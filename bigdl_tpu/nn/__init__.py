@@ -1,0 +1,45 @@
+"""bigdl_tpu.nn — the model layer (ref: scala/dllib .../nn/)."""
+
+from bigdl_tpu.nn.module import (
+    Criterion, Module, TensorModule, set_seed)
+from bigdl_tpu.nn.containers import (
+    Bottle, CAddTable, CAveTable, CDivTable, CMaxTable, CMinTable, CMulTable,
+    CSubTable, Concat, ConcatTable, Container, CosineDistance, DotProduct,
+    Echo, FlattenTable, JoinTable, MM, MV, MapTable, ParallelTable,
+    SelectTable, Sequential, SplitTable)
+from bigdl_tpu.nn.layers.linear import (
+    Add, Bilinear, CAdd, CMul, Cosine, Linear, Mul)
+from bigdl_tpu.nn.layers.conv import (
+    LocallyConnected1D, SpatialConvolution, SpatialDilatedConvolution,
+    SpatialFullConvolution, SpatialSeparableConvolution, TemporalConvolution)
+from bigdl_tpu.nn.layers.pooling import (
+    GlobalAveragePooling2D, GlobalMaxPooling2D, SpatialAveragePooling,
+    SpatialMaxPooling, TemporalMaxPooling, VolumetricMaxPooling)
+from bigdl_tpu.nn.layers.activation import (
+    Abs, AddConstant, Clamp, ELU, Exp, GELU, HardSigmoid, HardTanh, Identity,
+    LeakyReLU, Log, LogSoftMax, Mish, MulConstant, Negative, PReLU, Power,
+    ReLU, ReLU6, RReLU, SELU, SiLU, Sigmoid, SoftMax, SoftMin, SoftPlus,
+    SoftSign, Sqrt, Square, Swish, Tanh, Threshold)
+from bigdl_tpu.nn.layers.normalization import (
+    BatchNormalization, GroupNorm, LayerNorm, Normalize, RMSNorm,
+    SpatialBatchNormalization, SpatialCrossMapLRN, SpatialWithinChannelLRN)
+from bigdl_tpu.nn.layers.dropout import (
+    Dropout, GaussianDropout, GaussianNoise, SpatialDropout2D)
+from bigdl_tpu.nn.layers.shape import (
+    Contiguous, Flatten, InferReshape, Masking, Narrow, Padding, Permute,
+    Replicate, Reshape, Select, SpatialZeroPadding, Squeeze, Transpose,
+    Unsqueeze, UpSampling1D, UpSampling2D, View)
+from bigdl_tpu.nn.layers.embedding import Embedding, LookupTable
+from bigdl_tpu.nn.layers.recurrent import (
+    BiRecurrent, Cell, GRU, LSTM, Recurrent, RnnCell)
+from bigdl_tpu.nn.criterion import (
+    AbsCriterion, BCECriterion, BCEWithLogitsCriterion,
+    CategoricalCrossEntropy, ClassNLLCriterion, CosineEmbeddingCriterion,
+    CosineProximityCriterion, CrossEntropyCriterion, DistKLDivCriterion,
+    HingeEmbeddingCriterion, KullbackLeiblerDivergenceCriterion, L1Cost,
+    MAECriterion, MarginCriterion, MarginRankingCriterion,
+    MeanAbsolutePercentageCriterion, MeanSquaredLogarithmicCriterion,
+    MSECriterion, MultiCriterion, MultiLabelSoftMarginCriterion,
+    MultiMarginCriterion, ParallelCriterion, PoissonCriterion,
+    SmoothL1Criterion, SoftMarginCriterion, SoftmaxWithCriterion,
+    TimeDistributedCriterion)

@@ -1,0 +1,337 @@
+"""Containers (ref: .../nn/Sequential.scala, Concat.scala, ConcatTable.scala,
+ParallelTable.scala, CAddTable.scala, JoinTable.scala, SplitTable.scala,
+MapTable.scala, Bottle.scala, SelectTable.scala, FlattenTable.scala, ...).
+
+Containers recurse through the pure ``apply`` path with per-child param/state
+sub-scopes; the stateful facade is inherited from Module.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.module import Module, fold_name
+from bigdl_tpu.utils.table import T, Table
+
+
+class Container(Module):
+    """Base container (ref: nn/Container.scala)."""
+
+    def __init__(self, name: Optional[str] = None):
+        super().__init__(name)
+        self._ordered: list = []
+
+    def add(self, module: Module):
+        idx = str(len(self._ordered))
+        self._modules[idx] = module
+        self._ordered.append(module)
+        return self
+
+    def __len__(self):
+        return len(self._ordered)
+
+    def __getitem__(self, i) -> Module:
+        return self._ordered[i]
+
+    def _children_apply_seq(self, params, states, x, *, training, rng):
+        """Run children as a chain, returning (y, new_states)."""
+        new_states = {}
+        for idx in self._modules:
+            y, sub = self.sub_apply(idx, params, states, x,
+                                    training=training, rng=rng)
+            if sub:
+                new_states[idx] = sub
+            x = y
+        return x, _merge_states(states, new_states)
+
+
+def _merge_states(old: dict, updates: dict) -> dict:
+    if not updates:
+        return old
+    out = dict(old)
+    out.update(updates)
+    return out
+
+
+class Sequential(Container):
+    """ref: nn/Sequential.scala."""
+
+    def _apply(self, params, states, x, *, training, rng):
+        return self._children_apply_seq(params, states, x,
+                                        training=training, rng=rng)
+
+
+class Concat(Container):
+    """Apply each child to the same input, concat outputs along dim
+    (1-based; ref: nn/Concat.scala)."""
+
+    def __init__(self, dimension: int = 2, name: Optional[str] = None):
+        super().__init__(name)
+        self.dimension = dimension
+
+    def _apply(self, params, states, x, *, training, rng):
+        outs, new_states = [], {}
+        for idx in self._modules:
+            y, sub = self.sub_apply(idx, params, states, x,
+                                    training=training, rng=rng)
+            if sub:
+                new_states[idx] = sub
+            outs.append(y)
+        return (jnp.concatenate(outs, axis=self.dimension - 1),
+                _merge_states(states, new_states))
+
+
+class ConcatTable(Container):
+    """Each child sees the same input; outputs collected in a Table
+    (ref: nn/ConcatTable.scala)."""
+
+    def _apply(self, params, states, x, *, training, rng):
+        outs, new_states = [], {}
+        for idx in self._modules:
+            y, sub = self.sub_apply(idx, params, states, x,
+                                    training=training, rng=rng)
+            if sub:
+                new_states[idx] = sub
+            outs.append(y)
+        return T(*outs), _merge_states(states, new_states)
+
+
+class ParallelTable(Container):
+    """i-th child applied to i-th table element (ref: nn/ParallelTable.scala)."""
+
+    def _apply(self, params, states, x, *, training, rng):
+        xs = list(x) if isinstance(x, (Table, list, tuple)) else [x]
+        outs, new_states = [], {}
+        for (idx, _), xi in zip(self._modules.items(), xs):
+            y, sub = self.sub_apply(idx, params, states, xi,
+                                    training=training, rng=rng)
+            if sub:
+                new_states[idx] = sub
+            outs.append(y)
+        return T(*outs), _merge_states(states, new_states)
+
+
+class MapTable(Container):
+    """Same child applied to every table element (ref: nn/MapTable.scala)."""
+
+    def __init__(self, module: Optional[Module] = None,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        if module is not None:
+            self.add(module)
+
+    def _apply(self, params, states, x, *, training, rng):
+        xs = list(x)
+        outs = []
+        sub = states.get("0", {})
+        for i, xi in enumerate(xs):
+            r = None if rng is None else fold_name(rng, f"map{i}")
+            y, sub = self._modules["0"].apply(
+                params.get("0", {}), sub, xi, training=training, rng=r)
+            outs.append(y)
+        return T(*outs), _merge_states(states, {"0": sub} if sub else {})
+
+
+class Bottle(Container):
+    """Flatten leading dims, apply child, restore (ref: nn/Bottle.scala)."""
+
+    def __init__(self, module: Module, n_input_dim: int = 2,
+                 n_output_dim: Optional[int] = None,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.add(module)
+        self.n_input_dim = n_input_dim
+        self.n_output_dim = n_output_dim or n_input_dim
+
+    def _apply(self, params, states, x, *, training, rng):
+        lead = x.shape[: x.ndim - self.n_input_dim + 1]
+        flat = x.reshape((-1,) + x.shape[x.ndim - self.n_input_dim + 1:])
+        y, sub = self.sub_apply("0", params, states, flat,
+                                training=training, rng=rng)
+        y = y.reshape(lead + y.shape[1:])
+        return y, _merge_states(states, {"0": sub} if sub else {})
+
+
+# -- table arithmetic -------------------------------------------------------
+
+class CAddTable(Module):
+    """Elementwise sum of table elements (ref: nn/CAddTable.scala)."""
+
+    def __init__(self, inplace: bool = False, name: Optional[str] = None):
+        super().__init__(name)
+
+    def _apply(self, params, states, x, *, training, rng):
+        xs = list(x)
+        out = xs[0]
+        for xi in xs[1:]:
+            out = out + xi
+        return out
+
+
+class CMulTable(Module):
+    def _apply(self, params, states, x, *, training, rng):
+        xs = list(x)
+        out = xs[0]
+        for xi in xs[1:]:
+            out = out * xi
+        return out
+
+
+class CSubTable(Module):
+    def _apply(self, params, states, x, *, training, rng):
+        xs = list(x)
+        return xs[0] - xs[1]
+
+
+class CDivTable(Module):
+    def _apply(self, params, states, x, *, training, rng):
+        xs = list(x)
+        return xs[0] / xs[1]
+
+
+class CMaxTable(Module):
+    def _apply(self, params, states, x, *, training, rng):
+        xs = list(x)
+        out = xs[0]
+        for xi in xs[1:]:
+            out = jnp.maximum(out, xi)
+        return out
+
+
+class CMinTable(Module):
+    def _apply(self, params, states, x, *, training, rng):
+        xs = list(x)
+        out = xs[0]
+        for xi in xs[1:]:
+            out = jnp.minimum(out, xi)
+        return out
+
+
+class CAveTable(Module):
+    def _apply(self, params, states, x, *, training, rng):
+        xs = list(x)
+        return sum(xs) / len(xs)
+
+
+class DotProduct(Module):
+    """Batched dot of two inputs (ref: nn/DotProduct.scala)."""
+
+    def _apply(self, params, states, x, *, training, rng):
+        xs = list(x)
+        return jnp.sum(xs[0] * xs[1], axis=-1)
+
+
+class CosineDistance(Module):
+    """Batched cosine similarity of two inputs (ref: nn/CosineDistance.scala)."""
+
+    def _apply(self, params, states, x, *, training, rng):
+        a, b = list(x)
+        an = a / (jnp.linalg.norm(a, axis=-1, keepdims=True) + 1e-12)
+        bn = b / (jnp.linalg.norm(b, axis=-1, keepdims=True) + 1e-12)
+        return jnp.sum(an * bn, axis=-1)
+
+
+class MM(Module):
+    """Matrix multiply of table of two (ref: nn/MM.scala)."""
+
+    def __init__(self, trans_a: bool = False, trans_b: bool = False,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.trans_a, self.trans_b = trans_a, trans_b
+
+    def _apply(self, params, states, x, *, training, rng):
+        a, b = list(x)
+        if self.trans_a:
+            a = jnp.swapaxes(a, -1, -2)
+        if self.trans_b:
+            b = jnp.swapaxes(b, -1, -2)
+        return a @ b
+
+
+class MV(Module):
+    """Matrix–vector multiply of table (ref: nn/MV.scala)."""
+
+    def __init__(self, trans: bool = False, name: Optional[str] = None):
+        super().__init__(name)
+        self.trans = trans
+
+    def _apply(self, params, states, x, *, training, rng):
+        m, v = list(x)
+        if self.trans:
+            m = jnp.swapaxes(m, -1, -2)
+        return jnp.einsum("...ij,...j->...i", m, v)
+
+
+# -- table plumbing ---------------------------------------------------------
+
+class SelectTable(Module):
+    """1-based table index (ref: nn/SelectTable.scala)."""
+
+    def __init__(self, index: int, name: Optional[str] = None):
+        super().__init__(name)
+        self.index = index
+
+    def _apply(self, params, states, x, *, training, rng):
+        xs = list(x)
+        i = self.index - 1 if self.index > 0 else len(xs) + self.index
+        return xs[i]
+
+
+class FlattenTable(Module):
+    def _apply(self, params, states, x, *, training, rng):
+        flat = []
+
+        def rec(v):
+            if isinstance(v, (Table, list, tuple)):
+                for e in v:
+                    rec(e)
+            else:
+                flat.append(v)
+
+        rec(x)
+        return T(*flat)
+
+
+class JoinTable(Module):
+    """Concat table elements along dim (1-based, n_input_dims for
+    batch-dim adjust; ref: nn/JoinTable.scala)."""
+
+    def __init__(self, dimension: int, n_input_dims: int = 0,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.dimension = dimension
+        self.n_input_dims = n_input_dims
+
+    def _apply(self, params, states, x, *, training, rng):
+        xs = list(x)
+        d = self.dimension - 1
+        if self.n_input_dims and xs[0].ndim > self.n_input_dims:
+            d += xs[0].ndim - self.n_input_dims
+        return jnp.concatenate(xs, axis=d)
+
+
+class SplitTable(Module):
+    """Split along dim into a Table (ref: nn/SplitTable.scala)."""
+
+    def __init__(self, dimension: int, n_input_dims: int = 0,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.dimension = dimension
+        self.n_input_dims = n_input_dims
+
+    def _apply(self, params, states, x, *, training, rng):
+        d = self.dimension - 1 if self.dimension > 0 else x.ndim + self.dimension
+        if self.n_input_dims and x.ndim > self.n_input_dims:
+            d += x.ndim - self.n_input_dims
+        parts = [jnp.take(x, i, axis=d) for i in range(x.shape[d])]
+        return T(*parts)
+
+
+class Echo(Module):
+    """Debug pass-through that prints shape (ref: nn/Echo.scala)."""
+
+    def _apply(self, params, states, x, *, training, rng):
+        print(f"[{self.name}] shape={getattr(x, 'shape', None)}")
+        return x

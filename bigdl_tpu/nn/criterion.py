@@ -1,0 +1,352 @@
+"""Criterions — loss functions (ref: .../nn/ClassNLLCriterion.scala,
+CrossEntropyCriterion.scala, MSECriterion.scala, BCECriterion.scala,
+AbsCriterion.scala, SmoothL1Criterion.scala, MarginCriterion.scala,
+DistKLDivCriterion.scala, CosineEmbeddingCriterion.scala,
+ParallelCriterion.scala, TimeDistributedCriterion.scala, ...).
+
+Class-index targets follow the reference's 1-based convention: a target of
+``k`` selects log-prob column ``k-1``. ``zero_based_label=True`` switches to
+0-based (the python Keras path in the reference does the same conversion).
+Backward (gradInput) is jax.grad of ``apply_loss`` — see Criterion in
+module.py.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.module import Criterion
+from bigdl_tpu.utils.table import Table
+
+
+def _class_index(target, zero_based: bool):
+    idx = target.astype(jnp.int32)
+    if idx.ndim > 1:
+        idx = idx.reshape(idx.shape[0])
+    return idx if zero_based else idx - 1
+
+
+class ClassNLLCriterion(Criterion):
+    """NLL over log-probabilities (ref: nn/ClassNLLCriterion.scala).
+
+    Expects LogSoftMax output; pair = the reference's canonical
+    LeNet/ResNet training loss.
+    """
+
+    def __init__(self, weights=None, size_average: bool = True,
+                 logProbAsInput: bool = True, zero_based_label: bool = False):
+        super().__init__(size_average)
+        self.weights = None if weights is None else jnp.asarray(weights)
+        self.log_prob_as_input = logProbAsInput
+        self.zero_based = zero_based_label
+
+    def apply_loss(self, x, target):
+        logp = x if self.log_prob_as_input else jnp.log(x + 1e-8)
+        idx = _class_index(target, self.zero_based)
+        picked = jnp.take_along_axis(logp, idx[:, None], axis=1)[:, 0]
+        if self.weights is not None:
+            w = jnp.take(self.weights, idx)
+            loss = -jnp.sum(picked * w)
+            return loss / jnp.sum(w) if self.size_average else loss
+        return -jnp.mean(picked) if self.size_average else -jnp.sum(picked)
+
+
+class CrossEntropyCriterion(Criterion):
+    """LogSoftMax + ClassNLL fused (ref: nn/CrossEntropyCriterion.scala)."""
+
+    def __init__(self, weights=None, size_average: bool = True,
+                 zero_based_label: bool = False):
+        super().__init__(size_average)
+        self.weights = None if weights is None else jnp.asarray(weights)
+        self.zero_based = zero_based_label
+
+    def apply_loss(self, x, target):
+        logp = jax.nn.log_softmax(x, axis=-1)
+        idx = _class_index(target, self.zero_based)
+        picked = jnp.take_along_axis(logp, idx[:, None], axis=1)[:, 0]
+        if self.weights is not None:
+            w = jnp.take(self.weights, idx)
+            loss = -jnp.sum(picked * w)
+            return loss / jnp.sum(w) if self.size_average else loss
+        return -jnp.mean(picked) if self.size_average else -jnp.sum(picked)
+
+
+class CategoricalCrossEntropy(Criterion):
+    """One-hot-target cross entropy over probabilities (keras parity)."""
+
+    def apply_loss(self, x, target):
+        logp = jnp.log(jnp.clip(x, 1e-8, 1.0))
+        loss = -jnp.sum(target * logp, axis=-1)
+        return jnp.mean(loss) if self.size_average else jnp.sum(loss)
+
+
+class MSECriterion(Criterion):
+    def apply_loss(self, x, target):
+        d = (x - target) ** 2
+        return jnp.mean(d) if self.size_average else jnp.sum(d)
+
+
+class AbsCriterion(Criterion):
+    def apply_loss(self, x, target):
+        d = jnp.abs(x - target)
+        return jnp.mean(d) if self.size_average else jnp.sum(d)
+
+
+L1Cost = AbsCriterion
+
+
+class SmoothL1Criterion(Criterion):
+    def __init__(self, size_average: bool = True, sigma: float = 1.0):
+        super().__init__(size_average)
+        self.sigma = sigma
+
+    def apply_loss(self, x, target):
+        s2 = self.sigma * self.sigma
+        d = jnp.abs(x - target)
+        loss = jnp.where(d < 1.0 / s2, 0.5 * s2 * d * d, d - 0.5 / s2)
+        return jnp.mean(loss) if self.size_average else jnp.sum(loss)
+
+
+class BCECriterion(Criterion):
+    """Binary cross entropy over probabilities (ref: nn/BCECriterion.scala)."""
+
+    def __init__(self, weights=None, size_average: bool = True):
+        super().__init__(size_average)
+        self.weights = None if weights is None else jnp.asarray(weights)
+
+    def apply_loss(self, x, target):
+        eps = 1e-12
+        xc = jnp.clip(x, eps, 1 - eps)
+        loss = -(target * jnp.log(xc) + (1 - target) * jnp.log(1 - xc))
+        if self.weights is not None:
+            loss = loss * self.weights
+        return jnp.mean(loss) if self.size_average else jnp.sum(loss)
+
+
+class BCEWithLogitsCriterion(Criterion):
+    def apply_loss(self, x, target):
+        loss = jnp.maximum(x, 0) - x * target + jnp.log1p(jnp.exp(-jnp.abs(x)))
+        return jnp.mean(loss) if self.size_average else jnp.sum(loss)
+
+
+class DistKLDivCriterion(Criterion):
+    """KL divergence, input = log-probs (ref: nn/DistKLDivCriterion.scala)."""
+
+    def apply_loss(self, x, target):
+        loss = jnp.where(target > 0, target * (jnp.log(target + 1e-12) - x), 0.0)
+        if self.size_average:
+            return jnp.sum(loss) / x.shape[0]
+        return jnp.sum(loss)
+
+
+class MarginCriterion(Criterion):
+    """Hinge loss, targets ±1 (ref: nn/MarginCriterion.scala)."""
+
+    def __init__(self, margin: float = 1.0, size_average: bool = True,
+                 squared: bool = False):
+        super().__init__(size_average)
+        self.margin = margin
+        self.squared = squared
+
+    def apply_loss(self, x, target):
+        loss = jnp.maximum(0.0, self.margin - x * target)
+        if self.squared:
+            loss = loss * loss
+        return jnp.mean(loss) if self.size_average else jnp.sum(loss)
+
+
+class MarginRankingCriterion(Criterion):
+    """ref: nn/MarginRankingCriterion.scala — input Table(x1, x2)."""
+
+    def __init__(self, margin: float = 1.0, size_average: bool = True):
+        super().__init__(size_average)
+        self.margin = margin
+
+    def apply_loss(self, x, target):
+        x1, x2 = list(x)
+        loss = jnp.maximum(0.0, -target * (x1 - x2) + self.margin)
+        return jnp.mean(loss) if self.size_average else jnp.sum(loss)
+
+
+class HingeEmbeddingCriterion(Criterion):
+    def __init__(self, margin: float = 1.0, size_average: bool = True):
+        super().__init__(size_average)
+        self.margin = margin
+
+    def apply_loss(self, x, target):
+        loss = jnp.where(target > 0, x, jnp.maximum(0.0, self.margin - x))
+        return jnp.mean(loss) if self.size_average else jnp.sum(loss)
+
+
+class CosineEmbeddingCriterion(Criterion):
+    """ref: nn/CosineEmbeddingCriterion.scala — input Table(x1, x2)."""
+
+    def __init__(self, margin: float = 0.0, size_average: bool = True):
+        super().__init__(size_average)
+        self.margin = margin
+
+    def apply_loss(self, x, target):
+        x1, x2 = list(x)
+        cos = jnp.sum(x1 * x2, axis=-1) / (
+            jnp.linalg.norm(x1, axis=-1) * jnp.linalg.norm(x2, axis=-1) + 1e-12)
+        t = target.reshape(cos.shape)
+        loss = jnp.where(t > 0, 1.0 - cos, jnp.maximum(0.0, cos - self.margin))
+        return jnp.mean(loss) if self.size_average else jnp.sum(loss)
+
+
+class SoftmaxWithCriterion(Criterion):
+    """Softmax + NLL on raw scores with NCHW support (ref: caffe-style)."""
+
+    def __init__(self, ignore_label: Optional[int] = None,
+                 normalize_mode: str = "VALID"):
+        super().__init__(True)
+        self.ignore_label = ignore_label
+
+    def apply_loss(self, x, target):
+        logp = jax.nn.log_softmax(x, axis=1)
+        idx = target.astype(jnp.int32) - 1
+        picked = jnp.take_along_axis(
+            logp, idx[:, None] if idx.ndim == 1 else idx[:, None, ...], axis=1)
+        valid = jnp.ones_like(picked, dtype=bool) if self.ignore_label is None \
+            else (idx[:, None] != self.ignore_label - 1)
+        return -jnp.sum(jnp.where(valid, picked, 0.0)) / jnp.maximum(
+            jnp.sum(valid), 1)
+
+
+class ParallelCriterion(Criterion):
+    """Weighted sum of criterions over Table inputs (ref: ParallelCriterion.scala)."""
+
+    def __init__(self, repeat_target: bool = False):
+        super().__init__(True)
+        self.repeat_target = repeat_target
+        self.criterions: list = []
+        self.weights: list = []
+
+    def add(self, criterion: Criterion, weight: float = 1.0):
+        self.criterions.append(criterion)
+        self.weights.append(weight)
+        return self
+
+    def apply_loss(self, x, target):
+        xs = list(x) if isinstance(x, (Table, list, tuple)) else [x]
+        if self.repeat_target or not isinstance(target, (Table, list, tuple)):
+            ts = [target] * len(xs)
+        else:
+            ts = list(target)
+        total = 0.0
+        for crit, w, xi, ti in zip(self.criterions, self.weights, xs, ts):
+            total = total + w * crit.apply_loss(xi, ti)
+        return total
+
+
+class TimeDistributedCriterion(Criterion):
+    """Apply a criterion at every timestep (ref: TimeDistributedCriterion.scala)."""
+
+    def __init__(self, criterion: Criterion, size_average: bool = True,
+                 dimension: int = 2):
+        super().__init__(size_average)
+        self.criterion = criterion
+        self.dimension = dimension
+
+    def apply_loss(self, x, target):
+        steps = x.shape[self.dimension - 1]
+        total = 0.0
+        for t in range(steps):
+            xt = jnp.take(x, t, axis=self.dimension - 1)
+            tt = jnp.take(target, t, axis=self.dimension - 1) \
+                if target.ndim >= self.dimension else target
+            total = total + self.criterion.apply_loss(xt, tt)
+        return total / steps if self.size_average else total
+
+
+class MultiCriterion(Criterion):
+    """Sum of criterions on the same input (ref: nn/MultiCriterion.scala)."""
+
+    def __init__(self):
+        super().__init__(True)
+        self.criterions: list = []
+        self.weights: list = []
+
+    def add(self, criterion: Criterion, weight: float = 1.0):
+        self.criterions.append(criterion)
+        self.weights.append(weight)
+        return self
+
+    def apply_loss(self, x, target):
+        total = 0.0
+        for crit, w in zip(self.criterions, self.weights):
+            total = total + w * crit.apply_loss(x, target)
+        return total
+
+
+class MultiLabelSoftMarginCriterion(Criterion):
+    def apply_loss(self, x, target):
+        loss = -(target * jax.nn.log_sigmoid(x)
+                 + (1 - target) * jax.nn.log_sigmoid(-x))
+        return jnp.mean(loss) if self.size_average else jnp.sum(loss)
+
+
+class SoftMarginCriterion(Criterion):
+    def apply_loss(self, x, target):
+        loss = jnp.log1p(jnp.exp(-x * target))
+        return jnp.mean(loss) if self.size_average else jnp.sum(loss)
+
+
+class MultiMarginCriterion(Criterion):
+    """Multi-class hinge (ref: nn/MultiMarginCriterion.scala); 1-based target."""
+
+    def __init__(self, p: int = 1, weights=None, margin: float = 1.0,
+                 size_average: bool = True):
+        super().__init__(size_average)
+        self.p, self.margin = p, margin
+
+    def apply_loss(self, x, target):
+        idx = _class_index(target, False)
+        correct = jnp.take_along_axis(x, idx[:, None], axis=1)
+        loss = jnp.maximum(0.0, self.margin - correct + x) ** self.p
+        # zero out the correct-class column
+        mask = jax.nn.one_hot(idx, x.shape[1], dtype=bool)
+        loss = jnp.where(mask, 0.0, loss)
+        per_sample = jnp.sum(loss, axis=1) / x.shape[1]
+        return jnp.mean(per_sample) if self.size_average else jnp.sum(per_sample)
+
+
+class MAECriterion(AbsCriterion):
+    pass
+
+
+class KullbackLeiblerDivergenceCriterion(Criterion):
+    """Keras-style KLD over probability inputs."""
+
+    def apply_loss(self, x, target):
+        t = jnp.clip(target, 1e-7, 1.0)
+        p = jnp.clip(x, 1e-7, 1.0)
+        return jnp.mean(jnp.sum(t * jnp.log(t / p), axis=-1))
+
+
+class PoissonCriterion(Criterion):
+    def apply_loss(self, x, target):
+        return jnp.mean(x - target * jnp.log(x + 1e-7))
+
+
+class CosineProximityCriterion(Criterion):
+    def apply_loss(self, x, target):
+        xn = x / (jnp.linalg.norm(x, axis=-1, keepdims=True) + 1e-12)
+        tn = target / (jnp.linalg.norm(target, axis=-1, keepdims=True) + 1e-12)
+        return -jnp.mean(jnp.sum(xn * tn, axis=-1))
+
+
+class MeanAbsolutePercentageCriterion(Criterion):
+    def apply_loss(self, x, target):
+        diff = jnp.abs((target - x) / jnp.clip(jnp.abs(target), 1e-7, None))
+        return 100.0 * jnp.mean(diff)
+
+
+class MeanSquaredLogarithmicCriterion(Criterion):
+    def apply_loss(self, x, target):
+        a = jnp.log(jnp.clip(x, 1e-7, None) + 1.0)
+        b = jnp.log(jnp.clip(target, 1e-7, None) + 1.0)
+        return jnp.mean((a - b) ** 2)

@@ -1,0 +1,81 @@
+"""Parameter initialisation methods (ref: .../nn/InitializationMethod.scala).
+
+Each method is ``init(rng, shape, fan_in, fan_out) -> jnp array``. Layer
+constructors call these via :func:`init_param`; BigDL's defaults are kept
+(Xavier for Linear/SpatialConvolution weights, zeros for bias).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+class InitializationMethod:
+    def init(self, rng, shape, fan_in, fan_out):
+        raise NotImplementedError
+
+
+class Zeros(InitializationMethod):
+    def init(self, rng, shape, fan_in, fan_out):
+        return jnp.zeros(shape, jnp.float32)
+
+
+class Ones(InitializationMethod):
+    def init(self, rng, shape, fan_in, fan_out):
+        return jnp.ones(shape, jnp.float32)
+
+
+class ConstInitMethod(InitializationMethod):
+    def __init__(self, value: float):
+        self.value = value
+
+    def init(self, rng, shape, fan_in, fan_out):
+        return jnp.full(shape, self.value, jnp.float32)
+
+
+class RandomUniform(InitializationMethod):
+    def __init__(self, lower: float = -1.0, upper: float = 1.0):
+        self.lower, self.upper = lower, upper
+
+    def init(self, rng, shape, fan_in, fan_out):
+        return jax.random.uniform(
+            rng, shape, jnp.float32, self.lower, self.upper)
+
+
+class RandomNormal(InitializationMethod):
+    def __init__(self, mean: float = 0.0, stdv: float = 1.0):
+        self.mean, self.stdv = mean, stdv
+
+    def init(self, rng, shape, fan_in, fan_out):
+        return self.mean + self.stdv * jax.random.normal(rng, shape, jnp.float32)
+
+
+class Xavier(InitializationMethod):
+    """Glorot uniform — BigDL's default for Linear/Conv weights."""
+
+    def init(self, rng, shape, fan_in, fan_out):
+        limit = math.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(rng, shape, jnp.float32, -limit, limit)
+
+
+class MsraFiller(InitializationMethod):
+    """Kaiming/He init (ref: MsraFiller)."""
+
+    def __init__(self, var_in_count: bool = True):
+        self.var_in_count = var_in_count
+
+    def init(self, rng, shape, fan_in, fan_out):
+        n = fan_in if self.var_in_count else fan_out
+        std = math.sqrt(2.0 / n)
+        return std * jax.random.normal(rng, shape, jnp.float32)
+
+
+def init_param(method: InitializationMethod, rng, shape, fan_in=None, fan_out=None):
+    if fan_in is None:
+        fan_in = shape[-1] if len(shape) > 1 else shape[0]
+    if fan_out is None:
+        fan_out = shape[0]
+    return method.init(rng, shape, fan_in, fan_out)

@@ -1,0 +1,252 @@
+"""Activation layers (ref: .../nn/ReLU.scala, Tanh.scala, LogSoftMax.scala,
+SoftMax.scala, ELU.scala, PReLU.scala, HardTanh.scala, ...).
+
+Stateless elementwise modules — XLA fuses these into neighbouring matmuls/
+convs, which is the TPU-native replacement for the reference's oneDNN
+post-op fusion (nn/mkldnn/Fusion.scala).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.module import TensorModule
+
+
+class Identity(TensorModule):
+    def _apply(self, params, states, x, *, training, rng):
+        return x
+
+
+class ReLU(TensorModule):
+    def __init__(self, ip: bool = False, name: Optional[str] = None):
+        super().__init__(name)
+
+    def _apply(self, params, states, x, *, training, rng):
+        return jax.nn.relu(x)
+
+
+class ReLU6(TensorModule):
+    def _apply(self, params, states, x, *, training, rng):
+        return jax.nn.relu6(x)
+
+
+class Tanh(TensorModule):
+    def _apply(self, params, states, x, *, training, rng):
+        return jnp.tanh(x)
+
+
+class Sigmoid(TensorModule):
+    def _apply(self, params, states, x, *, training, rng):
+        return jax.nn.sigmoid(x)
+
+
+class HardSigmoid(TensorModule):
+    def _apply(self, params, states, x, *, training, rng):
+        return jnp.clip(0.2 * x + 0.5, 0.0, 1.0)
+
+
+class HardTanh(TensorModule):
+    def __init__(self, min_value: float = -1.0, max_value: float = 1.0,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.min_value, self.max_value = min_value, max_value
+
+    def _apply(self, params, states, x, *, training, rng):
+        return jnp.clip(x, self.min_value, self.max_value)
+
+
+class ELU(TensorModule):
+    def __init__(self, alpha: float = 1.0, name: Optional[str] = None):
+        super().__init__(name)
+        self.alpha = alpha
+
+    def _apply(self, params, states, x, *, training, rng):
+        return jax.nn.elu(x, self.alpha)
+
+
+class SELU(TensorModule):
+    def _apply(self, params, states, x, *, training, rng):
+        return jax.nn.selu(x)
+
+
+class GELU(TensorModule):
+    def __init__(self, approximate: bool = True, name: Optional[str] = None):
+        super().__init__(name)
+        self.approximate = approximate
+
+    def _apply(self, params, states, x, *, training, rng):
+        return jax.nn.gelu(x, approximate=self.approximate)
+
+
+class SiLU(TensorModule):
+    """a.k.a. Swish — used by Llama MLPs."""
+
+    def _apply(self, params, states, x, *, training, rng):
+        return jax.nn.silu(x)
+
+
+Swish = SiLU
+
+
+class Mish(TensorModule):
+    def _apply(self, params, states, x, *, training, rng):
+        return x * jnp.tanh(jax.nn.softplus(x))
+
+
+class LeakyReLU(TensorModule):
+    def __init__(self, negval: float = 0.01, name: Optional[str] = None):
+        super().__init__(name)
+        self.negval = negval
+
+    def _apply(self, params, states, x, *, training, rng):
+        return jax.nn.leaky_relu(x, self.negval)
+
+
+class PReLU(TensorModule):
+    """Learnable leaky slope (ref: nn/PReLU.scala). n_output_plane=0 → shared."""
+
+    def __init__(self, n_output_plane: int = 0, name: Optional[str] = None):
+        super().__init__(name)
+        self.n_output_plane = n_output_plane
+        size = (max(n_output_plane, 1),)
+        self.add_param("weight", jnp.full(size, 0.25))
+
+    def _apply(self, params, states, x, *, training, rng):
+        w = params["weight"]
+        if self.n_output_plane > 0 and x.ndim == 4:
+            w = w[:, None, None]  # NCHW channel broadcast
+        return jnp.where(x >= 0, x, w * x)
+
+
+class RReLU(TensorModule):
+    """Randomized leaky ReLU (ref: nn/RReLU.scala)."""
+
+    def __init__(self, lower: float = 1.0 / 8, upper: float = 1.0 / 3,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.lower, self.upper = lower, upper
+
+    def _apply(self, params, states, x, *, training, rng):
+        if training and rng is not None:
+            a = jax.random.uniform(rng, x.shape, x.dtype, self.lower, self.upper)
+        else:
+            a = (self.lower + self.upper) / 2.0
+        return jnp.where(x >= 0, x, a * x)
+
+
+class SoftMax(TensorModule):
+    def __init__(self, pos: int = -1, name: Optional[str] = None):
+        super().__init__(name)
+        self.pos = pos
+
+    def _apply(self, params, states, x, *, training, rng):
+        return jax.nn.softmax(x, axis=self.pos)
+
+
+class LogSoftMax(TensorModule):
+    def _apply(self, params, states, x, *, training, rng):
+        return jax.nn.log_softmax(x, axis=-1)
+
+
+class SoftMin(TensorModule):
+    def _apply(self, params, states, x, *, training, rng):
+        return jax.nn.softmax(-x, axis=-1)
+
+
+class SoftPlus(TensorModule):
+    def __init__(self, beta: float = 1.0, name: Optional[str] = None):
+        super().__init__(name)
+        self.beta = beta
+
+    def _apply(self, params, states, x, *, training, rng):
+        return jax.nn.softplus(self.beta * x) / self.beta
+
+
+class SoftSign(TensorModule):
+    def _apply(self, params, states, x, *, training, rng):
+        return jax.nn.soft_sign(x)
+
+
+class Threshold(TensorModule):
+    def __init__(self, th: float = 1e-6, v: float = 0.0,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.th, self.v = th, v
+
+    def _apply(self, params, states, x, *, training, rng):
+        return jnp.where(x > self.th, x, self.v)
+
+
+class Power(TensorModule):
+    """(shift + scale * x) ** power (ref: nn/Power.scala)."""
+
+    def __init__(self, power: float, scale: float = 1.0, shift: float = 0.0,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.power, self.scale, self.shift = power, scale, shift
+
+    def _apply(self, params, states, x, *, training, rng):
+        return (self.shift + self.scale * x) ** self.power
+
+
+class Square(TensorModule):
+    def _apply(self, params, states, x, *, training, rng):
+        return x * x
+
+
+class Sqrt(TensorModule):
+    def _apply(self, params, states, x, *, training, rng):
+        return jnp.sqrt(x)
+
+
+class Log(TensorModule):
+    def _apply(self, params, states, x, *, training, rng):
+        return jnp.log(x)
+
+
+class Exp(TensorModule):
+    def _apply(self, params, states, x, *, training, rng):
+        return jnp.exp(x)
+
+
+class Abs(TensorModule):
+    def _apply(self, params, states, x, *, training, rng):
+        return jnp.abs(x)
+
+
+class Negative(TensorModule):
+    def _apply(self, params, states, x, *, training, rng):
+        return -x
+
+
+class Clamp(TensorModule):
+    def __init__(self, min_v: float, max_v: float, name: Optional[str] = None):
+        super().__init__(name)
+        self.min_v, self.max_v = min_v, max_v
+
+    def _apply(self, params, states, x, *, training, rng):
+        return jnp.clip(x, self.min_v, self.max_v)
+
+
+class AddConstant(TensorModule):
+    def __init__(self, constant_scalar: float, ip: bool = False,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.constant_scalar = constant_scalar
+
+    def _apply(self, params, states, x, *, training, rng):
+        return x + self.constant_scalar
+
+
+class MulConstant(TensorModule):
+    def __init__(self, scalar: float, ip: bool = False,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.scalar = scalar
+
+    def _apply(self, params, states, x, *, training, rng):
+        return x * self.scalar

@@ -1,0 +1,85 @@
+"""Dropout / noise layers (ref: .../nn/Dropout.scala, GaussianDropout.scala,
+GaussianNoise.scala, SpatialDropout2D.scala).
+
+All stochastic layers draw from the per-call ``rng`` threaded through
+``Module.apply`` (jax functional randomness replacing the reference's
+per-thread RandomGenerator state).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.module import TensorModule
+
+
+class Dropout(TensorModule):
+    """ref: nn/Dropout.scala — inverted dropout (scale at train time)."""
+
+    def __init__(self, init_p: float = 0.5, scale: bool = True,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.p = init_p
+        self.scale = scale
+
+    def _apply(self, params, states, x, *, training, rng):
+        if not training or self.p <= 0.0 or rng is None:
+            return x
+        keep = 1.0 - self.p
+        mask = jax.random.bernoulli(rng, keep, x.shape)
+        y = jnp.where(mask, x, 0.0)
+        if self.scale:
+            y = y / keep
+        return y
+
+
+class SpatialDropout2D(TensorModule):
+    """Drops whole feature maps (ref: nn/SpatialDropout2D.scala)."""
+
+    def __init__(self, init_p: float = 0.5, format: str = "NCHW",
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.p = init_p
+        self.format = format
+
+    def _apply(self, params, states, x, *, training, rng):
+        if not training or self.p <= 0.0 or rng is None:
+            return x
+        keep = 1.0 - self.p
+        if self.format == "NCHW":
+            mask_shape = (x.shape[0], x.shape[1], 1, 1)
+        else:
+            mask_shape = (x.shape[0], 1, 1, x.shape[3])
+        mask = jax.random.bernoulli(rng, keep, mask_shape)
+        return jnp.where(mask, x / keep, 0.0)
+
+
+class GaussianDropout(TensorModule):
+    """Multiplicative 1-mean gaussian noise (ref: nn/GaussianDropout.scala)."""
+
+    def __init__(self, rate: float, name: Optional[str] = None):
+        super().__init__(name)
+        self.rate = rate
+
+    def _apply(self, params, states, x, *, training, rng):
+        if not training or rng is None:
+            return x
+        stddev = (self.rate / (1.0 - self.rate)) ** 0.5
+        noise = 1.0 + stddev * jax.random.normal(rng, x.shape, x.dtype)
+        return x * noise
+
+
+class GaussianNoise(TensorModule):
+    """Additive gaussian noise (ref: nn/GaussianNoise.scala)."""
+
+    def __init__(self, stddev: float, name: Optional[str] = None):
+        super().__init__(name)
+        self.stddev = stddev
+
+    def _apply(self, params, states, x, *, training, rng):
+        if not training or rng is None:
+            return x
+        return x + self.stddev * jax.random.normal(rng, x.shape, x.dtype)

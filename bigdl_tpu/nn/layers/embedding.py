@@ -1,0 +1,56 @@
+"""Embedding layers (ref: .../nn/LookupTable.scala, LookupTableSparse.scala).
+
+The reference's LookupTable is a gather with optional max-norm constraint;
+indices are 1-based there — we accept both via ``zero_based`` (python API
+users commonly pass 1-based labels/ids in BigDL).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.initialization import RandomNormal, init_param
+from bigdl_tpu.nn.module import RNG, TensorModule
+
+
+class LookupTable(TensorModule):
+    """ref: nn/LookupTable.scala."""
+
+    def __init__(self, n_index: int, n_output: int,
+                 padding_value: float = 0.0, max_norm: float = float("inf"),
+                 norm_type: float = 2.0, should_scale_grad_by_freq: bool = False,
+                 zero_based: bool = False, name: Optional[str] = None):
+        super().__init__(name)
+        self.n_index, self.n_output = n_index, n_output
+        self.padding_value = padding_value
+        self.max_norm = max_norm
+        self.norm_type = norm_type
+        self.zero_based = zero_based
+        self.add_param("weight", init_param(
+            RandomNormal(0, 1), RNG.next_key(), (n_index, n_output),
+            fan_in=n_index, fan_out=n_output))
+
+    def _apply(self, params, states, x, *, training, rng):
+        w = params["weight"]
+        if self.max_norm != float("inf"):
+            norms = jnp.linalg.norm(w, ord=self.norm_type, axis=1,
+                                    keepdims=True)
+            w = w * jnp.minimum(1.0, self.max_norm / (norms + 1e-12))
+        idx = x.astype(jnp.int32)
+        if not self.zero_based:
+            idx = idx - 1
+        y = jnp.take(w, jnp.clip(idx, 0, self.n_index - 1), axis=0)
+        if self.padding_value != 0.0:
+            pad_idx = int(self.padding_value) - (0 if self.zero_based else 1)
+            y = jnp.where((idx == pad_idx)[..., None], 0.0, y)
+        return y
+
+
+class Embedding(LookupTable):
+    """Keras-style zero-based embedding."""
+
+    def __init__(self, input_dim: int, output_dim: int,
+                 name: Optional[str] = None):
+        super().__init__(input_dim, output_dim, zero_based=True, name=name)

@@ -1,0 +1,155 @@
+"""Linear layers (ref: .../nn/Linear.scala, Bilinear.scala, CMul.scala, ...).
+
+The reference's Linear stores ``weight (out, in)`` and computes
+``output = input @ weight.T + bias`` with hand-written backward; here the
+forward is one jnp matmul (MXU) and backward comes from autodiff.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.initialization import (
+    InitializationMethod, Xavier, Zeros, init_param)
+from bigdl_tpu.nn.module import RNG, TensorModule
+
+
+class Linear(TensorModule):
+    """y = x W^T + b (ref: nn/Linear.scala)."""
+
+    def __init__(
+        self,
+        input_size: int,
+        output_size: int,
+        with_bias: bool = True,
+        w_regularizer=None,
+        b_regularizer=None,
+        init_weight: Optional[InitializationMethod] = None,
+        init_bias: Optional[InitializationMethod] = None,
+        name: Optional[str] = None,
+    ):
+        super().__init__(name)
+        self.input_size = input_size
+        self.output_size = output_size
+        self.with_bias = with_bias
+        self.w_regularizer = w_regularizer
+        self.b_regularizer = b_regularizer
+        self._init_weight = init_weight or Xavier()
+        self._init_bias = init_bias or Zeros()
+        self.reset()
+
+    def reset(self):
+        w = init_param(self._init_weight, RNG.next_key(),
+                       (self.output_size, self.input_size),
+                       fan_in=self.input_size, fan_out=self.output_size)
+        self.add_param("weight", w)
+        if self.with_bias:
+            b = init_param(self._init_bias, RNG.next_key(),
+                           (self.output_size,),
+                           fan_in=self.input_size, fan_out=self.output_size)
+            self.add_param("bias", b)
+        return self
+
+    def _apply(self, params, states, x, *, training, rng):
+        y = x @ params["weight"].T.astype(x.dtype)
+        if self.with_bias:
+            y = y + params["bias"].astype(x.dtype)
+        return y
+
+
+class Bilinear(TensorModule):
+    """y_k = x1 W_k x2 + b_k over a Table of two inputs (ref: Bilinear.scala)."""
+
+    def __init__(self, input_size1: int, input_size2: int, output_size: int,
+                 bias_res: bool = True, name: Optional[str] = None):
+        super().__init__(name)
+        self.input_size1 = input_size1
+        self.input_size2 = input_size2
+        self.output_size = output_size
+        self.bias_res = bias_res
+        self.reset()
+
+    def reset(self):
+        self.add_param("weight", init_param(
+            Xavier(), RNG.next_key(),
+            (self.output_size, self.input_size1, self.input_size2),
+            fan_in=self.input_size1 * self.input_size2,
+            fan_out=self.output_size))
+        if self.bias_res:
+            self.add_param("bias", jnp.zeros((self.output_size,)))
+        return self
+
+    def _apply(self, params, states, x, *, training, rng):
+        x1, x2 = list(x)
+        y = jnp.einsum("bi,oij,bj->bo", x1, params["weight"], x2)
+        if self.bias_res:
+            y = y + params["bias"]
+        return y
+
+
+class CMul(TensorModule):
+    """Learnable per-element scale, broadcastable size (ref: CMul.scala)."""
+
+    def __init__(self, size, name: Optional[str] = None):
+        super().__init__(name)
+        self.size = tuple(size)
+        self.add_param("weight", jnp.ones(self.size))
+
+    def _apply(self, params, states, x, *, training, rng):
+        return x * params["weight"]
+
+
+class CAdd(TensorModule):
+    """Learnable per-element bias (ref: CAdd.scala)."""
+
+    def __init__(self, size, name: Optional[str] = None):
+        super().__init__(name)
+        self.size = tuple(size)
+        self.add_param("bias", jnp.zeros(self.size))
+
+    def _apply(self, params, states, x, *, training, rng):
+        return x + params["bias"]
+
+
+class Add(TensorModule):
+    """Learnable bias vector (ref: Add.scala)."""
+
+    def __init__(self, input_size: int, name: Optional[str] = None):
+        super().__init__(name)
+        self.input_size = input_size
+        self.add_param("bias", jnp.zeros((input_size,)))
+
+    def _apply(self, params, states, x, *, training, rng):
+        return x + params["bias"]
+
+
+class Mul(TensorModule):
+    """Single learnable scalar gain (ref: Mul.scala)."""
+
+    def __init__(self, name: Optional[str] = None):
+        super().__init__(name)
+        self.add_param("weight", jnp.ones(()))
+
+    def _apply(self, params, states, x, *, training, rng):
+        return x * params["weight"]
+
+
+class Cosine(TensorModule):
+    """Cosine similarity against a weight matrix (ref: Cosine.scala)."""
+
+    def __init__(self, input_size: int, output_size: int,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.input_size = input_size
+        self.output_size = output_size
+        self.add_param("weight", init_param(
+            Xavier(), RNG.next_key(), (output_size, input_size),
+            fan_in=input_size, fan_out=output_size))
+
+    def _apply(self, params, states, x, *, training, rng):
+        w = params["weight"]
+        xn = x / (jnp.linalg.norm(x, axis=-1, keepdims=True) + 1e-12)
+        wn = w / (jnp.linalg.norm(w, axis=-1, keepdims=True) + 1e-12)
+        return xn @ wn.T

@@ -1,0 +1,216 @@
+"""Normalization layers (ref: .../nn/BatchNormalization.scala,
+SpatialBatchNormalization.scala, Normalize.scala, SpatialCrossMapLRN.scala,
+LayerNorm in nn/mkldnn + keras; RMSNorm is the LLM-era addition).
+
+BatchNorm is the one stateful layer family: running mean/var live in the
+module's **state** collection and the pure ``apply`` returns updated state
+in training mode (the functional answer to the reference's in-place
+runningMean updates).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.module import TensorModule
+
+
+class BatchNormalization(TensorModule):
+    """1-D batchnorm over (B, C) or (B, C, T)(ref: nn/BatchNormalization.scala).
+
+    Note the reference's ``momentum`` means "weight of the new batch stat"
+    (runningMean = (1-momentum)*runningMean + momentum*batchMean).
+    """
+
+    _feature_axis = 1
+
+    def __init__(self, n_output: int, eps: float = 1e-5,
+                 momentum: float = 0.1, affine: bool = True,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.n_output = n_output
+        self.eps = eps
+        self.momentum = momentum
+        self.affine = affine
+        if affine:
+            self.add_param("weight", jnp.ones((n_output,)))
+            self.add_param("bias", jnp.zeros((n_output,)))
+        self.add_state("running_mean", jnp.zeros((n_output,)))
+        self.add_state("running_var", jnp.ones((n_output,)))
+
+    def _reduce_axes(self, x):
+        return tuple(i for i in range(x.ndim) if i != self._feature_axis)
+
+    def _bshape(self, x):
+        return tuple(self.n_output if i == self._feature_axis else 1
+                     for i in range(x.ndim))
+
+    def _apply(self, params, states, x, *, training, rng):
+        axes = self._reduce_axes(x)
+        if training:
+            mean = jnp.mean(x, axis=axes)
+            var = jnp.var(x, axis=axes)
+            n = x.size // self.n_output
+            unbiased = var * n / max(n - 1, 1)
+            new_states = {
+                "running_mean": (1 - self.momentum) * states["running_mean"]
+                + self.momentum * mean,
+                "running_var": (1 - self.momentum) * states["running_var"]
+                + self.momentum * unbiased,
+            }
+        else:
+            mean, var = states["running_mean"], states["running_var"]
+            new_states = states
+        shape = self._bshape(x)
+        y = (x - mean.reshape(shape).astype(x.dtype)) * (
+            1.0 / jnp.sqrt(var.reshape(shape).astype(x.dtype) + self.eps))
+        if self.affine:
+            y = y * params["weight"].reshape(shape).astype(x.dtype) \
+                + params["bias"].reshape(shape).astype(x.dtype)
+        return y, new_states
+
+
+class SpatialBatchNormalization(BatchNormalization):
+    """NCHW/NHWC batchnorm (ref: nn/SpatialBatchNormalization.scala)."""
+
+    def __init__(self, n_output: int, eps: float = 1e-5,
+                 momentum: float = 0.1, affine: bool = True,
+                 format: str = "NCHW", name: Optional[str] = None):
+        self._fmt = format
+        super().__init__(n_output, eps, momentum, affine, name)
+
+    @property
+    def _feature_axis(self):
+        return 1 if self._fmt == "NCHW" else 3
+
+
+class LayerNorm(TensorModule):
+    """Layer normalization over the last dim (keras-era BigDL LayerNorm)."""
+
+    def __init__(self, hidden_size: int, eps: float = 1e-6,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.hidden_size = hidden_size
+        self.eps = eps
+        self.add_param("weight", jnp.ones((hidden_size,)))
+        self.add_param("bias", jnp.zeros((hidden_size,)))
+
+    def _apply(self, params, states, x, *, training, rng):
+        mean = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.var(x, axis=-1, keepdims=True)
+        y = (x - mean) / jnp.sqrt(var + self.eps)
+        return y * params["weight"].astype(x.dtype) + params["bias"].astype(x.dtype)
+
+
+class RMSNorm(TensorModule):
+    """Root-mean-square norm (no reference equivalent — Llama-family need)."""
+
+    def __init__(self, hidden_size: int, eps: float = 1e-6,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.hidden_size = hidden_size
+        self.eps = eps
+        self.add_param("weight", jnp.ones((hidden_size,)))
+
+    def _apply(self, params, states, x, *, training, rng):
+        dtype = x.dtype
+        xf = x.astype(jnp.float32)
+        inv = jnp.reciprocal(
+            jnp.sqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + self.eps))
+        return (xf * inv).astype(dtype) * params["weight"].astype(dtype)
+
+
+class GroupNorm(TensorModule):
+    def __init__(self, n_groups: int, n_channels: int, eps: float = 1e-5,
+                 format: str = "NCHW", name: Optional[str] = None):
+        super().__init__(name)
+        assert n_channels % n_groups == 0
+        self.n_groups, self.n_channels, self.eps = n_groups, n_channels, eps
+        self.format = format
+        self.add_param("weight", jnp.ones((n_channels,)))
+        self.add_param("bias", jnp.zeros((n_channels,)))
+
+    def _apply(self, params, states, x, *, training, rng):
+        if self.format == "NHWC":
+            x = jnp.moveaxis(x, -1, 1)
+        b, c = x.shape[0], x.shape[1]
+        g = self.n_groups
+        xg = x.reshape(b, g, c // g, *x.shape[2:])
+        axes = tuple(range(2, xg.ndim))
+        mean = jnp.mean(xg, axis=axes, keepdims=True)
+        var = jnp.var(xg, axis=axes, keepdims=True)
+        xg = (xg - mean) / jnp.sqrt(var + self.eps)
+        y = xg.reshape(x.shape)
+        shape = (1, c) + (1,) * (x.ndim - 2)
+        y = y * params["weight"].reshape(shape) + params["bias"].reshape(shape)
+        if self.format == "NHWC":
+            y = jnp.moveaxis(y, 1, -1)
+        return y
+
+
+class Normalize(TensorModule):
+    """Lp-normalize over the feature dim (ref: nn/Normalize.scala)."""
+
+    def __init__(self, p: float = 2.0, eps: float = 1e-10,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.p, self.eps = p, eps
+
+    def _apply(self, params, states, x, *, training, rng):
+        if self.p == float("inf"):
+            norm = jnp.max(jnp.abs(x), axis=-1, keepdims=True)
+        else:
+            norm = jnp.sum(jnp.abs(x) ** self.p, axis=-1,
+                           keepdims=True) ** (1.0 / self.p)
+        return x / (norm + self.eps)
+
+
+class SpatialCrossMapLRN(TensorModule):
+    """Local response norm across channels (ref: nn/SpatialCrossMapLRN.scala).
+
+    out = x / (k + alpha/size * sum_{nearby c} x_c^2)^beta — AlexNet/Inception-v1.
+    """
+
+    def __init__(self, size: int = 5, alpha: float = 1.0,
+                 beta: float = 0.75, k: float = 1.0, format: str = "NCHW",
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.size, self.alpha, self.beta, self.k = size, alpha, beta, k
+        self.format = format
+
+    def _apply(self, params, states, x, *, training, rng):
+        c_axis = 1 if self.format == "NCHW" else 3
+        sq = x * x
+        half = self.size // 2
+        pad = [(0, 0)] * x.ndim
+        pad[c_axis] = (half, self.size - 1 - half)
+        sq = jnp.pad(sq, pad)
+        # windowed sum over channel axis
+        acc = sum(
+            jnp.take(sq, jnp.arange(i, i + x.shape[c_axis]), axis=c_axis)
+            for i in range(self.size))
+        denom = (self.k + self.alpha / self.size * acc) ** self.beta
+        return x / denom
+
+
+class SpatialWithinChannelLRN(TensorModule):
+    """LRN within channel over a spatial window (ref: nn/SpatialWithinChannelLRN.scala)."""
+
+    def __init__(self, size: int = 5, alpha: float = 1.0, beta: float = 0.75,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.size, self.alpha, self.beta = size, alpha, beta
+
+    def _apply(self, params, states, x, *, training, rng):
+        from jax import lax
+        half = self.size // 2
+        sq = x * x
+        summed = lax.reduce_window(
+            sq, jnp.array(0, x.dtype), lax.add,
+            (1, 1, self.size, self.size), (1, 1, 1, 1),
+            ((0, 0), (0, 0), (half, self.size - 1 - half),
+             (half, self.size - 1 - half)))
+        denom = (1.0 + self.alpha / (self.size * self.size) * summed) ** self.beta
+        return x / denom

@@ -1,0 +1,175 @@
+"""Pooling layers (ref: .../nn/SpatialMaxPooling.scala,
+SpatialAveragePooling.scala, TemporalMaxPooling.scala, Pooling ops).
+
+All lower to ``lax.reduce_window`` — XLA's pooling primitive.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import lax
+
+from bigdl_tpu.nn.module import TensorModule
+
+
+def _pool2d(x, init, op, kh, kw, sh, sw, padding, format):
+    if format == "NCHW":
+        dims = (1, 1, kh, kw)
+        strides = (1, 1, sh, sw)
+        pads = ((0, 0), (0, 0)) + padding
+    else:
+        dims = (1, kh, kw, 1)
+        strides = (1, sh, sw, 1)
+        pads = ((0, 0),) + padding + ((0, 0),)
+    return lax.reduce_window(x, init, op, dims, strides, pads)
+
+
+def _pool_pads(x, format, kh, kw, dh, dw, pad_h, pad_w, ceil_mode):
+    """Shared SAME (pad=-1) / ceil_mode padding math for 2-D pooling.
+
+    ceil_mode pads up on the high side (XLA reduce_window is floor-mode).
+    """
+    h_axis = 2 if format == "NCHW" else 1
+    ih, iw = x.shape[h_axis], x.shape[h_axis + 1]
+    if pad_h == -1 or pad_w == -1:  # SAME
+        oh = -(-ih // dh)
+        ow = -(-iw // dw)
+        tot_h = max((oh - 1) * dh + kh - ih, 0)
+        tot_w = max((ow - 1) * dw + kw - iw, 0)
+        return ((tot_h // 2, tot_h - tot_h // 2),
+                (tot_w // 2, tot_w - tot_w // 2))
+    extra_h = extra_w = 0
+    if ceil_mode:
+        oh_floor = (ih + 2 * pad_h - kh) // dh + 1
+        oh_ceil = -(-(ih + 2 * pad_h - kh) // dh) + 1
+        extra_h = (oh_ceil - oh_floor) * dh
+        ow_floor = (iw + 2 * pad_w - kw) // dw + 1
+        ow_ceil = -(-(iw + 2 * pad_w - kw) // dw) + 1
+        extra_w = (ow_ceil - ow_floor) * dw
+    return ((pad_h, pad_h + extra_h), (pad_w, pad_w + extra_w))
+
+
+class SpatialMaxPooling(TensorModule):
+    """ref: nn/SpatialMaxPooling.scala. pad=-1 → SAME; ceil_mode supported
+    by padding up (XLA reduce_window is floor-mode)."""
+
+    def __init__(self, kw: int, kh: int, dw: Optional[int] = None,
+                 dh: Optional[int] = None, pad_w: int = 0, pad_h: int = 0,
+                 format: str = "NCHW", ceil_mode: bool = False,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.kw, self.kh = kw, kh
+        self.dw, self.dh = dw or kw, dh or kh
+        self.pad_w, self.pad_h = pad_w, pad_h
+        self.format = format
+        self.ceil_mode = ceil_mode
+
+    def ceil(self):
+        self.ceil_mode = True
+        return self
+
+    def _apply(self, params, states, x, *, training, rng):
+        pads = _pool_pads(x, self.format, self.kh, self.kw, self.dh, self.dw,
+                          self.pad_h, self.pad_w, self.ceil_mode)
+        return _pool2d(x, -jnp.inf, lax.max, self.kh, self.kw, self.dh, self.dw,
+                       pads, self.format)
+
+
+class SpatialAveragePooling(TensorModule):
+    """ref: nn/SpatialAveragePooling.scala (count_include_pad default true)."""
+
+    def __init__(self, kw: int, kh: int, dw: Optional[int] = None,
+                 dh: Optional[int] = None, pad_w: int = 0, pad_h: int = 0,
+                 global_pooling: bool = False, ceil_mode: bool = False,
+                 count_include_pad: bool = True, divide: bool = True,
+                 format: str = "NCHW", name: Optional[str] = None):
+        super().__init__(name)
+        self.kw, self.kh = kw, kh
+        self.dw, self.dh = dw or kw, dh or kh
+        self.pad_w, self.pad_h = pad_w, pad_h
+        self.global_pooling = global_pooling
+        self.ceil_mode = ceil_mode
+        self.count_include_pad = count_include_pad
+        self.divide = divide
+        self.format = format
+
+    def ceil(self):
+        self.ceil_mode = True
+        return self
+
+    def _apply(self, params, states, x, *, training, rng):
+        h_axis = 2 if self.format == "NCHW" else 1
+        kh, kw = self.kh, self.kw
+        dh, dw = self.dh, self.dw
+        if self.global_pooling:
+            kh, kw = x.shape[h_axis], x.shape[h_axis + 1]
+            dh, dw = 1, 1
+        pads = _pool_pads(x, self.format, kh, kw, dh, dw,
+                          self.pad_h, self.pad_w, self.ceil_mode)
+        summed = _pool2d(x, 0.0, lax.add, kh, kw, dh, dw,
+                         pads, self.format)
+        if not self.divide:
+            return summed
+        if self.count_include_pad:
+            return summed / (kh * kw)
+        ones = jnp.ones_like(x)
+        counts = _pool2d(ones, 0.0, lax.add, kh, kw, dh, dw,
+                         pads, self.format)
+        return summed / jnp.maximum(counts, 1.0)
+
+
+class TemporalMaxPooling(TensorModule):
+    """1-D max pooling over (B, T, C) (ref: nn/TemporalMaxPooling.scala)."""
+
+    def __init__(self, k_w: int, d_w: Optional[int] = None,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.k_w = k_w
+        self.d_w = d_w or k_w
+
+    def _apply(self, params, states, x, *, training, rng):
+        return lax.reduce_window(
+            x, -jnp.inf, lax.max, (1, self.k_w, 1), (1, self.d_w, 1),
+            ((0, 0), (0, 0), (0, 0)))
+
+
+class GlobalAveragePooling2D(TensorModule):
+    def __init__(self, format: str = "NCHW", keep_dims: bool = False,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.format = format
+        self.keep_dims = keep_dims
+
+    def _apply(self, params, states, x, *, training, rng):
+        axes = (2, 3) if self.format == "NCHW" else (1, 2)
+        return jnp.mean(x, axis=axes, keepdims=self.keep_dims)
+
+
+class GlobalMaxPooling2D(TensorModule):
+    def __init__(self, format: str = "NCHW", name: Optional[str] = None):
+        super().__init__(name)
+        self.format = format
+
+    def _apply(self, params, states, x, *, training, rng):
+        axes = (2, 3) if self.format == "NCHW" else (1, 2)
+        return jnp.max(x, axis=axes)
+
+
+class VolumetricMaxPooling(TensorModule):
+    """3-D max pooling, NCDHW (ref: nn/VolumetricMaxPooling.scala)."""
+
+    def __init__(self, kt: int, kw: int, kh: int, dt: Optional[int] = None,
+                 dw: Optional[int] = None, dh: Optional[int] = None,
+                 pad_t: int = 0, pad_w: int = 0, pad_h: int = 0,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.k = (kt, kh, kw)
+        self.d = (dt or kt, dh or kh, dw or kw)
+        self.p = (pad_t, pad_h, pad_w)
+
+    def _apply(self, params, states, x, *, training, rng):
+        return lax.reduce_window(
+            x, -jnp.inf, lax.max, (1, 1) + self.k, (1, 1) + self.d,
+            ((0, 0), (0, 0)) + tuple((p, p) for p in self.p))

@@ -1,0 +1,190 @@
+"""Recurrent layers (ref: .../nn/Recurrent.scala, LSTM.scala, GRU.scala,
+RnnCell.scala, BiRecurrent.scala, LSTMPeephole.scala).
+
+The reference's ``Recurrent`` container unrolls cells step-by-step in Scala;
+here the time loop is ``lax.scan`` — compiled once, fused by XLA, and the
+idiomatic TPU control-flow replacement for data-dependent Python loops.
+
+Cells expose ``init_carry(batch)`` + ``step(params, carry, x_t) -> (carry,
+y_t)``; the ``Recurrent`` wrapper scans a cell over (B, T, C) input.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from bigdl_tpu.nn.initialization import Xavier, init_param
+from bigdl_tpu.nn.module import RNG, TensorModule
+
+
+class Cell(TensorModule):
+    hidden_size: int
+
+    def init_carry(self, batch: int, dtype=jnp.float32):
+        raise NotImplementedError
+
+    def step(self, params, carry, x_t):
+        raise NotImplementedError
+
+    def _apply(self, params, states, x, *, training, rng):
+        # Applying a bare cell to (B, C) input runs one step from zeros.
+        carry = self.init_carry(x.shape[0], x.dtype)
+        _, y = self.step(params, carry, x)
+        return y
+
+
+class RnnCell(Cell):
+    """Simple tanh RNN cell (ref: nn/RnnCell.scala)."""
+
+    def __init__(self, input_size: int, hidden_size: int,
+                 activation: str = "tanh", name: Optional[str] = None):
+        super().__init__(name)
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.activation = activation
+        k = RNG.next_key
+        self.add_param("w_ih", init_param(Xavier(), k(), (hidden_size, input_size),
+                                          fan_in=input_size, fan_out=hidden_size))
+        self.add_param("w_hh", init_param(Xavier(), k(), (hidden_size, hidden_size),
+                                          fan_in=hidden_size, fan_out=hidden_size))
+        self.add_param("bias", jnp.zeros((hidden_size,)))
+
+    def init_carry(self, batch, dtype=jnp.float32):
+        return jnp.zeros((batch, self.hidden_size), dtype)
+
+    def step(self, params, carry, x_t):
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+        h = act(x_t @ params["w_ih"].T + carry @ params["w_hh"].T
+                + params["bias"])
+        return h, h
+
+
+class LSTM(Cell):
+    """LSTM cell (ref: nn/LSTM.scala). Gate order: i, f, g, o."""
+
+    def __init__(self, input_size: int, hidden_size: int,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.input_size, self.hidden_size = input_size, hidden_size
+        k = RNG.next_key
+        self.add_param("w_ih", init_param(
+            Xavier(), k(), (4 * hidden_size, input_size),
+            fan_in=input_size, fan_out=hidden_size))
+        self.add_param("w_hh", init_param(
+            Xavier(), k(), (4 * hidden_size, hidden_size),
+            fan_in=hidden_size, fan_out=hidden_size))
+        self.add_param("bias", jnp.zeros((4 * hidden_size,)))
+
+    def init_carry(self, batch, dtype=jnp.float32):
+        return (jnp.zeros((batch, self.hidden_size), dtype),
+                jnp.zeros((batch, self.hidden_size), dtype))
+
+    def step(self, params, carry, x_t):
+        h, c = carry
+        z = (x_t @ params["w_ih"].T.astype(x_t.dtype)
+             + h @ params["w_hh"].T.astype(x_t.dtype)
+             + params["bias"].astype(x_t.dtype))
+        i, f, g, o = jnp.split(z, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        g = jnp.tanh(g)
+        c = f * c + i * g
+        h = o * jnp.tanh(c)
+        return (h, c), h
+
+
+class GRU(Cell):
+    """GRU cell (ref: nn/GRU.scala). Gate order: r, z, n."""
+
+    def __init__(self, input_size: int, hidden_size: int,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.input_size, self.hidden_size = input_size, hidden_size
+        k = RNG.next_key
+        self.add_param("w_ih", init_param(
+            Xavier(), k(), (3 * hidden_size, input_size),
+            fan_in=input_size, fan_out=hidden_size))
+        self.add_param("w_hh", init_param(
+            Xavier(), k(), (3 * hidden_size, hidden_size),
+            fan_in=hidden_size, fan_out=hidden_size))
+        self.add_param("bias_ih", jnp.zeros((3 * hidden_size,)))
+        self.add_param("bias_hh", jnp.zeros((3 * hidden_size,)))
+
+    def init_carry(self, batch, dtype=jnp.float32):
+        return jnp.zeros((batch, self.hidden_size), dtype)
+
+    def step(self, params, carry, x_t):
+        h = carry
+        gi = x_t @ params["w_ih"].T + params["bias_ih"]
+        gh = h @ params["w_hh"].T + params["bias_hh"]
+        ir, iz, in_ = jnp.split(gi, 3, axis=-1)
+        hr, hz, hn = jnp.split(gh, 3, axis=-1)
+        r = jax.nn.sigmoid(ir + hr)
+        z = jax.nn.sigmoid(iz + hz)
+        n = jnp.tanh(in_ + r * hn)
+        return (1 - z) * n + z * h, (1 - z) * n + z * h
+
+
+class Recurrent(TensorModule):
+    """Scan a cell over time (ref: nn/Recurrent.scala container).
+
+    Input (B, T, C) → output (B, T, H) (all timesteps, matching the
+    reference's Recurrent; use :class:`Select` -1 for last step).
+    """
+
+    def __init__(self, cell: Optional[Cell] = None,
+                 return_sequences: bool = True, reverse: bool = False,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.return_sequences = return_sequences
+        self.reverse = reverse
+        if cell is not None:
+            self.add(cell)
+
+    def add(self, cell: Cell):
+        self._modules["cell"] = cell
+        return self
+
+    def _apply(self, params, states, x, *, training, rng):
+        cell: Cell = self._modules["cell"]
+        cp = params.get("cell", {})
+        carry0 = cell.init_carry(x.shape[0], x.dtype)
+        xs = jnp.swapaxes(x, 0, 1)  # (T, B, C)
+        if self.reverse:
+            xs = xs[::-1]
+
+        def body(carry, x_t):
+            return cell.step(cp, carry, x_t)
+
+        carry, ys = lax.scan(body, carry0, xs)
+        # last full-context output = last scan step, BEFORE any re-reversal
+        last = ys[-1]
+        if self.reverse:
+            ys = ys[::-1]
+        if self.return_sequences:
+            return jnp.swapaxes(ys, 0, 1)
+        return last
+
+
+class BiRecurrent(TensorModule):
+    """Bidirectional recurrent with merge (ref: nn/BiRecurrent.scala)."""
+
+    def __init__(self, cell_fwd: Cell, cell_bwd: Cell, merge: str = "concat",
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.fwd = Recurrent(cell_fwd)
+        self.bwd = Recurrent(cell_bwd, reverse=True)
+        self.merge = merge
+
+    def _apply(self, params, states, x, *, training, rng):
+        yf, _ = self.sub_apply("fwd", params, states, x,
+                               training=training, rng=rng)
+        yb, _ = self.sub_apply("bwd", params, states, x,
+                               training=training, rng=rng)
+        if self.merge == "concat":
+            return jnp.concatenate([yf, yb], axis=-1)
+        if self.merge == "sum":
+            return yf + yb
+        raise ValueError(f"unknown merge {self.merge}")

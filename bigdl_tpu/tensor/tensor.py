@@ -1,0 +1,373 @@
+"""Tensor — BigDL-style tensor facade over ``jax.Array``.
+
+Reference: scala/dllib/.../tensor/DenseTensor.scala (+DenseTensorMath,
+TensorNumericMath). The reference is a mutable, strided, storage-backed
+Torch tensor whose math routes to MKL JNI. On TPU the compute path is
+``jax.numpy`` under jit — so this facade exists for **API parity** (model
+zoo code, tests, user code written against BigDL's Tensor), while the hot
+path (nn layers, optimizers) operates on raw ``jax.Array`` pytrees.
+
+Mutability: "in-place" methods (``add_``-style: here BigDL names like
+``add``, ``fill``, ``copy``) rebind the underlying immutable ``jax.Array``
+and return ``self``. This preserves reference semantics at the API layer
+without fighting XLA's functional model (SURVEY.md §7.3 "Mutable Tensor
+semantics vs functional jax").
+
+Dtype dispatch (the reference's ``TensorNumeric[T]`` typeclass) degenerates
+to the jnp dtype carried by the underlying array.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+ArrayLike = Union[np.ndarray, "jnp.ndarray", "Tensor", float, int, list, tuple]
+
+
+def _unwrap(x):
+    return x.data if isinstance(x, Tensor) else x
+
+
+class Tensor:
+    __slots__ = ("data",)
+    __array_priority__ = 100
+
+    def __init__(self, *args, dtype=jnp.float32):
+        if len(args) == 0:
+            self.data = jnp.zeros((), dtype=dtype)
+        elif len(args) == 1 and isinstance(args[0], (np.ndarray, jnp.ndarray, jax.Array)):
+            self.data = jnp.asarray(args[0])
+        elif len(args) == 1 and isinstance(args[0], Tensor):
+            self.data = args[0].data
+        elif len(args) == 1 and isinstance(args[0], (list, tuple)):
+            self.data = jnp.asarray(np.asarray(args[0], dtype=dtype))
+        else:
+            # Tensor(d1, d2, ...) — zero-filled with the given size
+            self.data = jnp.zeros(tuple(int(a) for a in args), dtype=dtype)
+
+    # -- shape queries ------------------------------------------------------
+    def size(self, dim: Optional[int] = None):
+        if dim is None:
+            return tuple(self.data.shape)
+        return self.data.shape[dim - 1]  # 1-based like the reference
+
+    @property
+    def shape(self):
+        return tuple(self.data.shape)
+
+    def dim(self) -> int:
+        return self.data.ndim
+
+    def n_element(self) -> int:
+        return int(np.prod(self.data.shape)) if self.data.ndim else 1
+
+    nElement = n_element
+
+    def dtype(self):
+        return self.data.dtype
+
+    # -- creation helpers ---------------------------------------------------
+    @staticmethod
+    def zeros(*shape, dtype=jnp.float32):
+        return Tensor(jnp.zeros(shape, dtype=dtype))
+
+    @staticmethod
+    def ones(*shape, dtype=jnp.float32):
+        return Tensor(jnp.ones(shape, dtype=dtype))
+
+    @staticmethod
+    def randn(*shape, seed: int = 0, dtype=jnp.float32):
+        key = jax.random.PRNGKey(seed)
+        return Tensor(jax.random.normal(key, shape, dtype=dtype))
+
+    @staticmethod
+    def rand(*shape, seed: int = 0, dtype=jnp.float32):
+        key = jax.random.PRNGKey(seed)
+        return Tensor(jax.random.uniform(key, shape, dtype=dtype))
+
+    @staticmethod
+    def arange(start, stop=None, step=1, dtype=jnp.float32):
+        if stop is None:
+            start, stop = 1, start + 1  # Tensor.range semantics (1..n inclusive)
+        return Tensor(jnp.arange(start, stop, step, dtype=dtype))
+
+    # -- mutation-style ops (rebind + return self) --------------------------
+    def fill(self, value):
+        self.data = jnp.full_like(self.data, value)
+        return self
+
+    def zero(self):
+        return self.fill(0)
+
+    def copy(self, other: "Tensor"):
+        self.data = jnp.broadcast_to(_unwrap(other), self.data.shape).astype(self.data.dtype)
+        return self
+
+    def set(self, other: Optional["Tensor"] = None):
+        self.data = jnp.zeros((), self.data.dtype) if other is None else _unwrap(other)
+        return self
+
+    def resize(self, *sizes):
+        sizes = tuple(int(s) for s in sizes)
+        n_new = int(np.prod(sizes))
+        flat = self.data.reshape(-1)
+        if flat.size < n_new:
+            flat = jnp.concatenate([flat, jnp.zeros(n_new - flat.size, flat.dtype)])
+        self.data = flat[:n_new].reshape(sizes)
+        return self
+
+    resize_as = lambda self, other: self.resize(*_unwrap(other).shape)
+
+    def apply_(self, fn):
+        self.data = fn(self.data)
+        return self
+
+    def add(self, *args):
+        """add(value) | add(other) | add(alpha, other) — in-place like reference."""
+        if len(args) == 1:
+            self.data = self.data + _unwrap(args[0])
+        else:
+            alpha, other = args
+            self.data = self.data + alpha * _unwrap(other)
+        return self
+
+    def sub(self, *args):
+        if len(args) == 1:
+            self.data = self.data - _unwrap(args[0])
+        else:
+            alpha, other = args
+            self.data = self.data - alpha * _unwrap(other)
+        return self
+
+    def mul(self, value):
+        self.data = self.data * _unwrap(value)
+        return self
+
+    def cmul(self, other):
+        self.data = self.data * _unwrap(other)
+        return self
+
+    def cdiv(self, other):
+        self.data = self.data / _unwrap(other)
+        return self
+
+    def div(self, value):
+        self.data = self.data / _unwrap(value)
+        return self
+
+    def pow(self, n):
+        self.data = self.data ** n
+        return self
+
+    def sqrt(self):
+        self.data = jnp.sqrt(self.data)
+        return self
+
+    def exp(self):
+        self.data = jnp.exp(self.data)
+        return self
+
+    def log(self):
+        self.data = jnp.log(self.data)
+        return self
+
+    def abs(self):
+        self.data = jnp.abs(self.data)
+        return self
+
+    def clamp(self, min_v, max_v):
+        self.data = jnp.clip(self.data, min_v, max_v)
+        return self
+
+    def addcmul(self, value, t1, t2):
+        self.data = self.data + value * _unwrap(t1) * _unwrap(t2)
+        return self
+
+    def addcdiv(self, value, t1, t2):
+        self.data = self.data + value * _unwrap(t1) / _unwrap(t2)
+        return self
+
+    def addmm(self, *args):
+        """addmm([beta], [alpha,] mat1, mat2) — self = beta*self + alpha*mat1@mat2."""
+        beta, alpha = 1.0, 1.0
+        if len(args) == 2:
+            m1, m2 = args
+        elif len(args) == 3:
+            beta, m1, m2 = args
+        else:
+            beta, alpha, m1, m2 = args
+        self.data = beta * self.data + alpha * (_unwrap(m1) @ _unwrap(m2))
+        return self
+
+    def addmv(self, *args):
+        beta, alpha = 1.0, 1.0
+        if len(args) == 2:
+            m, v = args
+        elif len(args) == 3:
+            beta, m, v = args
+        else:
+            beta, alpha, m, v = args
+        self.data = beta * self.data + alpha * (_unwrap(m) @ _unwrap(v))
+        return self
+
+    def addr(self, alpha, v1, v2):
+        self.data = self.data + alpha * jnp.outer(_unwrap(v1), _unwrap(v2))
+        return self
+
+    # -- functional (return new Tensor) -------------------------------------
+    def clone(self) -> "Tensor":
+        return Tensor(self.data)
+
+    def contiguous(self) -> "Tensor":
+        return self
+
+    def view(self, *sizes) -> "Tensor":
+        if len(sizes) == 1 and isinstance(sizes[0], (tuple, list)):
+            sizes = tuple(sizes[0])
+        return Tensor(self.data.reshape(sizes))
+
+    reshape = view
+
+    def t(self) -> "Tensor":
+        return Tensor(self.data.T)
+
+    def transpose(self, dim1: int, dim2: int) -> "Tensor":
+        return Tensor(jnp.swapaxes(self.data, dim1 - 1, dim2 - 1))
+
+    def narrow(self, dim: int, index: int, size: int) -> "Tensor":
+        """1-based dim & index, like the reference."""
+        sl = [slice(None)] * self.data.ndim
+        sl[dim - 1] = slice(index - 1, index - 1 + size)
+        return Tensor(self.data[tuple(sl)])
+
+    def select(self, dim: int, index: int) -> "Tensor":
+        return Tensor(jnp.take(self.data, index - 1, axis=dim - 1))
+
+    def squeeze(self, dim: Optional[int] = None) -> "Tensor":
+        if dim is None:
+            return Tensor(jnp.squeeze(self.data))
+        if self.data.shape[dim - 1] != 1:
+            return Tensor(self.data)  # new facade, never alias self
+        return Tensor(jnp.squeeze(self.data, axis=dim - 1))
+
+    def unsqueeze(self, dim: int) -> "Tensor":
+        return Tensor(jnp.expand_dims(self.data, dim - 1))
+
+    def index_select(self, dim: int, indices) -> "Tensor":
+        idx = jnp.asarray(_unwrap(indices)).astype(jnp.int32) - 1
+        return Tensor(jnp.take(self.data, idx, axis=dim - 1))
+
+    def mm(self, other) -> "Tensor":
+        return Tensor(self.data @ _unwrap(other))
+
+    def mv(self, other) -> "Tensor":
+        return Tensor(self.data @ _unwrap(other))
+
+    def dot(self, other) -> float:
+        return float(jnp.vdot(self.data, _unwrap(other)))
+
+    def sum(self, dim: Optional[int] = None):
+        if dim is None:
+            return float(jnp.sum(self.data))
+        return Tensor(jnp.sum(self.data, axis=dim - 1, keepdims=True))
+
+    def mean(self, dim: Optional[int] = None):
+        if dim is None:
+            return float(jnp.mean(self.data))
+        return Tensor(jnp.mean(self.data, axis=dim - 1, keepdims=True))
+
+    def max(self, dim: Optional[int] = None):
+        if dim is None:
+            return float(jnp.max(self.data))
+        values = jnp.max(self.data, axis=dim - 1, keepdims=True)
+        indices = jnp.argmax(self.data, axis=dim - 1, keepdims=True) + 1
+        return Tensor(values), Tensor(indices.astype(jnp.float32))
+
+    def min(self, dim: Optional[int] = None):
+        if dim is None:
+            return float(jnp.min(self.data))
+        values = jnp.min(self.data, axis=dim - 1, keepdims=True)
+        indices = jnp.argmin(self.data, axis=dim - 1, keepdims=True) + 1
+        return Tensor(values), Tensor(indices.astype(jnp.float32))
+
+    def norm(self, p: int = 2) -> float:
+        return float(jnp.sum(jnp.abs(self.data) ** p) ** (1.0 / p))
+
+    def almost_equal(self, other, tolerance: float = 1e-5) -> bool:
+        return bool(jnp.allclose(self.data, _unwrap(other), atol=tolerance))
+
+    def to_numpy(self) -> np.ndarray:
+        return np.asarray(self.data)
+
+    def astype(self, dtype) -> "Tensor":
+        return Tensor(self.data.astype(dtype))
+
+    # -- operators ----------------------------------------------------------
+    def __add__(self, other):
+        return Tensor(self.data + _unwrap(other))
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        return Tensor(self.data - _unwrap(other))
+
+    def __rsub__(self, other):
+        return Tensor(_unwrap(other) - self.data)
+
+    def __mul__(self, other):
+        return Tensor(self.data * _unwrap(other))
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        return Tensor(self.data / _unwrap(other))
+
+    def __neg__(self):
+        return Tensor(-self.data)
+
+    def __matmul__(self, other):
+        return Tensor(self.data @ _unwrap(other))
+
+    def __getitem__(self, item):
+        return Tensor(self.data[item])
+
+    def __setitem__(self, item, value):
+        self.data = self.data.at[item].set(_unwrap(value))
+
+    def __repr__(self):
+        return f"Tensor({np.asarray(self.data)!r})"
+
+    def __float__(self):
+        return float(self.data)
+
+
+class SparseTensor:
+    """COO sparse tensor (ref: .../tensor/SparseTensor.scala).
+
+    Stores (indices, values, shape); ``to_dense`` scatters into a dense
+    jnp array. Used by LookupTableSparse-style layers; on TPU sparse
+    gathers compile to efficient dynamic-slice/gather HLO.
+    """
+
+    def __init__(self, indices, values, shape):
+        self.indices = jnp.asarray(indices, dtype=jnp.int32)  # (ndim, nnz), 0-based
+        self.values = jnp.asarray(values)
+        self.shape = tuple(int(s) for s in shape)
+
+    def to_dense(self) -> Tensor:
+        dense = jnp.zeros(self.shape, dtype=self.values.dtype)
+        dense = dense.at[tuple(self.indices)].add(self.values)
+        return Tensor(dense)
+
+    def n_element(self) -> int:
+        return int(self.values.shape[0])
+
+    @staticmethod
+    def from_dense(t: Tensor) -> "SparseTensor":
+        arr = np.asarray(_unwrap(t))
+        idx = np.nonzero(arr)
+        return SparseTensor(np.stack(idx), arr[idx], arr.shape)

@@ -1,0 +1,4 @@
+from bigdl_tpu.utils.engine import Engine, init_engine, get_mesh
+from bigdl_tpu.utils.table import Table, T
+
+__all__ = ["Engine", "init_engine", "get_mesh", "Table", "T"]

@@ -1,0 +1,167 @@
+"""Runtime bootstrap — the TPU-native equivalent of BigDL's ``Engine``.
+
+Reference: scala/dllib/.../utils/Engine.scala — detects node/core counts from
+the Spark conf, selects an engine type (MklBlas | MklDnn) and owns thread
+pools. Here the "cluster" is a JAX device mesh: ``Engine.init`` initialises
+jax.distributed (multi-host, when applicable), discovers local/global devices,
+and builds the default :class:`jax.sharding.Mesh` that the rest of the
+framework (DistriOptimizer, Keras fit, Orca Estimator) trains over.
+
+Engine types:
+- ``"tpu"``  — compile to the TPU backend (the whole point).
+- ``"cpu"``  — host CPU backend; with ``XLA_FLAGS=--xla_force_host_platform_
+  device_count=N`` this gives an N-device virtual mesh, the moral equivalent
+  of the reference's ``local[N]`` Spark mode used by its distributed tests
+  (SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import math
+import os
+import threading
+from typing import Optional, Sequence
+
+import numpy as np
+
+logger = logging.getLogger("bigdl_tpu")
+
+
+@dataclasses.dataclass
+class EngineConfig:
+    engine_type: str = "tpu"          # "tpu" | "cpu" | "gpu"
+    node_number: int = 1              # number of host processes
+    core_number: int = 1              # devices per host (was: cores per executor)
+    mesh_axes: tuple = ("data",)      # default mesh axis names
+    mesh_shape: Optional[tuple] = None
+    coordinator_address: Optional[str] = None
+    process_id: int = 0
+
+
+class Engine:
+    """Global runtime singleton (ref: Engine.scala object Engine)."""
+
+    _lock = threading.RLock()
+    _initialized = False
+    _config: EngineConfig = EngineConfig()
+    _mesh = None
+
+    # Axis-name conventions used across the framework. BigDL only has data
+    # parallelism (SURVEY.md §2.5); tensor/sequence/expert/pipeline axes are
+    # the idiomatic TPU extensions used by bigdl_tpu.llm / parallel.
+    DATA_AXIS = "data"
+    MODEL_AXIS = "model"
+    SEQ_AXIS = "seq"
+    EXPERT_AXIS = "expert"
+    PIPELINE_AXIS = "pipe"
+
+    @classmethod
+    def init(
+        cls,
+        engine_type: Optional[str] = None,
+        mesh_shape: Optional[Sequence[int]] = None,
+        mesh_axes: Optional[Sequence[str]] = None,
+        coordinator_address: Optional[str] = None,
+        num_processes: Optional[int] = None,
+        process_id: Optional[int] = None,
+    ):
+        """Initialise the runtime and build the default device mesh.
+
+        Multi-host: pass ``coordinator_address``/``num_processes``/
+        ``process_id`` (or set JAX_COORDINATOR_ADDRESS etc.) and every host
+        calls ``Engine.init`` — the analog of each Spark executor joining the
+        BlockManager cluster in the reference's ``Engine.init``.
+        """
+        import jax
+
+        with cls._lock:
+            if coordinator_address or os.environ.get("JAX_COORDINATOR_ADDRESS"):
+                try:
+                    jax.distributed.initialize(
+                        coordinator_address=coordinator_address,
+                        num_processes=num_processes,
+                        process_id=process_id,
+                    )
+                except RuntimeError as e:  # already initialized
+                    logger.debug("jax.distributed.initialize skipped: %s", e)
+
+            backend = engine_type or os.environ.get(
+                "BIGDL_ENGINE_TYPE", jax.default_backend()
+            )
+            devices = jax.devices()
+            local = jax.local_devices()
+            axes = tuple(mesh_axes) if mesh_axes else ("data",)
+            shape = tuple(mesh_shape) if mesh_shape else None
+            if shape is None:
+                shape = cls._default_shape(len(devices), axes)
+            if math.prod(shape) != len(devices):
+                raise ValueError(
+                    f"mesh_shape {shape} does not cover {len(devices)} devices"
+                )
+
+            from jax.sharding import Mesh
+
+            dev_array = np.asarray(devices).reshape(shape)
+            cls._mesh = Mesh(dev_array, axes)
+            cls._config = EngineConfig(
+                engine_type=backend,
+                node_number=jax.process_count(),
+                core_number=len(local),
+                mesh_axes=axes,
+                mesh_shape=shape,
+                coordinator_address=coordinator_address,
+                process_id=jax.process_index(),
+            )
+            cls._initialized = True
+            logger.info(
+                "Engine initialized: backend=%s devices=%d hosts=%d mesh=%s%s",
+                backend, len(devices), cls._config.node_number, axes, shape,
+            )
+            return cls._mesh
+
+    @staticmethod
+    def _default_shape(n_devices: int, axes: Sequence[str]) -> tuple:
+        if len(axes) == 1:
+            return (n_devices,)
+        # put everything on the first axis by default
+        return (n_devices,) + (1,) * (len(axes) - 1)
+
+    @classmethod
+    def mesh(cls):
+        if not cls._initialized:
+            cls.init()
+        return cls._mesh
+
+    @classmethod
+    def config(cls) -> EngineConfig:
+        return cls._config
+
+    @classmethod
+    def node_number(cls) -> int:
+        return cls._config.node_number
+
+    @classmethod
+    def core_number(cls) -> int:
+        return cls._config.core_number
+
+    @classmethod
+    def is_initialized(cls) -> bool:
+        return cls._initialized
+
+    @classmethod
+    def reset(cls):
+        with cls._lock:
+            cls._initialized = False
+            cls._mesh = None
+            cls._config = EngineConfig()
+
+
+def init_engine(**kwargs):
+    """Python-API parity shim (ref: python dllib utils/engine.py init_engine)."""
+    return Engine.init(**kwargs)
+
+
+def get_mesh():
+    return Engine.mesh()

@@ -1,0 +1,90 @@
+"""Table — heterogeneous activity container (ref: .../utils/Table.scala, T()).
+
+BigDL models whose layers take/produce multiple tensors pass a ``Table``
+(torch's ``table``): 1-based integer keys by default, arbitrary keys allowed.
+Here it is a thin ordered mapping that is also a JAX pytree, so Tables can
+flow through jit/grad unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator
+
+import jax
+
+
+class Table:
+    def __init__(self, *args, **kwargs):
+        self._state: Dict[Any, Any] = {}
+        for i, v in enumerate(args):
+            self._state[i + 1] = v  # 1-based, matching the reference
+        self._state.update(kwargs)
+
+    # -- mapping protocol ---------------------------------------------------
+    def __getitem__(self, key):
+        return self._state[key]
+
+    def __setitem__(self, key, value):
+        self._state[key] = value
+
+    def __contains__(self, key):
+        return key in self._state
+
+    def __len__(self):
+        return len(self._state)
+
+    def __iter__(self) -> Iterator:
+        return iter(self._state.values())
+
+    def keys(self):
+        return self._state.keys()
+
+    def values(self):
+        return self._state.values()
+
+    def items(self):
+        return self._state.items()
+
+    def get(self, key, default=None):
+        return self._state.get(key, default)
+
+    def insert(self, value):
+        self._state[len(self._state) + 1] = value
+        return self
+
+    def to_list(self):
+        return [self._state[k] for k in _sorted_keys(self._state)]
+
+    def __repr__(self):
+        inner = ", ".join(f"{k}: {v!r}" for k, v in self._state.items())
+        return f"Table({{{inner}}})"
+
+    def __eq__(self, other):
+        return isinstance(other, Table) and self._state == other._state
+
+
+def T(*args, **kwargs) -> Table:
+    """Constructor sugar matching the reference's ``T()``."""
+    return Table(*args, **kwargs)
+
+
+def _sorted_keys(state):
+    """Numeric keys first in numeric order, then others lexicographically —
+    keeps Tables with ≥10 positional entries in insertion order."""
+    return sorted(state.keys(),
+                  key=lambda k: (0, k, "") if isinstance(k, int)
+                  else (1, 0, str(k)))
+
+
+def _table_flatten(t: Table):
+    keys = _sorted_keys(t._state)
+    return [t._state[k] for k in keys], tuple(keys)
+
+
+def _table_unflatten(keys, children):
+    t = Table()
+    t._state = dict(zip(keys, children))
+    return t
+
+
+jax.tree_util.register_pytree_node(Table, _table_flatten, _table_unflatten)

@@ -1,0 +1,38 @@
+"""Test configuration: run everything on a virtual 8-device CPU mesh.
+
+This is the TPU rebuild's analog of the reference's ``local[N]`` Spark test
+pattern (SURVEY.md §4 "Distributed tests without a cluster"): XLA's host
+platform is forced to expose 8 CPU devices, so mesh/pjit/collective logic is
+exercised faithfully without TPU hardware.
+
+Note: this image's sitecustomize registers an ``axon`` TPU plugin and pins
+``jax_platforms`` before we run, so the env-var route (JAX_PLATFORMS=cpu) is
+ineffective — ``jax.config.update`` after import is the override that works.
+XLA_FLAGS must still be set before the first backend initialisation.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture()
+def rng():
+    return np.random.RandomState(42)
