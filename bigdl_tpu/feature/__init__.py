@@ -1,0 +1,11 @@
+from bigdl_tpu.feature.dataset import (
+    DataSet, DistributedDataSet, LocalDataSet, MiniBatch, Sample,
+    SampleToMiniBatch)
+from bigdl_tpu.feature.transformers import (
+    ChainedTransformer, Normalizer, OneHot, Transformer)
+
+__all__ = [
+    "DataSet", "DistributedDataSet", "LocalDataSet", "MiniBatch", "Sample",
+    "SampleToMiniBatch", "Transformer", "ChainedTransformer", "Normalizer",
+    "OneHot",
+]

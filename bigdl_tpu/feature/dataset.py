@@ -1,0 +1,201 @@
+"""Data layer (ref: .../feature/dataset/DataSet.scala, Sample.scala,
+MiniBatch.scala, SampleToMiniBatch).
+
+The reference's DistributedDataSet is an RDD cached per Spark partition;
+the TPU-native analog shards each global batch across the mesh's data axis
+(device_put with a NamedSharding happens in the optimizer — the DataSet
+only needs to yield steady, shuffled host batches; per-host sharding for
+multi-controller jax is a slice of the sample index space, the moral
+equivalent of partition locality).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Sequence, Union
+
+import numpy as np
+
+
+class Sample:
+    """(features, label) record (ref: Sample.scala / TensorSample)."""
+
+    __slots__ = ("features", "labels")
+
+    def __init__(self, features, labels=None):
+        self.features = [np.asarray(f) for f in (
+            features if isinstance(features, (list, tuple)) else [features])]
+        if labels is None:
+            self.labels = []
+        else:
+            self.labels = [np.asarray(l) for l in (
+                labels if isinstance(labels, (list, tuple)) else [labels])]
+
+    @staticmethod
+    def from_ndarray(features, labels=None) -> "Sample":
+        return Sample(features, labels)
+
+    def feature(self, i: int = 0):
+        return self.features[i]
+
+    def label(self, i: int = 0):
+        return self.labels[i] if self.labels else None
+
+
+class MiniBatch:
+    """Batched (input, target) pair (ref: MiniBatch.scala)."""
+
+    __slots__ = ("input", "target")
+
+    def __init__(self, input, target=None):
+        self.input = input
+        self.target = target
+
+    def size(self) -> int:
+        arr = self.input[0] if isinstance(self.input, (list, tuple)) \
+            else self.input
+        return arr.shape[0]
+
+    def get_input(self):
+        return self.input
+
+    def get_target(self):
+        return self.target
+
+
+def _stack_samples(samples: Sequence[Sample], pad: bool = False) -> MiniBatch:
+    n_feat = len(samples[0].features)
+    n_lab = len(samples[0].labels)
+
+    def stack(arrs: List[np.ndarray]) -> np.ndarray:
+        if pad:
+            # pad to the max shape in the batch (ref: PaddingParam)
+            max_shape = np.max([a.shape for a in arrs], axis=0)
+            out = np.zeros((len(arrs),) + tuple(max_shape), arrs[0].dtype)
+            for i, a in enumerate(arrs):
+                sl = (i,) + tuple(slice(0, s) for s in a.shape)
+                out[sl] = a
+            return out
+        return np.stack(arrs)
+
+    feats = [stack([s.features[i] for s in samples]) for i in range(n_feat)]
+    labs = [stack([s.labels[i] for s in samples]) for i in range(n_lab)]
+    inp = feats[0] if n_feat == 1 else feats
+    tgt = (labs[0] if n_lab == 1 else labs) if n_lab else None
+    return MiniBatch(inp, tgt)
+
+
+class AbstractDataSet:
+    def size(self) -> int:
+        raise NotImplementedError
+
+    def shuffle(self):
+        return self
+
+    def data(self, train: bool = True) -> Iterator:
+        raise NotImplementedError
+
+    def transform(self, transformer) -> "AbstractDataSet":
+        return _TransformedDataSet(self, transformer)
+
+    # sugar matching the reference's `dataset -> transformer` composition
+    def __rshift__(self, transformer):
+        return self.transform(transformer)
+
+
+class LocalDataSet(AbstractDataSet):
+    """In-memory dataset of Samples or raw arrays (ref: LocalArrayDataSet)."""
+
+    def __init__(self, x, y: Optional[np.ndarray] = None, shuffle: bool = True,
+                 seed: int = 0):
+        if isinstance(x, (list, tuple)) and x and isinstance(x[0], Sample):
+            self.samples = list(x)
+            self._array_mode = False
+        else:
+            self.x = np.asarray(x)
+            self.y = None if y is None else np.asarray(y)
+            self._array_mode = True
+        self._shuffle = shuffle
+        self._rng = np.random.RandomState(seed)
+
+    def size(self) -> int:
+        return len(self.samples) if not self._array_mode else self.x.shape[0]
+
+    def data(self, train: bool = True):
+        n = self.size()
+        order = np.arange(n)
+        if train and self._shuffle:
+            self._rng.shuffle(order)
+        if self._array_mode:
+            for i in order:
+                yield Sample(self.x[i],
+                             None if self.y is None else self.y[i])
+        else:
+            for i in order:
+                yield self.samples[i]
+
+
+class DistributedDataSet(LocalDataSet):
+    """Host-sharded dataset for multi-controller jax (ref:
+    CachedDistriDataSet). Each host sees samples [rank::world]; the global
+    batch assembled per step is the union, matching the per-partition
+    caching of the reference."""
+
+    def __init__(self, x, y=None, shuffle: bool = True, seed: int = 0,
+                 rank: Optional[int] = None, world: Optional[int] = None):
+        super().__init__(x, y, shuffle, seed)
+        if rank is None or world is None:
+            import jax
+            rank = jax.process_index()
+            world = jax.process_count()
+        self.rank, self.world = rank, world
+
+    def data(self, train: bool = True):
+        for i, s in enumerate(super().data(train)):
+            if i % self.world == self.rank:
+                yield s
+
+
+class _TransformedDataSet(AbstractDataSet):
+    def __init__(self, parent: AbstractDataSet, transformer):
+        self.parent = parent
+        self.transformer = transformer
+
+    def size(self):
+        return self.parent.size()
+
+    def data(self, train: bool = True):
+        return self.transformer(self.parent.data(train))
+
+
+class SampleToMiniBatch:
+    """Transformer: iterator[Sample] → iterator[MiniBatch]
+    (ref: SampleToMiniBatch.scala)."""
+
+    def __init__(self, batch_size: int, drop_remainder: bool = True,
+                 pad: bool = False):
+        self.batch_size = batch_size
+        self.drop_remainder = drop_remainder
+        self.pad = pad
+
+    def __call__(self, it: Iterator[Sample]) -> Iterator[MiniBatch]:
+        buf: List[Sample] = []
+        for s in it:
+            buf.append(s)
+            if len(buf) == self.batch_size:
+                yield _stack_samples(buf, self.pad)
+                buf = []
+        if buf and not self.drop_remainder:
+            yield _stack_samples(buf, self.pad)
+
+
+class DataSet:
+    """Factory facade (ref: DataSet object)."""
+
+    @staticmethod
+    def array(x, y=None, shuffle: bool = True, seed: int = 0) -> LocalDataSet:
+        return LocalDataSet(x, y, shuffle, seed)
+
+    @staticmethod
+    def distributed(x, y=None, shuffle: bool = True, seed: int = 0,
+                    rank=None, world=None) -> DistributedDataSet:
+        return DistributedDataSet(x, y, shuffle, seed, rank, world)

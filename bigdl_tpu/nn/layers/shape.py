@@ -30,19 +30,13 @@ class Reshape(TensorModule):
         return x.reshape(self.size)
 
 
-class InferReshape(TensorModule):
-    """Reshape with -1 inference (ref: nn/InferReshape.scala)."""
+class InferReshape(Reshape):
+    """Reshape with -1 inference (ref: nn/InferReshape.scala). Same jnp
+    reshape mechanics as Reshape; only the batch_mode default differs."""
 
     def __init__(self, size: Sequence[int], batch_mode: bool = False,
                  name: Optional[str] = None):
-        super().__init__(name)
-        self.size = tuple(int(s) for s in size)
-        self.batch_mode = batch_mode
-
-    def _apply(self, params, states, x, *, training, rng):
-        if self.batch_mode:
-            return x.reshape((x.shape[0],) + self.size)
-        return x.reshape(self.size)
+        super().__init__(size, batch_mode, name)
 
 
 class View(TensorModule):

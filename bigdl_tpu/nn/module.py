@@ -295,6 +295,8 @@ class Module:
         state["_grads"] = None
         state["output"] = None
         state["grad_input"] = None
+        state.pop("_jit_fwd", None)   # compiled-function cache is not picklable
+        state.pop("_last_rng", None)
         return state
 
     def __setstate__(self, state):

@@ -1,0 +1,13 @@
+from bigdl_tpu.optim.optim_method import (
+    Adadelta, Adagrad, Adam, Adamax, AdamWeightDecay, Default, Exponential,
+    Ftrl, LearningRateSchedule, MultiStep, OptimMethod, ParallelAdam,
+    Plateau, Poly, RMSprop, SequentialSchedule, SGD, Step, Warmup)
+from bigdl_tpu.optim.optimizer import (
+    BaseOptimizer, DistriOptimizer, Evaluator, LocalOptimizer, Optimizer,
+    Predictor, validate)
+from bigdl_tpu.optim.trigger import Trigger
+from bigdl_tpu.optim.validation import (
+    HitRatio, Loss, MAE, NDCG, Top1Accuracy, Top5Accuracy, ValidationMethod,
+    ValidationResult)
+from bigdl_tpu.optim.summary import TrainSummary, ValidationSummary
+from bigdl_tpu.optim.metrics import Metrics

@@ -1,0 +1,450 @@
+"""OptimMethods — per-parameter update rules (ref: .../optim/SGD.scala,
+Adam.scala, AdamWeightDecay.scala, Adagrad.scala, RMSprop.scala, Ftrl.scala,
+ParallelAdam.scala) and learning-rate schedules (ref: SGD.scala's
+LearningRateSchedule hierarchy: Default, Step, MultiStep, Exponential,
+Poly, Plateau, Warmup, SequentialSchedule).
+
+Design: each OptimMethod exposes a **pure, jittable** pair
+``init_state(params)`` / ``step(params, grads, state, lr)``; the learning
+rate is computed host-side per iteration from the schedule (so schedules —
+including validation-driven Plateau — stay arbitrary python without
+retracing) and enters the compiled step as a traced scalar. In the
+reference, the method runs on each AllReduceParameter slice owner; here it
+runs inside the SPMD step on every chip over replicated params.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+tree_map = jax.tree_util.tree_map
+
+
+# ---------------------------------------------------------------------------
+# Learning-rate schedules (host-side)
+# ---------------------------------------------------------------------------
+
+class LearningRateSchedule:
+    def lr(self, base_lr: float, state: Dict[str, Any]) -> float:
+        raise NotImplementedError
+
+
+class Default(LearningRateSchedule):
+    """ref: SGD.Default — lr / (1 + n*decay)."""
+
+    def lr(self, base_lr, state):
+        n = state["eval_counter"]
+        decay = state.get("learning_rate_decay", 0.0)
+        return base_lr / (1 + n * decay)
+
+
+class Step(LearningRateSchedule):
+    def __init__(self, step_size: int, gamma: float):
+        self.step_size, self.gamma = step_size, gamma
+
+    def lr(self, base_lr, state):
+        return base_lr * self.gamma ** (state["eval_counter"] // self.step_size)
+
+
+class MultiStep(LearningRateSchedule):
+    def __init__(self, step_sizes, gamma: float):
+        self.step_sizes, self.gamma = list(step_sizes), gamma
+
+    def lr(self, base_lr, state):
+        n = state["eval_counter"]
+        k = sum(1 for s in self.step_sizes if n >= s)
+        return base_lr * self.gamma ** k
+
+
+class Exponential(LearningRateSchedule):
+    def __init__(self, decay_step: int, decay_rate: float,
+                 stair_case: bool = False):
+        self.decay_step, self.decay_rate = decay_step, decay_rate
+        self.stair_case = stair_case
+
+    def lr(self, base_lr, state):
+        n = state["eval_counter"] / self.decay_step
+        if self.stair_case:
+            n = math.floor(n)
+        return base_lr * self.decay_rate ** n
+
+
+class Poly(LearningRateSchedule):
+    def __init__(self, power: float, max_iteration: int):
+        self.power, self.max_iteration = power, max_iteration
+
+    def lr(self, base_lr, state):
+        n = min(state["eval_counter"], self.max_iteration)
+        return base_lr * (1 - n / self.max_iteration) ** self.power
+
+
+class Warmup(LearningRateSchedule):
+    """Linear warmup by delta per iteration (ref: SGD.Warmup)."""
+
+    def __init__(self, delta: float):
+        self.delta = delta
+
+    def lr(self, base_lr, state):
+        return base_lr + self.delta * state["eval_counter"]
+
+
+class Plateau(LearningRateSchedule):
+    """Reduce on validation-score plateau (ref: SGD.Plateau). The Optimizer
+    feeds scores via ``record_score``."""
+
+    def __init__(self, monitor: str = "score", factor: float = 0.1,
+                 patience: int = 10, mode: str = "min", epsilon: float = 1e-4,
+                 cooldown: int = 0, min_lr: float = 0.0):
+        self.factor, self.patience = factor, patience
+        self.mode, self.epsilon = mode, epsilon
+        self.cooldown, self.min_lr = cooldown, min_lr
+        self._best: Optional[float] = None
+        self._wait = 0
+        self._cool = 0
+        self._scale = 1.0
+
+    def record_score(self, score: float):
+        better = (self._best is None
+                  or (self.mode == "min" and score < self._best - self.epsilon)
+                  or (self.mode == "max" and score > self._best + self.epsilon))
+        if better:
+            self._best = score
+            self._wait = 0
+        elif self._cool > 0:
+            self._cool -= 1
+        else:
+            self._wait += 1
+            if self._wait >= self.patience:
+                self._scale *= self.factor
+                self._wait = 0
+                self._cool = self.cooldown
+
+    def lr(self, base_lr, state):
+        return max(base_lr * self._scale, self.min_lr)
+
+
+class SequentialSchedule(LearningRateSchedule):
+    """Chain schedules, each active for N iterations (ref: SGD.SequentialSchedule)."""
+
+    def __init__(self):
+        self.schedules = []  # (schedule, duration)
+
+    def add(self, schedule: LearningRateSchedule, max_iteration: int):
+        self.schedules.append((schedule, max_iteration))
+        return self
+
+    def lr(self, base_lr, state):
+        n = state["eval_counter"]
+        offset = 0
+        for sched, dur in self.schedules:
+            if n < offset + dur or (sched, dur) == self.schedules[-1]:
+                sub_state = dict(state)
+                sub_state["eval_counter"] = n - offset
+                return sched.lr(base_lr, sub_state)
+            offset += dur
+        return base_lr
+
+
+# ---------------------------------------------------------------------------
+# Optim methods
+# ---------------------------------------------------------------------------
+
+class OptimMethod:
+    """Base (ref: optim/OptimMethod.scala). State dict includes the host
+    iteration counter ``eval_counter`` used by schedules."""
+
+    def __init__(self, learning_rate: float = 1e-3,
+                 learning_rate_schedule: Optional[LearningRateSchedule] = None,
+                 learning_rate_decay: float = 0.0):
+        self.learning_rate = learning_rate
+        self.schedule = learning_rate_schedule or Default()
+        self.learning_rate_decay = learning_rate_decay
+        self.host_state: Dict[str, Any] = {
+            "eval_counter": 0,
+            "epoch": 1,
+            "learning_rate_decay": learning_rate_decay,
+        }
+
+    def current_lr(self) -> float:
+        return float(self.schedule.lr(self.learning_rate, self.host_state))
+
+    def init_state(self, params):
+        return {}
+
+    def step(self, params, grads, state, lr):
+        """Pure update: returns (new_params, new_state)."""
+        raise NotImplementedError
+
+    # persistence parity (ref: OptimMethod.save/load)
+    def get_state(self):
+        return dict(self.host_state)
+
+    def load_state(self, s):
+        self.host_state.update(s)
+        return self
+
+
+class SGD(OptimMethod):
+    """ref: optim/SGD.scala — momentum, dampening, nesterov, weight decay."""
+
+    def __init__(self, learning_rate: float = 1e-3,
+                 learning_rate_decay: float = 0.0,
+                 weight_decay: float = 0.0, momentum: float = 0.0,
+                 dampening: Optional[float] = None, nesterov: bool = False,
+                 learning_rate_schedule: Optional[LearningRateSchedule] = None):
+        super().__init__(learning_rate, learning_rate_schedule,
+                         learning_rate_decay)
+        self.weight_decay = weight_decay
+        self.momentum = momentum
+        self.dampening = momentum if dampening is None else dampening
+        self.nesterov = nesterov
+        if nesterov:
+            assert momentum > 0 and self.dampening == 0, \
+                "nesterov requires momentum and zero dampening"
+
+    def init_state(self, params):
+        if self.momentum > 0:
+            return {"velocity": tree_map(jnp.zeros_like, params)}
+        return {}
+
+    def step(self, params, grads, state, lr):
+        wd, mom = self.weight_decay, self.momentum
+        if wd > 0:
+            grads = tree_map(lambda g, p: g + wd * p, grads, params)
+        if mom > 0:
+            damp = self.dampening
+            vel = tree_map(lambda v, g: mom * v + (1 - damp) * g,
+                           state["velocity"], grads)
+            if self.nesterov:
+                grads = tree_map(lambda g, v: g + mom * v, grads, vel)
+            else:
+                grads = vel
+            new_state = {"velocity": vel}
+        else:
+            new_state = state
+        new_params = tree_map(lambda p, g: p - lr * g.astype(p.dtype),
+                              params, grads)
+        return new_params, new_state
+
+
+class Adam(OptimMethod):
+    """ref: optim/Adam.scala."""
+
+    def __init__(self, learning_rate: float = 1e-3,
+                 learning_rate_decay: float = 0.0,
+                 beta1: float = 0.9, beta2: float = 0.999, epsilon: float = 1e-8,
+                 learning_rate_schedule: Optional[LearningRateSchedule] = None):
+        super().__init__(learning_rate, learning_rate_schedule,
+                         learning_rate_decay)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def init_state(self, params):
+        return {"m": tree_map(jnp.zeros_like, params),
+                "v": tree_map(jnp.zeros_like, params),
+                "t": jnp.zeros((), jnp.int32)}
+
+    def step(self, params, grads, state, lr):
+        b1, b2, eps = self.beta1, self.beta2, self.epsilon
+        t = state["t"] + 1
+        m = tree_map(lambda m_, g: b1 * m_ + (1 - b1) * g, state["m"], grads)
+        v = tree_map(lambda v_, g: b2 * v_ + (1 - b2) * g * g,
+                     state["v"], grads)
+        tf = t.astype(jnp.float32)
+        bc1 = 1 - b1 ** tf
+        bc2 = 1 - b2 ** tf
+        new_params = tree_map(
+            lambda p, m_, v_: p - (lr * (m_ / bc1)
+                                   / (jnp.sqrt(v_ / bc2) + eps)).astype(p.dtype),
+            params, m, v)
+        return new_params, {"m": m, "v": v, "t": t}
+
+
+class AdamWeightDecay(Adam):
+    """Decoupled weight decay + warmup/linear decay (ref: AdamWeightDecay.scala
+    — the BERT optimizer)."""
+
+    def __init__(self, learning_rate: float = 1e-3, warmup_portion: float = -1.0,
+                 total: int = -1, schedule: str = "linear",
+                 beta1: float = 0.9, beta2: float = 0.999, epsilon: float = 1e-6,
+                 weight_decay: float = 0.01):
+        super().__init__(learning_rate, 0.0, beta1, beta2, epsilon)
+        self.warmup_portion = warmup_portion
+        self.total = total
+        self.weight_decay = weight_decay
+        self.schedule_kind = schedule
+
+    def current_lr(self):
+        n = self.host_state["eval_counter"]
+        if self.total <= 0:
+            return self.learning_rate
+        progress = n / self.total
+        warm = self.warmup_portion
+        if warm > 0 and progress < warm:
+            return self.learning_rate * progress / warm
+        if self.schedule_kind == "linear":
+            return self.learning_rate * max(0.0, 1.0 - progress)
+        return self.learning_rate
+
+    def step(self, params, grads, state, lr):
+        b1, b2, eps, wd = self.beta1, self.beta2, self.epsilon, self.weight_decay
+        t = state["t"] + 1
+        m = tree_map(lambda m_, g: b1 * m_ + (1 - b1) * g, state["m"], grads)
+        v = tree_map(lambda v_, g: b2 * v_ + (1 - b2) * g * g,
+                     state["v"], grads)
+        new_params = tree_map(
+            lambda p, m_, v_: p - lr * (m_ / (jnp.sqrt(v_) + eps)
+                                        + wd * p).astype(p.dtype),
+            params, m, v)
+        return new_params, {"m": m, "v": v, "t": t}
+
+
+class Adagrad(OptimMethod):
+    """ref: optim/Adagrad.scala."""
+
+    def __init__(self, learning_rate: float = 1e-3,
+                 learning_rate_decay: float = 0.0,
+                 weight_decay: float = 0.0):
+        super().__init__(learning_rate, None, learning_rate_decay)
+        self.weight_decay = weight_decay
+
+    def init_state(self, params):
+        return {"accum": tree_map(jnp.zeros_like, params)}
+
+    def step(self, params, grads, state, lr):
+        if self.weight_decay > 0:
+            grads = tree_map(lambda g, p: g + self.weight_decay * p,
+                             grads, params)
+        accum = tree_map(lambda a, g: a + g * g, state["accum"], grads)
+        new_params = tree_map(
+            lambda p, g, a: p - (lr * g / (jnp.sqrt(a) + 1e-10)).astype(p.dtype),
+            params, grads, accum)
+        return new_params, {"accum": accum}
+
+
+class RMSprop(OptimMethod):
+    """ref: optim/RMSprop.scala."""
+
+    def __init__(self, learning_rate: float = 1e-2,
+                 learning_rate_decay: float = 0.0,
+                 decay_rate: float = 0.99, epsilon: float = 1e-8):
+        super().__init__(learning_rate, None, learning_rate_decay)
+        self.decay_rate, self.epsilon = decay_rate, epsilon
+
+    def init_state(self, params):
+        return {"sq": tree_map(jnp.zeros_like, params)}
+
+    def step(self, params, grads, state, lr):
+        dr, eps = self.decay_rate, self.epsilon
+        sq = tree_map(lambda s, g: dr * s + (1 - dr) * g * g,
+                      state["sq"], grads)
+        new_params = tree_map(
+            lambda p, g, s: p - (lr * g / (jnp.sqrt(s) + eps)).astype(p.dtype),
+            params, grads, sq)
+        return new_params, {"sq": sq}
+
+
+class Adadelta(OptimMethod):
+    """ref: optim/Adadelta.scala."""
+
+    def __init__(self, decay_rate: float = 0.9, epsilon: float = 1e-10):
+        super().__init__(1.0, None, 0.0)
+        self.decay_rate, self.epsilon = decay_rate, epsilon
+
+    def init_state(self, params):
+        return {"sq": tree_map(jnp.zeros_like, params),
+                "delta": tree_map(jnp.zeros_like, params)}
+
+    def step(self, params, grads, state, lr):
+        rho, eps = self.decay_rate, self.epsilon
+        sq = tree_map(lambda s, g: rho * s + (1 - rho) * g * g,
+                      state["sq"], grads)
+        upd = tree_map(
+            lambda g, s, d: g * jnp.sqrt(d + eps) / jnp.sqrt(s + eps),
+            grads, sq, state["delta"])
+        delta = tree_map(lambda d, u: rho * d + (1 - rho) * u * u,
+                         state["delta"], upd)
+        new_params = tree_map(lambda p, u: p - lr * u.astype(p.dtype),
+                              params, upd)
+        return new_params, {"sq": sq, "delta": delta}
+
+
+class Adamax(OptimMethod):
+    """ref: optim/Adamax.scala."""
+
+    def __init__(self, learning_rate: float = 2e-3, beta1: float = 0.9,
+                 beta2: float = 0.999, epsilon: float = 1e-38):
+        super().__init__(learning_rate, None, 0.0)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def init_state(self, params):
+        return {"m": tree_map(jnp.zeros_like, params),
+                "u": tree_map(jnp.zeros_like, params),
+                "t": jnp.zeros((), jnp.int32)}
+
+    def step(self, params, grads, state, lr):
+        b1, b2 = self.beta1, self.beta2
+        t = state["t"] + 1
+        m = tree_map(lambda m_, g: b1 * m_ + (1 - b1) * g, state["m"], grads)
+        u = tree_map(lambda u_, g: jnp.maximum(b2 * u_, jnp.abs(g) + self.epsilon),
+                     state["u"], grads)
+        bc = 1 - b1 ** t.astype(jnp.float32)
+        new_params = tree_map(
+            lambda p, m_, u_: p - (lr / bc * m_ / u_).astype(p.dtype),
+            params, m, u)
+        return new_params, {"m": m, "u": u, "t": t}
+
+
+class Ftrl(OptimMethod):
+    """ref: optim/Ftrl.scala — follow-the-regularized-leader."""
+
+    def __init__(self, learning_rate: float = 1e-3,
+                 learning_rate_power: float = -0.5,
+                 initial_accumulator_value: float = 0.1,
+                 l1_regularization_strength: float = 0.0,
+                 l2_regularization_strength: float = 0.0):
+        super().__init__(learning_rate, None, 0.0)
+        self.lr_power = learning_rate_power
+        self.init_accum = initial_accumulator_value
+        self.l1 = l1_regularization_strength
+        self.l2 = l2_regularization_strength
+
+    def init_state(self, params):
+        return {"accum": tree_map(
+                    lambda p: jnp.full_like(p, self.init_accum), params),
+                "linear": tree_map(jnp.zeros_like, params)}
+
+    def step(self, params, grads, state, lr):
+        lp, l1, l2 = self.lr_power, self.l1, self.l2
+
+        def upd(p, g, n, z):
+            n_new = n + g * g
+            sigma = (n_new ** -lp - n ** -lp) / lr
+            z_new = z + g - sigma * p
+            p_new = jnp.where(
+                jnp.abs(z_new) <= l1, 0.0,
+                -(z_new - jnp.sign(z_new) * l1)
+                / (n_new ** -lp / lr + 2 * l2))
+            return p_new, n_new, z_new
+
+        flat_p, tdef = jax.tree_util.tree_flatten(params)
+        flat_g = jax.tree_util.tree_leaves(grads)
+        flat_n = jax.tree_util.tree_leaves(state["accum"])
+        flat_z = jax.tree_util.tree_leaves(state["linear"])
+        new_p, new_n, new_z = [], [], []
+        for p, g, n, z in zip(flat_p, flat_g, flat_n, flat_z):
+            a, b, c = upd(p, g, n, z)
+            new_p.append(a)
+            new_n.append(b)
+            new_z.append(c)
+        unf = jax.tree_util.tree_unflatten
+        return unf(tdef, new_p), {"accum": unf(tdef, new_n),
+                                  "linear": unf(tdef, new_z)}
+
+
+# Intra-node parallel Adam is meaningless under SPMD — the step is already
+# partitioned across chips (ref: optim/ParallelAdam.scala).
+ParallelAdam = Adam

@@ -1,0 +1,60 @@
+"""Training summaries (ref: .../visualization/TrainSummary.scala,
+ValidationSummary.scala — hand-rolled TensorBoard event files).
+
+Here: torch.utils.tensorboard if importable (tensorboard wheels present),
+else a JSONL scalar log with the same read-back API (``read_scalar``),
+which is what the reference's summary reader offers.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import List, Tuple
+
+
+class Summary:
+    def __init__(self, log_dir: str, app_name: str, kind: str):
+        self.dir = os.path.join(log_dir, app_name, kind)
+        os.makedirs(self.dir, exist_ok=True)
+        self._tb = None
+        try:
+            from torch.utils.tensorboard import SummaryWriter
+            self._tb = SummaryWriter(self.dir)
+        except Exception:
+            pass
+        self._jsonl = open(os.path.join(self.dir, "scalars.jsonl"), "a")
+
+    def add_scalar(self, tag: str, value: float, step: int):
+        if self._tb is not None:
+            self._tb.add_scalar(tag, value, step)
+        self._jsonl.write(json.dumps(
+            {"tag": tag, "value": float(value), "step": int(step),
+             "wall": time.time()}) + "\n")
+        self._jsonl.flush()
+
+    def read_scalar(self, tag: str) -> List[Tuple[int, float]]:
+        out = []
+        path = os.path.join(self.dir, "scalars.jsonl")
+        with open(path) as f:
+            for line in f:
+                rec = json.loads(line)
+                if rec["tag"] == tag:
+                    out.append((rec["step"], rec["value"]))
+        return out
+
+    def close(self):
+        if self._tb is not None:
+            self._tb.close()
+        self._jsonl.close()
+
+
+class TrainSummary(Summary):
+    def __init__(self, log_dir: str, app_name: str):
+        super().__init__(log_dir, app_name, "train")
+
+
+class ValidationSummary(Summary):
+    def __init__(self, log_dir: str, app_name: str):
+        super().__init__(log_dir, app_name, "validation")

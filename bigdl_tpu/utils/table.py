@@ -60,7 +60,22 @@ class Table:
         return f"Table({{{inner}}})"
 
     def __eq__(self, other):
-        return isinstance(other, Table) and self._state == other._state
+        if not isinstance(other, Table):
+            return NotImplemented
+        if set(self._state.keys()) != set(other._state.keys()):
+            return False
+        import numpy as np
+        for k, v in self._state.items():
+            w = other._state[k]
+            if isinstance(v, Table) or isinstance(w, Table):
+                if v != w:
+                    return False
+            elif not np.array_equal(np.asarray(v), np.asarray(w)):
+                return False
+        return True
+
+    # mutable container: value-equal, identity-unhashable (like dict)
+    __hash__ = None
 
 
 def T(*args, **kwargs) -> Table:
