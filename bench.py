@@ -1,0 +1,91 @@
+"""Benchmark entry point — prints ONE JSON line for the driver.
+
+Current headline: LeNet-5/MNIST synchronous training throughput (BASELINE
+config 1 — the canonical BigDL hello-world) on whatever accelerator jax
+exposes (one real TPU chip under the driver; CPU elsewhere).
+
+The reference published no harvestable numbers this round (BASELINE.md):
+``vs_baseline`` is reported against the baseline anchor when one exists,
+else ``null``. As the build widens this script upgrades to the north-star
+metrics (ResNet-50 images/sec/chip, Llama-2-7B INT4 tokens/sec).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def bench_lenet_train(batch_size: int = 512, warmup: int = 5,
+                      iters: int = 30) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from bigdl_tpu.models import lenet
+    from bigdl_tpu.nn import ClassNLLCriterion
+    from bigdl_tpu.optim.optim_method import SGD
+
+    model = lenet.build_model(10)
+    criterion = ClassNLLCriterion()
+    optim = SGD(learning_rate=0.05)
+    params = jax.tree_util.tree_map(jnp.asarray, model.parameters_dict())
+    states = jax.tree_util.tree_map(jnp.asarray, model.states_dict())
+    opt_state = jax.tree_util.tree_map(jnp.asarray, optim.init_state(params))
+
+    def train_step(params, states, opt_state, x, t, rng):
+        def loss_fn(p):
+            y, s2 = model.apply(p, states, x, training=True, rng=rng)
+            return criterion.apply_loss(y, t), s2
+
+        (loss, new_states), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        new_params, new_opt = optim.step(params, grads, opt_state, 0.05)
+        return new_params, new_states, new_opt, loss
+
+    step = jax.jit(train_step, donate_argnums=(0, 1, 2))
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.rand(batch_size, 28 * 28).astype(np.float32))
+    t = jnp.asarray((rs.randint(0, 10, batch_size) + 1).astype(np.int32))
+    key = jax.random.PRNGKey(0)
+
+    for _ in range(warmup):
+        key, sub = jax.random.split(key)
+        params, states, opt_state, loss = step(params, states, opt_state,
+                                               x, t, sub)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        key, sub = jax.random.split(key)
+        params, states, opt_state, loss = step(params, states, opt_state,
+                                               x, t, sub)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    imgs_per_sec = batch_size * iters / dt
+    return {
+        "metric": "lenet_mnist_train_throughput",
+        "value": round(imgs_per_sec, 1),
+        "unit": "images/sec/chip",
+        "vs_baseline": None,  # no reference number harvestable (BASELINE.md)
+        "extra": {
+            "batch_size": batch_size,
+            "iters": iters,
+            "backend": jax.default_backend(),
+            "final_loss": float(loss),
+        },
+    }
+
+
+if __name__ == "__main__":
+    import os
+    import sys
+
+    if "--cpu" in sys.argv or os.environ.get("BIGDL_TPU_BENCH_CPU"):
+        # sitecustomize pins the axon TPU platform; env JAX_PLATFORMS is
+        # ineffective — the in-process config update is the working override
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    print(json.dumps(bench_lenet_train()))

@@ -42,7 +42,10 @@ logger = logging.getLogger("bigdl_tpu.optim")
 
 def _to_device(tree, sharding=None):
     if sharding is None:
-        return jax.tree_util.tree_map(jnp.asarray, tree)
+        # force fresh buffers: the jitted step donates its inputs, and a
+        # plain asarray would alias the live Module's own param arrays
+        return jax.tree_util.tree_map(
+            lambda a: jnp.array(a, copy=True), tree)
     return jax.tree_util.tree_map(
         lambda a: jax.device_put(jnp.asarray(a), sharding), tree)
 
@@ -78,6 +81,7 @@ class BaseOptimizer:
     # -- builder API (ref: Optimizer setters) --------------------------------
     def set_optim_method(self, method: OptimMethod):
         self.optim_method = method
+        self._step_fn = None   # compiled step closed over the old method
         return self
 
     set_optim_methods = set_optim_method
@@ -180,10 +184,13 @@ class BaseOptimizer:
 
         batcher = SampleToMiniBatch(self.batch_size)
         state = self.state
-        epoch_start = time.time()
+        end_uses_loss = getattr(self.end_trigger, "uses_loss", False)
+        self._pending_loss = None
+
         while not self.end_trigger(state):
             records = 0
             t_epoch = time.time()
+            ended_mid_epoch = False
             for mb in batcher(self.dataset.data(train=True)):
                 t0 = time.time()
                 x, t = self._place_batch(mb.get_input(), mb.get_target())
@@ -193,22 +200,23 @@ class BaseOptimizer:
                 t0 = time.time()
                 params, states, opt_state, loss = step(
                     params, states, opt_state, x, t, lr, sub)
-                loss = float(loss)
                 self.metrics.add("compute", time.time() - t0)
+                # loss is materialized one step late so the host can
+                # dispatch iteration N+1 while the device still runs N
+                self._drain_loss()
+                self._pending_loss = (loss, state["neval"], lr)
                 records += mb.size()
                 state["record_count"] += mb.size()
-                state["loss"] = loss
                 self.optim_method.host_state["eval_counter"] += 1
-                if self._train_summary is not None:
-                    self._train_summary.add_scalar(
-                        "Loss", loss, state["neval"])
-                    self._train_summary.add_scalar(
-                        "LearningRate", lr, state["neval"])
                 state["neval"] += 1
                 state["iteration_done"] += 1
                 self._after_iteration(params, states, opt_state, state)
+                if end_uses_loss:
+                    self._drain_loss()
                 if self.end_trigger(state):
+                    ended_mid_epoch = True
                     break
+            self._drain_loss()
             thr = records / max(time.time() - t_epoch, 1e-9)
             logger.info(
                 "Epoch %d done: loss=%.6f throughput=%.1f records/s (%s)",
@@ -216,6 +224,14 @@ class BaseOptimizer:
             if self._train_summary is not None:
                 self._train_summary.add_scalar(
                     "Throughput", thr, state["neval"])
+            if ended_mid_epoch:
+                # end_trigger fired inside the epoch: don't advance the
+                # epoch counter, but still give epoch-cadence checkpoint/
+                # validation triggers a final chance to persist state
+                state["epoch_finished"] = True
+                self._after_iteration(params, states, opt_state, state)
+                state["epoch_finished"] = False
+                break
             state["epoch"] += 1
             self.optim_method.host_state["epoch"] = state["epoch"]
             state["epoch_finished"] = True
@@ -229,13 +245,38 @@ class BaseOptimizer:
             jax.tree_util.tree_map(np.asarray, states))
         return self.model
 
+    def _drain_loss(self):
+        pending = getattr(self, "_pending_loss", None)
+        if pending is not None:
+            dev_loss, neval, lr = pending
+            self.state["loss"] = float(dev_loss)
+            if self._train_summary is not None:
+                self._train_summary.add_scalar(
+                    "Loss", self.state["loss"], neval)
+                self._train_summary.add_scalar("LearningRate", lr, neval)
+            self._pending_loss = None
+
     def _after_iteration(self, params, states, opt_state, state):
-        if self._validation_trigger is not None and \
-                self._validation_trigger(state):
-            self._run_validation(params, states, state)
-        if self._checkpoint_trigger is not None and \
-                self._checkpoint_trigger(state):
-            self._save_checkpoint(params, states, opt_state, state)
+        # each trigger is evaluated exactly ONCE per pass (triggers may be
+        # stateful, e.g. _EveryEpoch's latch); the neval dedup stops the
+        # epoch-end pass from re-firing an iteration-cadence trigger that
+        # already fired in-loop at the same neval
+        if self._validation_trigger is not None:
+            if getattr(self._validation_trigger, "uses_loss", False):
+                self._drain_loss()
+            if self._validation_trigger(state) and \
+                    getattr(self, "_last_val_neval", -1) != state["neval"]:
+                self._last_val_neval = state["neval"]
+                self._drain_loss()
+                self._run_validation(params, states, state)
+        if self._checkpoint_trigger is not None:
+            if getattr(self._checkpoint_trigger, "uses_loss", False):
+                self._drain_loss()
+            if self._checkpoint_trigger(state) and \
+                    getattr(self, "_last_ckpt_neval", -1) != state["neval"]:
+                self._last_ckpt_neval = state["neval"]
+                self._drain_loss()
+                self._save_checkpoint(params, states, opt_state, state)
 
     def _run_validation(self, params, states, state):
         results = validate(self.model, params, states,
@@ -274,6 +315,7 @@ class BaseOptimizer:
     def resume_from_checkpoint(self, path: str, tag: str):
         """Resume (ref: Optimizer resume = loadModule + OptimMethod.load)."""
         self.model = Module.load_module(os.path.join(path, f"model.{tag}"))
+        self._step_fn = None   # compiled step closed over the old model
         with open(os.path.join(path, f"optim.{tag}"), "rb") as f:
             blob = pickle.load(f)
         self.optim_method.load_state(blob["host_state"])
@@ -315,8 +357,18 @@ class DistriOptimizer(BaseOptimizer):
         return _to_device(tree, self._rep)
 
     def _place_batch(self, x, t):
+        multi_host = jax.process_count() > 1
+
         def put(a):
+            a = np.asarray(a)
+            if multi_host:
+                # each host holds only its local shard; device_put to a
+                # global NamedSharding is illegal for non-addressable
+                # devices — assemble the global array from per-process data
+                return jax.make_array_from_process_local_data(
+                    self._batch_sharding, a)
             return jax.device_put(jnp.asarray(a), self._batch_sharding)
+
         x = jax.tree_util.tree_map(put, x) if isinstance(x, list) else put(x)
         t = jax.tree_util.tree_map(put, t) if isinstance(t, list) else put(t)
         return x, t

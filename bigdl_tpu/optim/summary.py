@@ -32,10 +32,20 @@ class Summary:
         self._jsonl.write(json.dumps(
             {"tag": tag, "value": float(value), "step": int(step),
              "wall": time.time()}) + "\n")
+        # flush at a coarse cadence, not per scalar: per-iteration flushed
+        # writes serialize the hot loop on filesystem latency
+        self._pending = getattr(self, "_pending", 0) + 1
+        if self._pending >= 64:
+            self._jsonl.flush()
+            self._pending = 0
+
+    def flush(self):
         self._jsonl.flush()
+        self._pending = 0
 
     def read_scalar(self, tag: str) -> List[Tuple[int, float]]:
         out = []
+        self.flush()
         path = os.path.join(self.dir, "scalars.jsonl")
         with open(path) as f:
             for line in f:
@@ -47,6 +57,7 @@ class Summary:
     def close(self):
         if self._tb is not None:
             self._tb.close()
+        self.flush()
         self._jsonl.close()
 
 

@@ -7,6 +7,10 @@ from __future__ import annotations
 
 
 class Trigger:
+    # True when the trigger reads state["loss"]: the optimizer keeps loss on
+    # device (one-step-lagged) unless a trigger needs it synchronously
+    uses_loss = False
+
     def __call__(self, state: dict) -> bool:
         raise NotImplementedError
 
@@ -94,6 +98,8 @@ class _MaxScore(Trigger):
 
 
 class _MinLoss(Trigger):
+    uses_loss = True
+
     def __init__(self, l):
         self.l = l
 
@@ -104,6 +110,7 @@ class _MinLoss(Trigger):
 class _And(Trigger):
     def __init__(self, triggers):
         self.triggers = triggers
+        self.uses_loss = any(getattr(t, "uses_loss", False) for t in triggers)
 
     def __call__(self, state):
         return all(t(state) for t in self.triggers)
@@ -112,6 +119,7 @@ class _And(Trigger):
 class _Or(Trigger):
     def __init__(self, triggers):
         self.triggers = triggers
+        self.uses_loss = any(getattr(t, "uses_loss", False) for t in triggers)
 
     def __call__(self, state):
         return any(t(state) for t in self.triggers)

@@ -1,0 +1,36 @@
+"""Parallelism primitives over ``jax.sharding.Mesh``.
+
+The reference implements exactly one strategy — synchronous data parallelism
+via parameter-sharded BlockManager allreduce (AllReduceParameter,
+SURVEY.md §2.5) — because Spark is its only substrate. On TPU the substrate
+is the device mesh + XLA collectives over ICI, which makes DP one
+``PartitionSpec`` and opens the strategies the reference lacks (tensor /
+sequence / pipeline / expert parallelism, ring attention for long context).
+This package is the home of those primitives; the training facades
+(DistriOptimizer, keras fit, orca Estimator) build on it.
+"""
+
+from bigdl_tpu.parallel.mesh import (
+    create_mesh, default_mesh, mesh_axis_size, replicated, shard_along,
+    shard_batch, constrain,
+)
+from bigdl_tpu.parallel.collectives import (
+    all_gather, all_reduce, all_to_all, barrier_sum, compressed_all_reduce,
+    ppermute_next, reduce_scatter,
+)
+from bigdl_tpu.parallel.ring_attention import ring_attention, ring_self_attention
+from bigdl_tpu.parallel.ulysses import ulysses_attention
+from bigdl_tpu.parallel.pipeline import pipeline_stage_fn, PipelineModule
+from bigdl_tpu.parallel.data_parallel import (
+    dp_train_step, tp_linear_spec, param_shardings,
+)
+
+__all__ = [
+    "create_mesh", "default_mesh", "mesh_axis_size", "replicated",
+    "shard_along", "shard_batch", "constrain",
+    "all_reduce", "all_gather", "reduce_scatter", "all_to_all",
+    "ppermute_next", "barrier_sum", "compressed_all_reduce",
+    "ring_attention", "ring_self_attention", "ulysses_attention",
+    "pipeline_stage_fn", "PipelineModule",
+    "dp_train_step", "tp_linear_spec", "param_shardings",
+]

@@ -1,0 +1,81 @@
+"""Collective wrappers — the XLA-native replacement for the reference's comm
+backend.
+
+Reference comm (SURVEY.md §2.5): ``AllReduceParameter`` slices the flattened
+parameter vector into partition-count chunks; workers put gradient slices
+into Spark BlockManager, slice owners fetch+reduce, update, put weights back,
+workers re-fetch — with FP16 wire compression (``FP16CompressedTensor``).
+Here each of those becomes one XLA collective compiled into the step program
+and scheduled over ICI:
+
+- put/fetch+reduce            → ``all_reduce`` (psum) / ``reduce_scatter``
+- weight re-fetch             → ``all_gather``
+- FP16CompressedTensor        → ``compressed_all_reduce`` (bf16 wire dtype)
+
+These must be called inside ``shard_map``-ed (or manually partitioned jit)
+code where ``axis_name`` is bound.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def all_reduce(tree: Any, axis_name: str, mean: bool = False) -> Any:
+    """Sum (or mean) a pytree across ``axis_name`` (ref: the gradient
+    aggregate in AllReduceParameter.putGradients/getGradients)."""
+    op = lax.pmean if mean else lax.psum
+    return jax.tree_util.tree_map(lambda x: op(x, axis_name), tree)
+
+
+def compressed_all_reduce(tree: Any, axis_name: str, mean: bool = False,
+                          wire_dtype=jnp.bfloat16) -> Any:
+    """All-reduce with gradients cast to a 16-bit wire dtype first — the
+    analog of the reference's FP16CompressedTensor wire compression
+    (optim/parameters/FP16CompressedTensor.scala). Accumulation happens in
+    the wire dtype (matching the reference, which sums fp16 buffers), the
+    result is cast back to the input dtype."""
+
+    def _cr(x):
+        y = lax.psum(x.astype(wire_dtype), axis_name)
+        if mean:
+            y = y / lax.psum(jnp.ones((), wire_dtype), axis_name)
+        return y.astype(x.dtype)
+
+    return jax.tree_util.tree_map(_cr, tree)
+
+
+def all_gather(x, axis_name: str, axis: int = 0, tiled: bool = True):
+    """Gather shards along ``axis`` (ref: AllReduceParameter.getWeights)."""
+    return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis_name: str, axis: int = 0):
+    """Sum across the axis group, scattering result slices — the fused form
+    of the reference's put-gradients + owner-reduce."""
+    return lax.psum_scatter(x, axis_name, scatter_dimension=axis, tiled=True)
+
+
+def all_to_all(x, axis_name: str, split_axis: int, concat_axis: int,
+               tiled: bool = True):
+    """Transpose sharded layout between two tensor dimensions (used by
+    Ulysses sequence parallelism — no reference analog, SURVEY.md §5)."""
+    return lax.all_to_all(x, axis_name, split_axis=split_axis,
+                          concat_axis=concat_axis, tiled=tiled)
+
+
+def ppermute_next(x, axis_name: str, shift: int = 1):
+    """Circular shift around the axis ring (ring attention's neighbor
+    exchange; rides ICI nearest-neighbor links)."""
+    n = lax.axis_size(axis_name)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis_name, perm)
+
+
+def barrier_sum(axis_name: str):
+    """Cheap synchronization point (ref: ParameterSynchronizer barrier)."""
+    return lax.psum(jnp.ones((), jnp.int32), axis_name)

@@ -1,0 +1,80 @@
+"""Data/tensor-parallel training-step builders.
+
+The reference's DistriOptimizer turns every iteration into a Spark job with
+a BlockManager parameter-slice allreduce (SURVEY.md §3.2). Here the whole
+iteration is one jit program over the mesh: batch sharded on ``data``,
+params replicated (or tensor-sharded on ``model``), XLA inserting the
+gradient psum during SPMD partitioning. These helpers build such steps for
+any (apply, loss, optim) triple and are what DistriOptimizer/keras/orca use
+under the hood.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def tp_linear_spec(shape, axis: str = "model", dim: int = 0) -> P:
+    """PartitionSpec sharding a weight matrix's ``dim`` over ``axis``."""
+    spec = [None] * len(shape)
+    spec[dim] = axis
+    return P(*spec)
+
+
+def param_shardings(params, mesh: Mesh,
+                    rules: Optional[list] = None):
+    """Map a param pytree to NamedShardings.
+
+    ``rules`` is an ordered list of ``(path_regex, PartitionSpec)``; first
+    match wins, default replicated. Paths are '/'-joined key paths, e.g.
+    ``"fc_1/weight"``.
+    """
+    rules = rules or []
+    rep = NamedSharding(mesh, P())
+
+    def pick(path, leaf):
+        keys = "/".join(
+            str(getattr(p, "key", getattr(p, "name", p))) for p in path)
+        for pat, spec in rules:
+            if re.search(pat, keys):
+                # drop axes the leaf can't shard (size not divisible)
+                fixed = []
+                for i, ax in enumerate(spec):
+                    if ax is None or i >= leaf.ndim:
+                        fixed.append(None)
+                        continue
+                    size = mesh.shape[ax] if isinstance(ax, str) else 1
+                    fixed.append(ax if leaf.shape[i] % max(size, 1) == 0
+                                 else None)
+                return NamedSharding(mesh, P(*fixed[:leaf.ndim]))
+        return rep
+
+    return jax.tree_util.tree_map_with_path(pick, params)
+
+
+def dp_train_step(apply_fn: Callable, loss_fn: Callable, optim,
+                  mesh: Mesh, data_axis: str = "data",
+                  donate: bool = True):
+    """Build a jitted SPMD train step.
+
+    ``apply_fn(params, states, x, rng) -> (y, new_states)``;
+    ``loss_fn(y, t) -> scalar``; ``optim`` is an OptimMethod.
+    Returns ``step(params, states, opt_state, x, t, lr, rng)``.
+    """
+
+    def train_step(params, states, opt_state, x, t, lr, rng):
+        def f(p):
+            y, s2 = apply_fn(p, states, x, rng)
+            return loss_fn(y, t), s2
+
+        (loss, new_states), grads = jax.value_and_grad(f, has_aux=True)(params)
+        new_params, new_opt = optim.step(params, grads, opt_state, lr)
+        return new_params, new_states, new_opt, loss
+
+    return jax.jit(train_step,
+                   donate_argnums=(0, 1, 2) if donate else ())

@@ -1,0 +1,87 @@
+"""Mesh construction and sharding helpers.
+
+The reference's topology discovery is ``Engine.init`` parsing the Spark conf
+for node/core counts (Engine.scala); the comm topology is implicit in
+BlockManager. Here topology is explicit: a ``jax.sharding.Mesh`` whose axes
+name the parallelism dimensions. Axis conventions (shared with
+``bigdl_tpu.utils.engine.Engine``):
+
+- ``data``   — data parallelism (the reference's only strategy)
+- ``model``  — tensor parallelism
+- ``seq``    — sequence/context parallelism (ring attention)
+- ``pipe``   — pipeline parallelism
+- ``expert`` — expert parallelism (MoE)
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Union
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def create_mesh(axes: Union[Dict[str, int], Sequence[str]],
+                devices: Optional[Sequence] = None) -> Mesh:
+    """Build a mesh from ``{"data": 4, "model": 2}``-style axis sizes.
+
+    A size of ``-1`` (at most one axis) absorbs the remaining devices.
+    When given just axis names, all devices go to the first axis.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if not isinstance(axes, dict):
+        axes = {name: (-1 if i == 0 else 1) for i, name in enumerate(axes)}
+    names = list(axes.keys())
+    sizes = list(axes.values())
+    n = len(devices)
+    if -1 in sizes:
+        known = int(np.prod([s for s in sizes if s != -1]))
+        if n % known != 0:
+            raise ValueError(f"{n} devices not divisible by {known}")
+        sizes[sizes.index(-1)] = n // known
+    total = int(np.prod(sizes))
+    if total > n:
+        raise ValueError(f"mesh {dict(zip(names, sizes))} needs {total} "
+                         f"devices, have {n}")
+    return Mesh(np.asarray(devices[:total]).reshape(sizes), tuple(names))
+
+
+def default_mesh() -> Mesh:
+    """The Engine-owned mesh, creating a 1-axis DP mesh if Engine is cold."""
+    from bigdl_tpu.utils.engine import Engine
+
+    if not Engine.is_initialized():
+        Engine.init()
+    return Engine.mesh()
+
+
+def mesh_axis_size(mesh: Mesh, axis: str) -> int:
+    return mesh.shape[axis] if axis in mesh.axis_names else 1
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_along(mesh: Mesh, axis: str, dim: int = 0,
+                ndim: Optional[int] = None) -> NamedSharding:
+    """NamedSharding that splits tensor dimension ``dim`` over mesh ``axis``."""
+    spec = [None] * (dim + 1 if ndim is None else ndim)
+    spec[dim] = axis
+    return NamedSharding(mesh, P(*spec))
+
+
+def shard_batch(tree, mesh: Mesh, axis: str = "data"):
+    """Place a host batch pytree with dim-0 sharded over ``axis`` (the
+    equivalent of the reference's RDD partitioning of the minibatch)."""
+    sh = NamedSharding(mesh, P(axis))
+    import jax.numpy as jnp
+
+    return jax.tree_util.tree_map(
+        lambda a: jax.device_put(jnp.asarray(a), sh), tree)
+
+
+def constrain(x, spec: P):
+    """``lax.with_sharding_constraint`` under the ambient mesh."""
+    return jax.lax.with_sharding_constraint(x, spec)

@@ -1,0 +1,114 @@
+"""Ring attention — sequence/context parallelism for long sequences.
+
+The reference has NO long-context machinery (SURVEY.md §5: no ring
+attention, no context parallel; bigdl-llm only manages kv-cache memory on a
+single host). This module is the idiomatic TPU answer: the sequence axis is
+sharded over a mesh axis, each device computes blockwise attention for its
+query chunk while key/value chunks rotate around the ring via ``ppermute``
+(one ICI neighbor hop per step), with flash-style online-softmax
+accumulation so the full score matrix never materializes.
+
+Layout convention: ``(batch, seq, heads, head_dim)``, sequence sharded over
+the mesh axis (default ``"seq"``).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _varying(x, like):
+    """Make a locally-created array inherit ``like``'s varying-manual-axes
+    type — required by jax>=0.9 shard_map VMA typing when the array enters a
+    scan carry whose other leg went through a collective. The zero-valued
+    summand is DCE'd by XLA."""
+    return x + jnp.zeros((), x.dtype) * like.astype(x.dtype).ravel()[0]
+
+
+def _block_attn(q, k, v, acc, row_max, row_sum, *, scale,
+                q_pos, k_pos, causal):
+    """One (q-chunk × kv-chunk) blockwise update with online softmax.
+
+    q: (B, Sq, H, D); k, v: (B, Sk, H, D)
+    acc: (B, H, Sq, D); row_max/row_sum: (B, H, Sq)
+    """
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        mask = q_pos[:, None] >= k_pos[None, :]          # (Sq, Sk)
+        logits = jnp.where(mask[None, None], logits, NEG_INF)
+    blk_max = jnp.max(logits, axis=-1)                    # (B, H, Sq)
+    new_max = jnp.maximum(row_max, blk_max)
+    correction = jnp.exp(row_max - new_max)
+    p = jnp.exp(logits - new_max[..., None])              # (B, H, Sq, Sk)
+    if causal:
+        # rows with no valid key yet: keep p's zeros (exp(NEG_INF-max)=0)
+        p = jnp.where(mask[None, None], p, 0.0)
+    acc = acc * correction[..., None] + jnp.einsum(
+        "bhqk,bkhd->bhqd", p, v.astype(p.dtype),
+        preferred_element_type=jnp.float32)
+    row_sum = row_sum * correction + jnp.sum(p, axis=-1)
+    return acc, new_max, row_sum
+
+
+def ring_self_attention(q, k, v, axis_name: str = "seq",
+                        causal: bool = False,
+                        scale: Optional[float] = None):
+    """Per-device body: call inside ``shard_map`` with seq sharded on
+    ``axis_name``. q/k/v: (B, S_local, H, D) local chunks."""
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    b, s_local, h, d = q.shape
+    scale = scale if scale is not None else d ** -0.5
+
+    q_pos = my * s_local + jnp.arange(s_local)
+    acc0 = _varying(jnp.zeros((b, h, s_local, d), jnp.float32), q)
+    max0 = _varying(jnp.full((b, h, s_local), NEG_INF, jnp.float32), q)
+    sum0 = _varying(jnp.zeros((b, h, s_local), jnp.float32), q)
+
+    def step(carry, i):
+        k_blk, v_blk, acc, row_max, row_sum = carry
+        # after i forward shifts, this device holds chunk (my - i) mod n
+        chunk = (my - i) % n
+        k_pos = chunk * s_local + jnp.arange(s_local)
+        acc, row_max, row_sum = _block_attn(
+            q, k_blk, v_blk, acc, row_max, row_sum,
+            scale=scale, q_pos=q_pos, k_pos=k_pos, causal=causal)
+        # rotate kv to the next device (one ICI hop)
+        perm = [(j, (j + 1) % n) for j in range(n)]
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        return (k_blk, v_blk, acc, row_max, row_sum), None
+
+    (k, v, acc, row_max, row_sum), _ = lax.scan(
+        step, (k, v, acc0, max0, sum0), jnp.arange(n))
+    out = acc / jnp.maximum(row_sum, 1e-30)[..., None]    # (B, H, Sq, D)
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)      # (B, Sq, H, D)
+
+
+def ring_attention(q, k, v, mesh: Mesh, axis: str = "seq",
+                   causal: bool = False, scale: Optional[float] = None,
+                   batch_axis: Optional[str] = "data"):
+    """Global entry: q/k/v are (B, S, H, D) arrays; S is sharded over
+    ``axis`` (and optionally B over ``batch_axis``) by this wrapper."""
+    from jax import shard_map
+
+    baxis = batch_axis if (batch_axis and batch_axis in mesh.axis_names) \
+        else None
+    spec = P(baxis, axis, None, None)
+    fn = shard_map(
+        functools.partial(ring_self_attention, axis_name=axis,
+                          causal=causal, scale=scale),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+)
+    sh = NamedSharding(mesh, spec)
+    q, k, v = (jax.device_put(t, sh) for t in (q, k, v))
+    return fn(q, k, v)
