@@ -1,13 +1,16 @@
 """Benchmark entry point — prints ONE JSON line for the driver.
 
-Current headline: LeNet-5/MNIST synchronous training throughput (BASELINE
-config 1 — the canonical BigDL hello-world) on whatever accelerator jax
-exposes (one real TPU chip under the driver; CPU elsewhere).
+Headline: ResNet-50 ImageNet-shape synchronous training throughput in
+images/sec/chip (the BASELINE.json north-star metric) in bf16 on whatever
+accelerator jax exposes (one real TPU chip under the driver). ``--llama``
+reports the second north-star, Llama-2-7B q4_0 decode tokens/sec.
 
 The reference published no harvestable numbers this round (BASELINE.md):
-``vs_baseline`` is reported against the baseline anchor when one exists,
-else ``null``. As the build widens this script upgrades to the north-star
-metrics (ResNet-50 images/sec/chip, Llama-2-7B INT4 tokens/sec).
+``vs_baseline`` is ``null``. ``--quick`` shrinks configs for CPU smoke
+runs and prefixes the metric name with ``smoke_`` so dashboards never
+ingest smoke numbers as flagship results; ``--cpu`` forces the CPU
+backend (the env-var route is ineffective under this image's
+sitecustomize).
 """
 
 from __future__ import annotations
@@ -18,36 +21,33 @@ import time
 import numpy as np
 
 
-def bench_lenet_train(batch_size: int = 512, warmup: int = 5,
-                      iters: int = 30) -> dict:
+def _bench_train(model, make_batch, metric: str, batch_size: int,
+                 warmup: int, iters: int, lr: float, optim,
+                 extra: dict) -> dict:
+    """Shared train-step timing harness: jit+donate, warmup, timed loop."""
     import jax
     import jax.numpy as jnp
 
-    from bigdl_tpu.models import lenet
     from bigdl_tpu.nn import ClassNLLCriterion
-    from bigdl_tpu.optim.optim_method import SGD
 
-    model = lenet.build_model(10)
     criterion = ClassNLLCriterion()
-    optim = SGD(learning_rate=0.05)
     params = jax.tree_util.tree_map(jnp.asarray, model.parameters_dict())
     states = jax.tree_util.tree_map(jnp.asarray, model.states_dict())
-    opt_state = jax.tree_util.tree_map(jnp.asarray, optim.init_state(params))
+    opt_state = jax.tree_util.tree_map(jnp.asarray,
+                                       optim.init_state(params))
 
     def train_step(params, states, opt_state, x, t, rng):
         def loss_fn(p):
             y, s2 = model.apply(p, states, x, training=True, rng=rng)
-            return criterion.apply_loss(y, t), s2
+            return criterion.apply_loss(y.astype(jnp.float32), t), s2
 
         (loss, new_states), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(params)
-        new_params, new_opt = optim.step(params, grads, opt_state, 0.05)
+        new_params, new_opt = optim.step(params, grads, opt_state, lr)
         return new_params, new_states, new_opt, loss
 
     step = jax.jit(train_step, donate_argnums=(0, 1, 2))
-    rs = np.random.RandomState(0)
-    x = jnp.asarray(rs.rand(batch_size, 28 * 28).astype(np.float32))
-    t = jnp.asarray((rs.randint(0, 10, batch_size) + 1).astype(np.int32))
+    x, t = make_batch()
     key = jax.random.PRNGKey(0)
 
     for _ in range(warmup):
@@ -64,17 +64,162 @@ def bench_lenet_train(batch_size: int = 512, warmup: int = 5,
     jax.block_until_ready(loss)
     dt = time.perf_counter() - t0
 
-    imgs_per_sec = batch_size * iters / dt
+    import jax as _jax
     return {
-        "metric": "lenet_mnist_train_throughput",
-        "value": round(imgs_per_sec, 1),
+        "metric": metric,
+        "value": round(batch_size * iters / dt, 2),
         "unit": "images/sec/chip",
         "vs_baseline": None,  # no reference number harvestable (BASELINE.md)
+        "extra": {**extra, "batch_size": batch_size, "iters": iters,
+                  "backend": _jax.default_backend(),
+                  "final_loss": float(loss)},
+    }
+
+
+def bench_lenet_train(batch_size: int = 512, warmup: int = 5,
+                      iters: int = 30) -> dict:
+    import jax.numpy as jnp
+
+    from bigdl_tpu.models import lenet
+    from bigdl_tpu.optim.optim_method import SGD
+
+    rs = np.random.RandomState(0)
+
+    def make_batch():
+        x = jnp.asarray(rs.rand(batch_size, 28 * 28).astype(np.float32))
+        t = jnp.asarray((rs.randint(0, 10, batch_size) + 1)
+                        .astype(np.int32))
+        return x, t
+
+    return _bench_train(lenet.build_model(10), make_batch,
+                        "lenet_mnist_train_throughput", batch_size,
+                        warmup, iters, 0.05, SGD(learning_rate=0.05),
+                        extra={})
+
+
+def bench_resnet50_train(batch_size: int = 32, warmup: int = 3,
+                         iters: int = 10, image: int = 224,
+                         depth: int = 50, classes: int = 1000,
+                         smoke: bool = False) -> dict:
+    """North-star: ResNet train-step throughput, bf16 params/compute."""
+    import jax
+    import jax.numpy as jnp
+
+    from bigdl_tpu.models import resnet
+    from bigdl_tpu.optim.optim_method import SGD
+
+    model = resnet.resnet_imagenet(depth=depth, class_num=classes)
+    rs = np.random.RandomState(0)
+
+    def make_batch():
+        x = jnp.asarray(rs.rand(batch_size, 3, image, image), jnp.bfloat16)
+        t = jnp.asarray((rs.randint(0, classes, batch_size) + 1)
+                        .astype(np.int32))
+        return x, t
+
+    # bf16 params: the MXU-native dtype
+    model.load_parameters_dict(jax.tree_util.tree_map(
+        lambda a: a.astype(jnp.bfloat16) if a.dtype == jnp.float32 else a,
+        model.parameters_dict()))
+    name = "resnet50_imagenet_train_throughput"
+    return _bench_train(model, make_batch,
+                        ("smoke_" + name) if smoke else name,
+                        batch_size, warmup, iters, 0.1,
+                        SGD(learning_rate=0.1, momentum=0.9),
+                        extra={"image": image, "depth": depth,
+                               "dtype": "bfloat16"})
+
+
+def _synthetic_q4_llama_params(cfg, seed: int = 0):
+    """Random already-quantized params, built directly on device — avoids
+    materializing 28 GB of fp32 host weights for the 7B benchmark (the
+    values don't matter for throughput)."""
+    import jax
+    import jax.numpy as jnp
+
+    from bigdl_tpu.llm.ggml.quantize import QK
+    from bigdl_tpu.llm.models.llama import _LAYER_LINEARS, linear_shapes
+
+    key = jax.random.PRNGKey(seed)
+    h = cfg.hidden_size
+    shapes = linear_shapes(cfg)
+    L = cfg.num_hidden_layers
+    layers = {}
+    for name in _LAYER_LINEARS:
+        n, k = shapes[name]
+        key, k1, k2 = jax.random.split(key, 3)
+        layers[name] = {
+            "q": jax.random.randint(k1, (L, n, k // 2), 0, 256, jnp.uint8),
+            "scale": (jax.random.uniform(k2, (L, n, k // QK),
+                                         jnp.float32, 0.001, 0.02)
+                      .astype(jnp.float16)),
+        }
+    layers["input_layernorm"] = jnp.ones((L, h), jnp.bfloat16)
+    layers["post_attention_layernorm"] = jnp.ones((L, h), jnp.bfloat16)
+    key, k1, k2 = jax.random.split(key, 3)
+    return {
+        "embed_tokens": (jax.random.normal(k1, (cfg.vocab_size, h),
+                                           jnp.float32) * 0.02
+                         ).astype(jnp.bfloat16),
+        "norm": jnp.ones((h,), jnp.bfloat16),
+        "layers": layers,
+        "lm_head": {"w": (jax.random.normal(k2, (cfg.vocab_size, h),
+                                            jnp.float32) * 0.02
+                          ).astype(jnp.bfloat16)},
+    }
+
+
+def bench_llama_int4_decode(model_size: str = "7b", batch: int = 1,
+                            prompt_len: int = 128, decode_tokens: int = 64,
+                            max_cache: int = 256,
+                            smoke: bool = False) -> dict:
+    """North-star 2: Llama q4_0 decode throughput — prefill runs OUTSIDE
+    the timed window; only the autoregressive decode loop is measured."""
+    import jax
+    import jax.numpy as jnp
+
+    from bigdl_tpu.llm.models.llama import (
+        LlamaConfig, LlamaForCausalLM, init_cache)
+
+    cfg = {"7b": LlamaConfig.llama2_7b,
+           "8b": LlamaConfig.llama3_8b,
+           "tiny": LlamaConfig.tiny}[model_size]()
+    limit = min(max_cache, cfg.max_position_embeddings)
+    prompt_len = min(prompt_len, limit - decode_tokens - 1)
+    params = _synthetic_q4_llama_params(cfg)
+    model = LlamaForCausalLM(cfg, params, max_cache_len=limit)
+
+    rs = np.random.RandomState(0)
+    ids = jnp.asarray(rs.randint(0, cfg.vocab_size, (batch, prompt_len)),
+                      jnp.int32)
+
+    def decode_loop(logits, cache, n):
+        last = logits[:, -1]
+        for _ in range(n):
+            nxt = jnp.argmax(last, axis=-1).astype(jnp.int32)[:, None]
+            logits, cache = model(nxt, cache)
+            last = logits[:, -1]
+        jax.block_until_ready(last)
+        return logits, cache
+
+    # prefill + decode-step compile happen before the timer
+    logits, cache = model(ids)
+    logits, cache = decode_loop(logits, cache, 2)
+
+    t0 = time.perf_counter()
+    decode_loop(logits, cache, decode_tokens)
+    dt = time.perf_counter() - t0
+
+    name = "llama2_7b_int4_decode_throughput"
+    return {
+        "metric": ("smoke_" + name) if smoke else name,
+        "value": round(decode_tokens * batch / dt, 2),
+        "unit": "tokens/sec",
+        "vs_baseline": None,  # no reference number harvestable (BASELINE.md)
         "extra": {
-            "batch_size": batch_size,
-            "iters": iters,
+            "model": model_size, "batch": batch, "prompt_len": prompt_len,
+            "decode_tokens": decode_tokens, "qtype": "sym_int4",
             "backend": jax.default_backend(),
-            "final_loss": float(loss),
         },
     }
 
@@ -88,4 +233,19 @@ if __name__ == "__main__":
         # ineffective — the in-process config update is the working override
         import jax
         jax.config.update("jax_platforms", "cpu")
-    print(json.dumps(bench_lenet_train()))
+    quick = "--quick" in sys.argv or bool(os.environ.get(
+        "BIGDL_TPU_BENCH_QUICK"))
+    if "--lenet" in sys.argv:
+        print(json.dumps(bench_lenet_train()))
+    elif "--llama" in sys.argv:
+        if quick:
+            print(json.dumps(bench_llama_int4_decode(
+                model_size="tiny", smoke=True)))
+        else:
+            print(json.dumps(bench_llama_int4_decode()))
+    elif quick:
+        print(json.dumps(bench_resnet50_train(
+            batch_size=4, warmup=1, iters=3, image=64, depth=18,
+            classes=100, smoke=True)))
+    else:
+        print(json.dumps(bench_resnet50_train()))

@@ -1,0 +1,33 @@
+"""bigdl_tpu.keras — Keras-1-style user API (ref: scala …/dllib/keras,
+python P:dllib/keras)."""
+
+from bigdl_tpu.keras.topology import (
+    Input, KerasLayer, KerasTensor, Model, Sequential)
+from bigdl_tpu.keras.layers import (
+    Activation, AveragePooling1D, AveragePooling2D, BatchNormalization,
+    Bidirectional, Conv2D, Convolution1D, Convolution2D, Deconvolution2D,
+    Dense, Dropout, ELU, Embedding, Flatten, GRU, GlobalAveragePooling1D,
+    GlobalAveragePooling2D, GlobalMaxPooling1D, GlobalMaxPooling2D,
+    InputLayer, LSTM, LeakyReLU, MaxPooling1D, MaxPooling2D, Merge, PReLU,
+    Permute, RepeatVector, Reshape, SeparableConvolution2D, SimpleRNN,
+    ThresholdedReLU, TimeDistributed, UpSampling1D, UpSampling2D,
+    ZeroPadding1D, ZeroPadding2D, merge,
+)
+from bigdl_tpu.keras.objectives import to_criterion
+from bigdl_tpu.keras.optimizers import to_optim_method
+from bigdl_tpu.keras.metrics import to_validation_methods
+
+__all__ = [
+    "Input", "KerasLayer", "KerasTensor", "Model", "Sequential",
+    "Activation", "AveragePooling1D", "AveragePooling2D",
+    "BatchNormalization", "Bidirectional", "Conv2D", "Convolution1D",
+    "Convolution2D", "Deconvolution2D", "Dense", "Dropout", "ELU",
+    "Embedding", "Flatten", "GRU", "GlobalAveragePooling1D",
+    "GlobalAveragePooling2D", "GlobalMaxPooling1D", "GlobalMaxPooling2D",
+    "InputLayer", "LSTM", "LeakyReLU", "MaxPooling1D", "MaxPooling2D",
+    "Merge", "PReLU", "Permute", "RepeatVector", "Reshape",
+    "SeparableConvolution2D", "SimpleRNN", "ThresholdedReLU",
+    "TimeDistributed", "UpSampling1D", "UpSampling2D", "ZeroPadding1D",
+    "ZeroPadding2D", "merge", "to_criterion", "to_optim_method",
+    "to_validation_methods",
+]

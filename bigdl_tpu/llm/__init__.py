@@ -1,0 +1,21 @@
+"""bigdl_tpu.llm — low-bit LLM inference (ref: python/llm — bigdl-llm).
+
+The reference patches HuggingFace ``from_pretrained(load_in_4bit=True)`` to
+replace every ``nn.Linear`` with a ggml-block-quantized ``LowBitLinear``
+backed by vendored llama.cpp CPU kernels (SURVEY.md §2.8). Here the same
+API surface runs on TPU: q4_0-family block quantization (``llm.ggml``),
+Pallas dequant-matmul kernels (``llm.kernels``), a jax Llama with kv cache
+and tensor-parallel shardings (``llm.models``), and the
+``AutoModelForCausalLM`` facade (``llm.transformers``).
+"""
+
+from bigdl_tpu.llm.ggml.quantize import (
+    QK, dequantize, ggml_qtypes, quantize)
+from bigdl_tpu.llm.transformers.low_bit_linear import LowBitLinear
+from bigdl_tpu.llm.transformers.convert import (
+    ggml_convert_low_bit, optimize_model)
+
+__all__ = [
+    "QK", "dequantize", "ggml_qtypes", "quantize",
+    "LowBitLinear", "ggml_convert_low_bit", "optimize_model",
+]

@@ -1,0 +1,7 @@
+"""ggml-style block quantization (ref: P:llm/ggml — quantize.py + native
+quantize kernels)."""
+
+from bigdl_tpu.llm.ggml.quantize import (
+    QK, dequantize, ggml_qtypes, quantize)
+
+__all__ = ["QK", "dequantize", "ggml_qtypes", "quantize"]

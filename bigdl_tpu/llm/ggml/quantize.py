@@ -1,0 +1,168 @@
+"""Block quantization formats (ref: P:llm/ggml/quantize.py + the ggml
+q4_0/q4_1/q8_0/nf4 C kernels the reference ships as native .so).
+
+Formats (all 32-element blocks along the input/K dim, fp16 scales — the
+ggml layout the reference's ``sym_int4``/``asym_int4``/``sym_int8``/
+``nf4``/``fp4`` qtype enum names):
+
+- ``sym_int4``  (q4_0): w ≈ scale * (q - 8),   q ∈ [0, 15], 2 nibbles/byte
+- ``asym_int4`` (q4_1): w ≈ scale * q + min,   q ∈ [0, 15]
+- ``sym_int8``  (q8_0): w ≈ scale * q,         q ∈ [-127, 127]
+- ``nf4``: 16-entry normal-float codebook, absmax-scaled per block
+- ``fp4``: 16-entry e2m1 codebook, absmax-scaled per block
+
+Tensors quantize row-wise over (out_features, in_features); packed arrays
+keep TPU-friendly layouts (nibbles split into two planes rather than
+byte-interleaved, so dequant is a gather-free arithmetic op).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import numpy as np
+
+QK = 32  # ggml block size
+
+# bitsandbytes/QLoRA NF4 codebook — the reference's nf4 uses the same table
+NF4_CODE = np.array([
+    -1.0, -0.6961928009986877, -0.5250730514526367, -0.39491748809814453,
+    -0.28444138169288635, -0.18477343022823334, -0.09105003625154495, 0.0,
+    0.07958029955625534, 0.16093020141124725, 0.24611230194568634,
+    0.33791524171829224, 0.44070982933044434, 0.5626170039176941,
+    0.7229568362236023, 1.0], dtype=np.float32)
+
+# e2m1 fp4 codebook (sign × {0, .5, 1, 1.5, 2, 3, 4, 6} / 6 absmax-scaled)
+FP4_CODE = np.array([
+    0.0, 0.0052083334, 0.6666667, 1.0, 0.3333333, 0.5, 0.16666667, 0.25,
+    -0.0, -0.0052083334, -0.6666667, -1.0, -0.3333333, -0.5, -0.16666667,
+    -0.25], dtype=np.float32)
+
+
+def ggml_qtypes() -> Tuple[str, ...]:
+    return ("sym_int4", "asym_int4", "sym_int5", "sym_int8", "nf4", "fp4",
+            "fp8", "bf16")
+
+
+def _to_blocks(w: np.ndarray) -> np.ndarray:
+    w = np.ascontiguousarray(w, dtype=np.float32)
+    n, k = w.shape
+    if k % QK != 0:
+        raise ValueError(f"in_features {k} not a multiple of QK={QK}")
+    return w.reshape(n, k // QK, QK)
+
+
+def quantize(w: np.ndarray, qtype: str = "sym_int4") -> Dict[str, np.ndarray]:
+    """Quantize a (out, in) weight matrix. Returns a dict of arrays:
+
+    - int4 family: ``q`` uint8 (out, in//2) — low nibbles = even k, high
+      nibbles = odd k (plane-split packing); ``scale`` fp16 (out, in//QK);
+      asym adds ``zero`` fp16
+    - sym_int8: ``q`` int8 (out, in); ``scale`` fp16
+    - nf4/fp4: codebook indices packed like int4, absmax ``scale``
+    - fp8/bf16: stored as reduced-precision floats (no blocks)
+    """
+    if qtype in ("bf16",):
+        import jax.numpy as jnp
+        return {"qtype": qtype,
+                "q": np.asarray(jnp.asarray(w, jnp.bfloat16))}
+    if qtype == "fp8":
+        import jax.numpy as jnp
+        return {"qtype": qtype,
+                "q": np.asarray(jnp.asarray(w, jnp.float8_e4m3fn))}
+
+    blocks = _to_blocks(w)
+    n, nb, _ = blocks.shape
+
+    if qtype == "sym_int8":
+        amax = np.abs(blocks).max(axis=2)
+        scale = (amax / 127.0).astype(np.float16)
+        s = scale.astype(np.float32)[..., None]
+        q = np.round(np.divide(blocks, s, out=np.zeros_like(blocks),
+                               where=s > 0)).clip(-127, 127).astype(np.int8)
+        return {"qtype": qtype, "q": q.reshape(n, -1), "scale": scale}
+
+    if qtype in ("sym_int4", "sym_int5"):
+        bits = 4 if qtype == "sym_int4" else 5
+        qmax = (1 << (bits - 1)) - 1   # 7 / 15
+        zero = 1 << (bits - 1)         # 8 / 16
+        amax = np.abs(blocks).max(axis=2)
+        scale = (amax / qmax).astype(np.float16)
+        s = scale.astype(np.float32)[..., None]
+        q = np.round(np.divide(blocks, s, out=np.zeros_like(blocks),
+                               where=s > 0)).clip(-qmax, qmax) + zero
+        q = q.astype(np.uint8).reshape(n, -1)
+        if bits == 5:
+            return {"qtype": qtype, "q": q, "scale": scale}
+        return {"qtype": qtype, "q": _pack_nibbles(q), "scale": scale}
+
+    if qtype == "asym_int4":
+        wmin = blocks.min(axis=2)
+        wmax = blocks.max(axis=2)
+        scale = ((wmax - wmin) / 15.0).astype(np.float16)
+        s = scale.astype(np.float32)[..., None]
+        q = np.round(np.divide(blocks - wmin[..., None], s,
+                               out=np.zeros_like(blocks),
+                               where=s > 0)).clip(0, 15)
+        q = q.astype(np.uint8).reshape(n, -1)
+        return {"qtype": qtype, "q": _pack_nibbles(q), "scale": scale,
+                "zero": wmin.astype(np.float16)}
+
+    if qtype in ("nf4", "fp4"):
+        code = NF4_CODE if qtype == "nf4" else FP4_CODE
+        amax = np.abs(blocks).max(axis=2)
+        scale = amax.astype(np.float16)
+        s = scale.astype(np.float32)[..., None]
+        normed = np.divide(blocks, s, out=np.zeros_like(blocks),
+                           where=s > 0)
+        idx = np.abs(normed[..., None] - code[None, None, None, :]) \
+            .argmin(axis=-1).astype(np.uint8).reshape(n, -1)
+        return {"qtype": qtype, "q": _pack_nibbles(idx), "scale": scale}
+
+    raise ValueError(f"unknown qtype {qtype!r}; known: {ggml_qtypes()}")
+
+
+def _pack_nibbles(q: np.ndarray) -> np.ndarray:
+    """(n, k) 4-bit values → (n, k//2) bytes; low nibble = even k-plane,
+    high nibble = odd k-plane."""
+    lo = q[:, 0::2]
+    hi = q[:, 1::2]
+    return (lo | (hi << 4)).astype(np.uint8)
+
+
+def _unpack_nibbles(packed: np.ndarray) -> np.ndarray:
+    n, half = packed.shape
+    out = np.empty((n, half * 2), dtype=np.uint8)
+    out[:, 0::2] = packed & 0xF
+    out[:, 1::2] = packed >> 4
+    return out
+
+
+def dequantize(qdict: Dict[str, np.ndarray]) -> np.ndarray:
+    """Inverse of :func:`quantize` (fp32)."""
+    qtype = qdict["qtype"]
+    if qtype in ("bf16", "fp8"):
+        return np.asarray(qdict["q"], dtype=np.float32)
+    scale = qdict["scale"].astype(np.float32)
+    n, nb = scale.shape
+
+    if qtype == "sym_int8":
+        q = qdict["q"].reshape(n, nb, QK).astype(np.float32)
+        return (q * scale[..., None]).reshape(n, -1)
+    if qtype == "sym_int5":
+        q = qdict["q"].reshape(n, nb, QK).astype(np.float32) - 16.0
+        return (q * scale[..., None]).reshape(n, -1)
+    if qtype == "sym_int4":
+        q = _unpack_nibbles(qdict["q"]).reshape(n, nb, QK)
+        return ((q.astype(np.float32) - 8.0) * scale[..., None]) \
+            .reshape(n, -1)
+    if qtype == "asym_int4":
+        q = _unpack_nibbles(qdict["q"]).reshape(n, nb, QK)
+        zero = qdict["zero"].astype(np.float32)
+        return (q.astype(np.float32) * scale[..., None]
+                + zero[..., None]).reshape(n, -1)
+    if qtype in ("nf4", "fp4"):
+        code = NF4_CODE if qtype == "nf4" else FP4_CODE
+        idx = _unpack_nibbles(qdict["q"]).reshape(n, nb, QK)
+        return (code[idx] * scale[..., None]).reshape(n, -1)
+    raise ValueError(f"unknown qtype {qtype!r}")

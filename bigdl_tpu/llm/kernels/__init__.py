@@ -1,0 +1,7 @@
+"""Pallas TPU kernels for low-bit inference (ref: the llama.cpp-family
+AVX/VNNI kernels the reference ships — here lowered to the MXU)."""
+
+from bigdl_tpu.llm.kernels.int4_matmul import (
+    int4_matmul, int4_matmul_reference, int8_matmul)
+
+__all__ = ["int4_matmul", "int4_matmul_reference", "int8_matmul"]

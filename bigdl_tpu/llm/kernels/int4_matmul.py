@@ -1,0 +1,182 @@
+"""INT4/INT8 block-dequant matmul Pallas kernels.
+
+Reference counterpart: bigdl-llm's native q4_0 matvec (ctypes →
+llama.cpp-family C kernels, SURVEY.md §3.4 hot loop). TPU design:
+
+- weights stay packed in HBM/VMEM (uint8, two nibble-planes) — 4.5 bits/
+  weight including scales, so the HBM→VMEM stream is ~3.5x smaller than
+  bf16. Decode is HBM-bandwidth-bound, so this is where the speed comes
+  from (same reason the CPU kernels win on DDR bandwidth).
+- dequant happens in-kernel on the VPU (arithmetic only, no gathers for
+  q4_0/q8_0), feeding bf16 tiles straight into the MXU ``jnp.dot``.
+- grid = (M/bm, N/bn, K/bk) with a VMEM fp32 accumulator, K innermost so
+  the accumulator lives across the K sweep (standard Pallas TPU matmul
+  schedule).
+
+Layouts (from llm.ggml.quantize): x (M, K) activations; q packed uint8
+(N, K//2) — low nibble = even-k plane, high = odd-k; scale fp16
+(N, K//32). Output (M, N) = x @ W^T, matching Linear's y = x W^T.
+
+``interpret=True`` runs the same kernel on CPU for tests (SURVEY.md §4:
+golden parity against an independent implementation — here the numpy
+dequant reference).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from bigdl_tpu.llm.ggml.quantize import QK
+
+
+def _int4_kernel(x_ref, qlo_ref, qhi_ref, scale_ref, o_ref, acc_ref,
+                 *, n_k_tiles):
+    """One (bm, bn) tile: accumulate x_tile @ dequant(w_tile)^T over K."""
+    k_idx = pl.program_id(2)
+
+    @pl.when(k_idx == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # dequant: interleave the two nibble planes back into k-order
+    lo = qlo_ref[:].astype(jnp.int32) - 8          # (bn, bk/2) even k
+    hi = qhi_ref[:].astype(jnp.int32) - 8          # (bn, bk/2) odd k
+    bn, half = lo.shape
+    w = jnp.stack([lo, hi], axis=-1).reshape(bn, half * 2)  # (bn, bk)
+    scale = scale_ref[:].astype(jnp.float32)       # (bn, bk/QK)
+    w = w.reshape(bn, half * 2 // QK, QK) * scale[..., None]
+    w = w.reshape(bn, half * 2).astype(jnp.bfloat16)
+
+    acc_ref[:] += jnp.dot(x_ref[:], w.T, preferred_element_type=jnp.float32)
+
+    @pl.when(k_idx == n_k_tiles - 1)
+    def _done():
+        o_ref[:] = acc_ref[:].astype(o_ref.dtype)
+
+
+def _split_planes(q_packed: jnp.ndarray):
+    """uint8 (N, K//2) → (lo, hi) nibble planes, each (N, K//2)."""
+    return q_packed & 0xF, q_packed >> 4
+
+
+@functools.partial(jax.jit, static_argnames=("bm", "bn", "bk", "interpret",
+                                             "out_dtype"))
+def int4_matmul(x, q_packed, scale, bm: int = 128, bn: int = 128,
+                bk: int = 512, interpret: bool = False,
+                out_dtype=jnp.bfloat16):
+    """y = x @ dequant_q4_0(q, scale)^T.
+
+    x: (M, K) bf16/f32; q_packed: (N, K//2) uint8; scale: (N, K//QK) fp16.
+    M, N, K padded internally to tile multiples.
+    """
+    m, k = x.shape
+    n = q_packed.shape[0]
+    bm = min(bm, max(8, m))
+    bk = min(bk, k)
+    if bk % QK:
+        raise ValueError(f"bk must be a multiple of {QK}")
+
+    qlo, qhi = _split_planes(q_packed)
+
+    m_pad = -m % bm
+    n_pad = -n % bn
+    k_pad = -k % bk
+    if m_pad or k_pad:
+        x = jnp.pad(x, ((0, m_pad), (0, k_pad)))
+    if n_pad or k_pad:
+        qlo = jnp.pad(qlo, ((0, n_pad), (0, k_pad // 2)),
+                      constant_values=8)
+        qhi = jnp.pad(qhi, ((0, n_pad), (0, k_pad // 2)),
+                      constant_values=8)
+        scale = jnp.pad(scale, ((0, n_pad), (0, k_pad // QK)))
+    mp, kp = x.shape
+    np_ = qlo.shape[0]
+    n_k_tiles = kp // bk
+
+    out = pl.pallas_call(
+        functools.partial(_int4_kernel, n_k_tiles=n_k_tiles),
+        grid=(mp // bm, np_ // bn, n_k_tiles),
+        in_specs=[
+            pl.BlockSpec((bm, bk), lambda i, j, kk: (i, kk)),
+            pl.BlockSpec((bn, bk // 2), lambda i, j, kk: (j, kk)),
+            pl.BlockSpec((bn, bk // 2), lambda i, j, kk: (j, kk)),
+            pl.BlockSpec((bn, bk // QK), lambda i, j, kk: (j, kk)),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((mp, np_), out_dtype),
+        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
+        interpret=interpret,
+    )(x.astype(jnp.bfloat16), qlo, qhi, scale)
+    return out[:m, :n]
+
+
+def _int8_kernel(x_ref, q_ref, scale_ref, o_ref, acc_ref, *, n_k_tiles):
+    k_idx = pl.program_id(2)
+
+    @pl.when(k_idx == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    w = q_ref[:].astype(jnp.float32)               # (bn, bk)
+    scale = scale_ref[:].astype(jnp.float32)       # (bn, bk/QK)
+    bn, bk = w.shape
+    w = (w.reshape(bn, bk // QK, QK) * scale[..., None]) \
+        .reshape(bn, bk).astype(jnp.bfloat16)
+    acc_ref[:] += jnp.dot(x_ref[:], w.T, preferred_element_type=jnp.float32)
+
+    @pl.when(k_idx == n_k_tiles - 1)
+    def _done():
+        o_ref[:] = acc_ref[:].astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("bm", "bn", "bk", "interpret",
+                                             "out_dtype"))
+def int8_matmul(x, q, scale, bm: int = 128, bn: int = 128, bk: int = 512,
+                interpret: bool = False, out_dtype=jnp.bfloat16):
+    """y = x @ dequant_q8_0(q, scale)^T — the BigQuant INT8 gemm
+    equivalent (SURVEY.md §2.2). q: (N, K) int8."""
+    m, k = x.shape
+    n = q.shape[0]
+    bm = min(bm, max(8, m))
+    bk = min(bk, k)
+    m_pad, n_pad, k_pad = -m % bm, -n % bn, -k % bk
+    if m_pad or k_pad:
+        x = jnp.pad(x, ((0, m_pad), (0, k_pad)))
+    if n_pad or k_pad:
+        q = jnp.pad(q, ((0, n_pad), (0, k_pad)))
+        scale = jnp.pad(scale, ((0, n_pad), (0, k_pad // QK)))
+    mp, kp = x.shape
+    np_ = q.shape[0]
+    n_k_tiles = kp // bk
+
+    out = pl.pallas_call(
+        functools.partial(_int8_kernel, n_k_tiles=n_k_tiles),
+        grid=(mp // bm, np_ // bn, n_k_tiles),
+        in_specs=[
+            pl.BlockSpec((bm, bk), lambda i, j, kk: (i, kk)),
+            pl.BlockSpec((bn, bk), lambda i, j, kk: (j, kk)),
+            pl.BlockSpec((bn, bk // QK), lambda i, j, kk: (j, kk)),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((mp, np_), out_dtype),
+        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
+        interpret=interpret,
+    )(x.astype(jnp.bfloat16), q, scale)
+    return out[:m, :n]
+
+
+def int4_matmul_reference(x: np.ndarray, q_packed: np.ndarray,
+                          scale: np.ndarray) -> np.ndarray:
+    """Independent numpy implementation for golden-parity tests."""
+    from bigdl_tpu.llm.ggml.quantize import dequantize
+
+    w = dequantize({"qtype": "sym_int4", "q": np.asarray(q_packed),
+                    "scale": np.asarray(scale)})
+    return np.asarray(x, np.float32) @ w.T

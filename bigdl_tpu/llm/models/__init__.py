@@ -1,0 +1,7 @@
+"""jax LLM implementations (ref: the per-arch forward rewrites under
+P:llm/transformers/models/ — here full TPU-native models)."""
+
+from bigdl_tpu.llm.models.llama import (
+    LlamaConfig, LlamaForCausalLM)
+
+__all__ = ["LlamaConfig", "LlamaForCausalLM"]

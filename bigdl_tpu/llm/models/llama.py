@@ -1,0 +1,415 @@
+"""Llama family on TPU (ref: P:llm/transformers/models/llama.py — the
+reference rewrites HF LlamaAttention.forward for fused rope + kv cache on
+CPU; BASELINE config 5 = Llama-2-7B INT4 decode).
+
+TPU-first design decisions:
+- decoder layers are a **stacked pytree scanned with lax.scan** (compile
+  time O(1) in depth, weights stream per layer);
+- kv cache is a static-shape ring ``(L, B, S_max, H_kv, D)`` updated with
+  dynamic_update_slice inside the jitted step — the whole decode step is
+  ONE compiled program (the reference's python-per-layer loop becomes a
+  single XLA launch);
+- weights may be ggml-quantized (llm.ggml): each linear is a dict with
+  either ``{"w"}`` (dense bf16) or ``{"q", "scale"}`` (q4_0 planes), and
+  matmuls dispatch to the Pallas kernel on TPU;
+- tensor parallelism via PartitionSpec rules (:func:`param_pspecs`):
+  attention heads and MLP intermediate sharded over ``model``, sequence
+  shardable over ``seq`` for long prompts (ring attention available in
+  bigdl_tpu.parallel for the prefill path).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+
+@dataclasses.dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 32
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    tie_word_embeddings: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    @classmethod
+    def llama2_7b(cls) -> "LlamaConfig":
+        return cls()
+
+    @classmethod
+    def llama3_8b(cls) -> "LlamaConfig":
+        return cls(vocab_size=128256, intermediate_size=14336,
+                   num_key_value_heads=8, rope_theta=500000.0,
+                   max_position_embeddings=8192)
+
+    @classmethod
+    def tiny(cls, vocab: int = 256) -> "LlamaConfig":
+        """Test-size config (the reference's tests use tiny dummy ckpts)."""
+        return cls(vocab_size=vocab, hidden_size=64, intermediate_size=128,
+                   num_hidden_layers=2, num_attention_heads=4,
+                   num_key_value_heads=2, max_position_embeddings=128)
+
+    @classmethod
+    def from_hf(cls, hf_config) -> "LlamaConfig":
+        g = (lambda k, d: getattr(hf_config, k, d))
+        return cls(
+            vocab_size=g("vocab_size", 32000),
+            hidden_size=g("hidden_size", 4096),
+            intermediate_size=g("intermediate_size", 11008),
+            num_hidden_layers=g("num_hidden_layers", 32),
+            num_attention_heads=g("num_attention_heads", 32),
+            num_key_value_heads=g("num_key_value_heads",
+                                  g("num_attention_heads", 32)),
+            max_position_embeddings=g("max_position_embeddings", 4096),
+            rms_norm_eps=g("rms_norm_eps", 1e-5),
+            rope_theta=g("rope_theta", 10000.0),
+            tie_word_embeddings=g("tie_word_embeddings", False))
+
+
+# ---------------------------------------------------------------------------
+# params
+# ---------------------------------------------------------------------------
+
+_LAYER_LINEARS = ("q_proj", "k_proj", "v_proj", "o_proj",
+                  "gate_proj", "up_proj", "down_proj")
+
+
+def linear_shapes(cfg: LlamaConfig) -> Dict[str, Tuple[int, int]]:
+    """(out, in) shapes of every per-layer linear — single source of truth
+    shared by init_params and the synthetic benchmark params."""
+    hd, h = cfg.head_dim, cfg.hidden_size
+    kvh = cfg.num_key_value_heads * hd
+    qh = cfg.num_attention_heads * hd
+    return {
+        "q_proj": (qh, h), "k_proj": (kvh, h), "v_proj": (kvh, h),
+        "o_proj": (h, qh),
+        "gate_proj": (cfg.intermediate_size, h),
+        "up_proj": (cfg.intermediate_size, h),
+        "down_proj": (h, cfg.intermediate_size),
+    }
+
+
+def init_params(cfg: LlamaConfig, seed: int = 0,
+                dtype=jnp.bfloat16) -> Dict[str, Any]:
+    """Random-init params (tests / benchmarks without checkpoints)."""
+    key = jax.random.PRNGKey(seed)
+    h = cfg.hidden_size
+    shapes = linear_shapes(cfg)
+    L = cfg.num_hidden_layers
+
+    def mk(key, shape, scale=None):
+        scale = scale or (1.0 / np.sqrt(shape[-1]))
+        return (jax.random.normal(key, shape, jnp.float32)
+                * scale).astype(dtype)
+
+    keys = jax.random.split(key, 3 + len(shapes))
+    layers = {}
+    for i, (name, shape) in enumerate(shapes.items()):
+        layers[name] = {"w": mk(keys[i], (L,) + shape)}
+    layers["input_layernorm"] = jnp.ones((L, h), dtype)
+    layers["post_attention_layernorm"] = jnp.ones((L, h), dtype)
+    params = {
+        "embed_tokens": mk(keys[-3], (cfg.vocab_size, h), 0.02),
+        "norm": jnp.ones((h,), dtype),
+        "layers": layers,
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = {"w": mk(keys[-2], (cfg.vocab_size, h))}
+    return params
+
+
+def quantize_params(params: Dict[str, Any], qtype: str = "sym_int4",
+                    quantize_lm_head: bool = False) -> Dict[str, Any]:
+    """ggml-quantize every decoder linear (stacked per layer), keeping
+    norms/embeddings in bf16 (matching the reference's default)."""
+    from bigdl_tpu.llm.ggml.quantize import quantize
+
+    if qtype != "sym_int4":
+        raise NotImplementedError(
+            "the scanned decoder path implements q4_0 (sym_int4); other "
+            "qtypes are available through LowBitLinear module surgery")
+    out = dict(params)
+    layers = dict(params["layers"])
+    for name in _LAYER_LINEARS:
+        w = np.asarray(layers[name]["w"], np.float32)   # (L, N, K)
+        qs, ss = [], []
+        for l in range(w.shape[0]):
+            qd = quantize(w[l], qtype)
+            qs.append(qd["q"])
+            ss.append(qd["scale"])
+        # NOTE: no "qtype" string key here — the stacked layer pytree is
+        # scanned, so every leaf must be an L-leading array
+        layers[name] = {"q": jnp.asarray(np.stack(qs)),
+                        "scale": jnp.asarray(np.stack(ss))}
+    out["layers"] = layers
+    if quantize_lm_head and "lm_head" in out:
+        qd = quantize(np.asarray(out["lm_head"]["w"], np.float32), qtype)
+        out["lm_head"] = {"q": jnp.asarray(qd["q"]),
+                          "scale": jnp.asarray(qd["scale"]), "qtype": qtype}
+    return out
+
+
+def param_pspecs(params: Dict[str, Any]) -> Dict[str, Any]:
+    """Tensor-parallel PartitionSpecs over the ``model`` axis.
+
+    Row-sharded (output dim): q/k/v, gate/up (+ their q4 planes & scales).
+    Col-sharded (input dim): o_proj, down_proj. Embed/lm_head row-sharded
+    over vocab. Norms replicated. XLA inserts the two allreduces per layer
+    (after o_proj and down_proj) — the standard Megatron TP pattern.
+    """
+    ROW = {"q_proj", "k_proj", "v_proj", "gate_proj", "up_proj"}
+
+    def spec_for(path, leaf):
+        keys = [str(getattr(p, "key", "")) for p in path]
+        stacked = "layers" in keys
+        d0 = 1 if stacked else 0            # skip the layer-stack dim
+        name = next((k for k in keys if k in ROW
+                     or k in ("o_proj", "down_proj", "lm_head",
+                              "embed_tokens")), None)
+        if name is None or leaf.ndim <= d0:
+            return P()
+        spec = [None] * leaf.ndim
+        if name in ROW or name in ("lm_head", "embed_tokens"):
+            spec[d0] = "model"               # shard N/vocab dim
+        else:
+            # o/down: shard K dim; for packed q4 (N, K/2) that's dim d0+1
+            if leaf.ndim > d0 + 1:
+                spec[d0 + 1] = "model"
+            else:
+                spec[d0] = None
+        return P(*spec)
+
+    return jax.tree_util.tree_map_with_path(spec_for, params)
+
+
+# ---------------------------------------------------------------------------
+# compute
+# ---------------------------------------------------------------------------
+
+def _linear(wd: Dict[str, Any], x: jnp.ndarray) -> jnp.ndarray:
+    """Dense or quantized matmul: x (..., K) → (..., N)."""
+    if "w" in wd:
+        return x @ wd["w"].T.astype(x.dtype)
+    qtype = wd.get("qtype", "sym_int4")
+    shape = x.shape
+    x2 = x.reshape(-1, shape[-1])
+    if qtype == "sym_int4" and jax.default_backend() == "tpu":
+        from bigdl_tpu.llm.kernels import int4_matmul
+        y = int4_matmul(x2, wd["q"], wd["scale"], out_dtype=x.dtype)
+    else:
+        y = x2 @ _dequant_q4(wd, x.dtype).T
+    return y.reshape(shape[:-1] + (y.shape[-1],))
+
+
+def _dequant_q4(wd, dtype):
+    from bigdl_tpu.llm.ggml.quantize import QK
+    packed, scale = wd["q"], wd["scale"].astype(jnp.float32)
+    n = packed.shape[0]
+    lo = (packed & 0xF).astype(jnp.int32)
+    hi = (packed >> 4).astype(jnp.int32)
+    q = jnp.stack([lo, hi], axis=-1).reshape(n, -1)
+    nb = scale.shape[1]
+    w = ((q - 8).astype(jnp.float32).reshape(n, nb, QK)
+         * scale[..., None])
+    return w.reshape(n, -1).astype(dtype)
+
+
+def rms_norm(x, w, eps: float):
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+    return (x32 * jax.lax.rsqrt(var + eps)).astype(x.dtype) * w
+
+
+def rope(x, positions, theta: float):
+    """Rotate-half RoPE. x: (B, T, H, D); positions: (B, T) int32."""
+    d = x.shape[-1]
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    ang = positions[..., None].astype(jnp.float32) * inv_freq  # (B,T,D/2)
+    cos = jnp.cos(ang)[:, :, None, :]
+    sin = jnp.sin(ang)[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin,
+                           x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+def init_cache(cfg: LlamaConfig, batch: int, max_len: int,
+               dtype=jnp.bfloat16) -> Dict[str, jnp.ndarray]:
+    shape = (cfg.num_hidden_layers, batch, max_len,
+             cfg.num_key_value_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype),
+            "pos": jnp.zeros((), jnp.int32)}
+
+
+def _attention(q, k_all, v_all, q_positions, kv_len_mask, cfg):
+    """q: (B, Tq, Hq, D); k_all/v_all: (B, S, Hkv, D) (full cache window).
+    kv_len_mask: (B, S) True where the cache slot is valid.
+    Causal: slot position s attends iff s <= q_position."""
+    b, tq, hq, d = q.shape
+    rep = hq // k_all.shape[2]
+    k_all = jnp.repeat(k_all, rep, axis=2)
+    v_all = jnp.repeat(v_all, rep, axis=2)
+    logits = jnp.einsum("bqhd,bshd->bhqs", q, k_all,
+                        preferred_element_type=jnp.float32) / np.sqrt(d)
+    s = k_all.shape[1]
+    slot = jnp.arange(s)[None, None, None, :]              # (1,1,1,S)
+    qpos = q_positions[:, None, :, None]                   # (B,1,Tq,1)
+    mask = (slot <= qpos) & kv_len_mask[:, None, None, :]
+    logits = jnp.where(mask, logits, -1e30)
+    p = jax.nn.softmax(logits, axis=-1).astype(v_all.dtype)
+    out = jnp.einsum("bhqs,bshd->bqhd", p, v_all)
+    return out.reshape(b, tq, hq * d)
+
+
+def forward(params: Dict[str, Any], cfg: LlamaConfig,
+            tokens: jnp.ndarray, cache: Dict[str, jnp.ndarray],
+            positions: jnp.ndarray) -> Tuple[jnp.ndarray, Dict]:
+    """One forward pass over ``tokens`` (B, T) writing kv at
+    ``positions`` (B, T); returns (logits (B, T, V), new_cache).
+
+    Works for both prefill (T = prompt len) and decode (T = 1); the whole
+    body jits once per T.
+    """
+    x = params["embed_tokens"][tokens]                     # (B, T, H)
+    start = cache["pos"]
+    s_max = cache["k"].shape[2]
+    valid = jnp.arange(s_max)[None, :] < (start + tokens.shape[1])
+
+    def layer_step(carry, inputs):
+        x, = carry
+        lp, k_cache, v_cache = inputs
+        h = rms_norm(x, lp["input_layernorm"], cfg.rms_norm_eps)
+        b, t, _ = h.shape
+        q = _linear(lp["q_proj"], h).reshape(
+            b, t, cfg.num_attention_heads, cfg.head_dim)
+        k = _linear(lp["k_proj"], h).reshape(
+            b, t, cfg.num_key_value_heads, cfg.head_dim)
+        v = _linear(lp["v_proj"], h).reshape(
+            b, t, cfg.num_key_value_heads, cfg.head_dim)
+        q = rope(q, positions, cfg.rope_theta)
+        k = rope(k, positions, cfg.rope_theta)
+        k_cache = jax.lax.dynamic_update_slice(
+            k_cache, k.astype(k_cache.dtype), (0, start, 0, 0))
+        v_cache = jax.lax.dynamic_update_slice(
+            v_cache, v.astype(v_cache.dtype), (0, start, 0, 0))
+        attn = _attention(q, k_cache, v_cache, positions, valid, cfg)
+        x = x + _linear(lp["o_proj"], attn)
+        h2 = rms_norm(x, lp["post_attention_layernorm"], cfg.rms_norm_eps)
+        gate = jax.nn.silu(_linear(lp["gate_proj"], h2).astype(jnp.float32))
+        up = _linear(lp["up_proj"], h2).astype(jnp.float32)
+        x = x + _linear(lp["down_proj"], (gate * up).astype(x.dtype))
+        return (x,), (k_cache, v_cache)
+
+    (x,), (k_new, v_new) = jax.lax.scan(
+        layer_step, (x,), (params["layers"], cache["k"], cache["v"]))
+    x = rms_norm(x, params["norm"], cfg.rms_norm_eps)
+    head = params.get("lm_head")
+    if head is None:
+        logits = x @ params["embed_tokens"].T.astype(x.dtype)
+    else:
+        logits = _linear(head, x)
+    new_cache = {"k": k_new, "v": v_new,
+                 "pos": start + tokens.shape[1]}
+    return logits.astype(jnp.float32), new_cache
+
+
+# ---------------------------------------------------------------------------
+# generation facade
+# ---------------------------------------------------------------------------
+
+class LlamaForCausalLM:
+    """Generation driver (ref: the stock HF generate loop the reference
+    keeps, with our compiled prefill/decode steps underneath)."""
+
+    def __init__(self, cfg: LlamaConfig, params: Dict[str, Any],
+                 max_cache_len: int = 512):
+        self.config = cfg
+        self.params = params
+        self.max_cache_len = min(max_cache_len, cfg.max_position_embeddings)
+        self._prefill = jax.jit(functools.partial(forward, cfg=cfg))
+        self._decode = jax.jit(functools.partial(forward, cfg=cfg))
+
+    @classmethod
+    def from_config(cls, cfg: LlamaConfig, seed: int = 0,
+                    load_in_low_bit: Optional[str] = None,
+                    max_cache_len: int = 512) -> "LlamaForCausalLM":
+        params = init_params(cfg, seed)
+        if load_in_low_bit:
+            params = quantize_params(params, load_in_low_bit)
+        return cls(cfg, params, max_cache_len)
+
+    def quantize(self, qtype: str = "sym_int4") -> "LlamaForCausalLM":
+        self.params = quantize_params(self.params, qtype)
+        return self
+
+    def shard(self, mesh) -> "LlamaForCausalLM":
+        """Place params on a mesh with TP PartitionSpecs."""
+        from jax.sharding import NamedSharding
+
+        specs = param_pspecs(self.params)
+        self.params = jax.tree_util.tree_map(
+            lambda a, s: jax.device_put(a, NamedSharding(mesh, s)),
+            self.params, specs)
+        return self
+
+    def __call__(self, tokens, cache=None, positions=None):
+        b, t = tokens.shape
+        if cache is None:
+            cache = init_cache(self.config, b, self.max_cache_len)
+        if positions is None:
+            base = jnp.asarray(cache["pos"])
+            positions = base + jnp.broadcast_to(jnp.arange(t), (b, t))
+        return self._prefill(self.params, tokens=jnp.asarray(tokens),
+                             cache=cache, positions=positions)
+
+    def generate(self, input_ids, max_new_tokens: int = 32,
+                 do_sample: bool = False, temperature: float = 1.0,
+                 top_k: int = 0, eos_token_id: Optional[int] = None,
+                 seed: int = 0):
+        """Greedy/sampled autoregressive decode. input_ids: (B, T0)."""
+        tokens = jnp.asarray(np.asarray(input_ids), jnp.int32)
+        b, t0 = tokens.shape
+        if t0 + max_new_tokens > self.max_cache_len:
+            raise ValueError(
+                f"sequence {t0}+{max_new_tokens} exceeds cache "
+                f"{self.max_cache_len}")
+        cache = init_cache(self.config, b, self.max_cache_len)
+        logits, cache = self(tokens, cache)
+        key = jax.random.PRNGKey(seed)
+        out = [tokens]
+        last = logits[:, -1]
+        finished = np.zeros((b,), bool)
+        for _ in range(max_new_tokens):
+            if do_sample:
+                key, sub = jax.random.split(key)
+                scaled = last / max(temperature, 1e-6)
+                if top_k > 0:
+                    kth = jnp.sort(scaled, axis=-1)[:, -top_k][:, None]
+                    scaled = jnp.where(scaled < kth, -1e30, scaled)
+                nxt = jax.random.categorical(sub, scaled)
+            else:
+                nxt = jnp.argmax(last, axis=-1)
+            nxt = nxt.astype(jnp.int32)[:, None]
+            out.append(nxt)
+            if eos_token_id is not None:
+                finished |= np.asarray(nxt[:, 0] == eos_token_id)
+                if finished.all():
+                    break
+            logits, cache = self(nxt, cache)
+            last = logits[:, -1]
+        return np.concatenate([np.asarray(t) for t in out], axis=1)

@@ -1,0 +1,9 @@
+"""HF-style facade + low-bit module surgery (ref: P:llm/transformers)."""
+
+from bigdl_tpu.llm.transformers.low_bit_linear import LowBitLinear
+from bigdl_tpu.llm.transformers.convert import (
+    ggml_convert_low_bit, optimize_model)
+from bigdl_tpu.llm.transformers.model import AutoModelForCausalLM
+
+__all__ = ["LowBitLinear", "ggml_convert_low_bit", "optimize_model",
+           "AutoModelForCausalLM"]

@@ -1,0 +1,44 @@
+"""Module surgery: replace Linear with LowBitLinear (ref:
+P:llm/transformers/convert.py — ``ggml_convert_low_bit`` recursive
+replacement + ``optimize_model``)."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from bigdl_tpu.llm.transformers.low_bit_linear import LowBitLinear
+from bigdl_tpu.nn.layers.linear import Linear
+from bigdl_tpu.nn.module import Module
+
+
+def ggml_convert_low_bit(model: Module, qtype: str = "sym_int4",
+                         modules_to_not_convert:
+                         Optional[Sequence[str]] = None) -> Module:
+    """Recursively swap every nn.Linear for a quantized LowBitLinear.
+
+    ``modules_to_not_convert``: names to skip (the reference skips lm_head
+    by default for quality; pass e.g. ``["lm_head"]``)."""
+    skip = set(modules_to_not_convert or ())
+
+    def walk(mod: Module):
+        for key, child in list(mod._modules.items()):
+            if isinstance(child, Linear) and not \
+                    isinstance(child, LowBitLinear):
+                if child.name in skip or key in skip:
+                    continue
+                low = LowBitLinear.from_linear(child, qtype)
+                mod._modules[key] = low
+                if getattr(mod, key, None) is child:
+                    object.__setattr__(mod, key, low)
+            else:
+                walk(child)
+
+    walk(model)
+    return model
+
+
+def optimize_model(model: Module, low_bit: str = "sym_int4",
+                   **kwargs) -> Module:
+    """Public entry (ref: bigdl.llm.optimize_model) — quantize an arbitrary
+    model built on our nn."""
+    return ggml_convert_low_bit(model, low_bit, **kwargs)

@@ -1,0 +1,118 @@
+"""LowBitLinear — quantized drop-in for nn.Linear.
+
+Reference: P:llm/transformers/low_bit_linear.py (``LowBitLinear(nn.Linear)``
+holding ``FP4Params`` ggml-quantized weights, forwarding through native
+int4 matvec). Here the weight lives as packed uint8 + fp16 scales in the
+module's param tree and forward dispatches to the Pallas kernel on TPU
+(jnp dequant-matmul elsewhere — same math, XLA fuses it)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.llm.ggml.quantize import QK, quantize
+from bigdl_tpu.nn.module import TensorModule
+
+
+def _use_pallas() -> bool:
+    return jax.default_backend() == "tpu"
+
+
+class LowBitLinear(TensorModule):
+    """y = x @ dequant(W)^T + b with ggml-block-quantized W."""
+
+    def __init__(self, input_size: int, output_size: int,
+                 qtype: str = "sym_int4", with_bias: bool = False,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.input_size = input_size
+        self.output_size = output_size
+        self.qtype = qtype
+        self.with_bias = with_bias
+
+    @classmethod
+    def from_linear(cls, linear, qtype: str = "sym_int4") -> "LowBitLinear":
+        """Quantize an nn.Linear's weights (ref: FP4Params.quantize)."""
+        w = np.asarray(linear._params["weight"], np.float32)
+        mod = cls(linear.input_size, linear.output_size, qtype,
+                  with_bias="bias" in linear._params,
+                  name=getattr(linear, "name", None))
+        mod.load_quantized(quantize(w, qtype))
+        if mod.with_bias:
+            mod.add_param("bias", jnp.asarray(linear._params["bias"]))
+        return mod
+
+    @classmethod
+    def from_weight(cls, w: np.ndarray, qtype: str = "sym_int4",
+                    bias: Optional[np.ndarray] = None) -> "LowBitLinear":
+        out_f, in_f = w.shape
+        mod = cls(in_f, out_f, qtype, with_bias=bias is not None)
+        mod.load_quantized(quantize(np.asarray(w, np.float32), qtype))
+        if bias is not None:
+            mod.add_param("bias", jnp.asarray(bias))
+        return mod
+
+    def load_quantized(self, qdict):
+        for k, v in qdict.items():
+            if k == "qtype":
+                assert v == self.qtype, (v, self.qtype)
+                continue
+            # quantized planes are constants, not trainable: store as state
+            self.add_state(k, v)
+
+    def _apply(self, params, states, x, *, training, rng):
+        orig_shape = x.shape
+        x2 = x.reshape(-1, orig_shape[-1])
+        qtype = self.qtype
+
+        if qtype == "sym_int4" and _use_pallas():
+            from bigdl_tpu.llm.kernels import int4_matmul
+            y = int4_matmul(x2, states["q"], states["scale"],
+                            out_dtype=x.dtype)
+        else:
+            w = self._dequant(states, x.dtype)
+            y = x2 @ w.T
+        if self.with_bias:
+            y = y + params["bias"]
+        return y.reshape(orig_shape[:-1] + (self.output_size,))
+
+    def _dequant(self, states, dtype):
+        """jnp dequant (XLA path / non-int4 qtypes)."""
+        qtype = self.qtype
+        n = self.output_size
+        if qtype in ("bf16", "fp8"):
+            return states["q"].astype(dtype)
+        scale = states["scale"].astype(jnp.float32)
+        nb = scale.shape[1]
+        if qtype == "sym_int8":
+            q = states["q"].reshape(n, nb, QK).astype(jnp.float32)
+            return (q * scale[..., None]).reshape(n, -1).astype(dtype)
+        if qtype == "sym_int5":
+            q = states["q"].reshape(n, nb, QK).astype(jnp.float32) - 16.0
+            return (q * scale[..., None]).reshape(n, -1).astype(dtype)
+        packed = states["q"]
+        lo = (packed & 0xF).astype(jnp.int32)
+        hi = (packed >> 4).astype(jnp.int32)
+        q = jnp.stack([lo, hi], axis=-1).reshape(n, -1)
+        if qtype == "sym_int4":
+            w = (q - 8).astype(jnp.float32).reshape(n, nb, QK) \
+                * scale[..., None]
+        elif qtype == "asym_int4":
+            zero = states["zero"].astype(jnp.float32)
+            w = q.astype(jnp.float32).reshape(n, nb, QK) * scale[..., None] \
+                + zero[..., None]
+        elif qtype in ("nf4", "fp4"):
+            from bigdl_tpu.llm.ggml.quantize import FP4_CODE, NF4_CODE
+            code = jnp.asarray(NF4_CODE if qtype == "nf4" else FP4_CODE)
+            w = code[q].reshape(n, nb, QK) * scale[..., None]
+        else:
+            raise ValueError(f"unknown qtype {qtype!r}")
+        return w.reshape(n, -1).astype(dtype)
+
+    def __repr__(self):
+        return (f"LowBitLinear({self.input_size} -> {self.output_size}, "
+                f"{self.qtype})")

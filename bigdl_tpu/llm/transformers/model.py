@@ -1,0 +1,106 @@
+"""AutoModelForCausalLM facade (ref: P:llm/transformers/model.py — the
+patched ``from_pretrained(load_in_4bit=True)`` entry that is bigdl-llm's
+public API).
+
+Loading paths:
+- HF checkpoint dir / hub id (requires the baked-in ``transformers``):
+  config + weights are read via torch on CPU, transposed into the jax
+  Llama layout, then ggml-quantized.
+- ``LlamaConfig`` instance (or ``config=``): random-init weights —
+  the test/benchmark path (the reference's tests use tiny dummy ckpts).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from bigdl_tpu.llm.models.llama import (
+    LlamaConfig, LlamaForCausalLM, init_params, quantize_params)
+
+
+def _hf_to_params(model, cfg: LlamaConfig) -> Dict[str, Any]:
+    """torch LlamaForCausalLM state_dict → our stacked jax layout."""
+    import jax.numpy as jnp
+
+    sd = {k: v.detach().cpu().float().numpy()
+          for k, v in model.state_dict().items()}
+    L = cfg.num_hidden_layers
+
+    def stack(fmt: str) -> np.ndarray:
+        return np.stack([sd[fmt.format(l)] for l in range(L)])
+
+    layers = {
+        "q_proj": {"w": jnp.asarray(
+            stack("model.layers.{}.self_attn.q_proj.weight"),
+            jnp.bfloat16)},
+        "k_proj": {"w": jnp.asarray(
+            stack("model.layers.{}.self_attn.k_proj.weight"),
+            jnp.bfloat16)},
+        "v_proj": {"w": jnp.asarray(
+            stack("model.layers.{}.self_attn.v_proj.weight"),
+            jnp.bfloat16)},
+        "o_proj": {"w": jnp.asarray(
+            stack("model.layers.{}.self_attn.o_proj.weight"),
+            jnp.bfloat16)},
+        "gate_proj": {"w": jnp.asarray(
+            stack("model.layers.{}.mlp.gate_proj.weight"), jnp.bfloat16)},
+        "up_proj": {"w": jnp.asarray(
+            stack("model.layers.{}.mlp.up_proj.weight"), jnp.bfloat16)},
+        "down_proj": {"w": jnp.asarray(
+            stack("model.layers.{}.mlp.down_proj.weight"), jnp.bfloat16)},
+        "input_layernorm": jnp.asarray(
+            stack("model.layers.{}.input_layernorm.weight"), jnp.bfloat16),
+        "post_attention_layernorm": jnp.asarray(
+            stack("model.layers.{}.post_attention_layernorm.weight"),
+            jnp.bfloat16),
+    }
+    params = {
+        "embed_tokens": jnp.asarray(sd["model.embed_tokens.weight"],
+                                    jnp.bfloat16),
+        "norm": jnp.asarray(sd["model.norm.weight"], jnp.bfloat16),
+        "layers": layers,
+    }
+    if "lm_head.weight" in sd and not cfg.tie_word_embeddings:
+        params["lm_head"] = {"w": jnp.asarray(sd["lm_head.weight"],
+                                              jnp.bfloat16)}
+    return params
+
+
+class AutoModelForCausalLM:
+    """ref API: AutoModelForCausalLM.from_pretrained(path,
+    load_in_4bit=True | load_in_low_bit="sym_int4")."""
+
+    @staticmethod
+    def from_pretrained(pretrained_model_name_or_path=None,
+                        load_in_4bit: bool = False,
+                        load_in_low_bit: Optional[str] = None,
+                        config: Optional[LlamaConfig] = None,
+                        max_cache_len: int = 512,
+                        seed: int = 0,
+                        **kwargs) -> LlamaForCausalLM:
+        qtype = load_in_low_bit or ("sym_int4" if load_in_4bit else None)
+
+        if isinstance(pretrained_model_name_or_path, LlamaConfig):
+            config = pretrained_model_name_or_path
+            pretrained_model_name_or_path = None
+
+        if pretrained_model_name_or_path is None:
+            cfg = config or LlamaConfig.tiny()
+            params = init_params(cfg, seed)
+        else:
+            import transformers
+
+            hf_cfg = transformers.AutoConfig.from_pretrained(
+                pretrained_model_name_or_path)
+            cfg = LlamaConfig.from_hf(hf_cfg)
+            hf_model = transformers.AutoModelForCausalLM.from_pretrained(
+                pretrained_model_name_or_path, torch_dtype="float32",
+                **kwargs)
+            params = _hf_to_params(hf_model, cfg)
+            del hf_model
+
+        if qtype:
+            params = quantize_params(params, qtype)
+        return LlamaForCausalLM(cfg, params, max_cache_len=max_cache_len)

@@ -1,0 +1,7 @@
+"""Model zoo (ref: scala …/dllib/models/ — lenet, resnet, inception, vgg,
+autoencoder, rnn)."""
+
+from bigdl_tpu.models import (
+    autoencoder, inception, lenet, resnet, rnn, vgg)
+
+__all__ = ["autoencoder", "inception", "lenet", "resnet", "rnn", "vgg"]

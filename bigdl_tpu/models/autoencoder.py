@@ -1,0 +1,16 @@
+"""MNIST autoencoder (ref: .../dllib/models/autoencoder/Autoencoder.scala —
+784 → 32 → 784 MLP with sigmoid reconstruction, trained with MSE)."""
+
+from __future__ import annotations
+
+import bigdl_tpu.nn as nn
+
+
+def build_model(class_num: int = 32) -> nn.Sequential:
+    """``class_num`` is the bottleneck width (reference keeps this name)."""
+    return (nn.Sequential()
+            .add(nn.Reshape([28 * 28]))
+            .add(nn.Linear(28 * 28, class_num))
+            .add(nn.ReLU())
+            .add(nn.Linear(class_num, 28 * 28))
+            .add(nn.Sigmoid()))

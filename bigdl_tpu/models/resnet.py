@@ -1,0 +1,123 @@
+"""ResNet (ref: .../dllib/models/resnet/ResNet.scala — CIFAR-10 basic-block
+variants and ImageNet bottleneck variants incl. ResNet-50, BASELINE
+config 2).
+
+The reference builds residual blocks as ConcatTable(path, shortcut) →
+CAddTable → ReLU; the same composition is used here (it jits into one
+fused XLA program, so the Table plumbing costs nothing at runtime).
+"""
+
+from __future__ import annotations
+
+import bigdl_tpu.nn as nn
+
+
+def conv_bn(n_in: int, n_out: int, k: int, stride: int = 1,
+            pad: int = -1, relu: bool = True) -> nn.Sequential:
+    seq = (nn.Sequential()
+           .add(nn.SpatialConvolution(n_in, n_out, k, k, stride, stride,
+                                      pad, pad, with_bias=False))
+           .add(nn.SpatialBatchNormalization(n_out)))
+    if relu:
+        seq.add(nn.ReLU())
+    return seq
+
+
+def _shortcut(n_in: int, n_out: int, stride: int) -> nn.Module:
+    if n_in != n_out or stride != 1:
+        # type-B projection shortcut (1x1 conv + BN), the reference default
+        return (nn.Sequential()
+                .add(nn.SpatialConvolution(n_in, n_out, 1, 1, stride, stride,
+                                           0, 0, with_bias=False))
+                .add(nn.SpatialBatchNormalization(n_out)))
+    return nn.Identity()
+
+
+def basic_block(n_in: int, n_out: int, stride: int = 1) -> nn.Sequential:
+    path = (nn.Sequential()
+            .add(conv_bn(n_in, n_out, 3, stride))
+            .add(conv_bn(n_out, n_out, 3, 1, relu=False)))
+    return (nn.Sequential()
+            .add(nn.ConcatTable().add(path).add(_shortcut(n_in, n_out,
+                                                          stride)))
+            .add(nn.CAddTable())
+            .add(nn.ReLU()))
+
+
+def bottleneck(n_in: int, n_mid: int, stride: int = 1,
+               expansion: int = 4) -> nn.Sequential:
+    n_out = n_mid * expansion
+    path = (nn.Sequential()
+            .add(conv_bn(n_in, n_mid, 1, 1, 0))
+            .add(conv_bn(n_mid, n_mid, 3, stride))
+            .add(conv_bn(n_mid, n_out, 1, 1, 0, relu=False)))
+    return (nn.Sequential()
+            .add(nn.ConcatTable().add(path).add(_shortcut(n_in, n_out,
+                                                          stride)))
+            .add(nn.CAddTable())
+            .add(nn.ReLU()))
+
+
+def resnet_cifar(depth: int = 20, class_num: int = 10) -> nn.Sequential:
+    """CIFAR-10 ResNet (ref: ResNet.apply with dataSet=CIFAR-10): depth =
+    6n+2 basic blocks over 16/32/64 channels on 32x32 inputs."""
+    if (depth - 2) % 6 != 0:
+        raise ValueError("cifar resnet depth must be 6n+2")
+    n = (depth - 2) // 6
+    model = nn.Sequential().add(conv_bn(3, 16, 3, 1))
+    chans = [(16, 16, 1), (16, 32, 2), (32, 64, 2)]
+    for c_in, c_out, stride in chans:
+        model.add(basic_block(c_in, c_out, stride))
+        for _ in range(n - 1):
+            model.add(basic_block(c_out, c_out, 1))
+    return (model
+            .add(nn.GlobalAveragePooling2D())
+            .add(nn.Linear(64, class_num))
+            .add(nn.LogSoftMax()))
+
+
+_IMAGENET_CFG = {
+    50: (bottleneck, (3, 4, 6, 3)),
+    101: (bottleneck, (3, 4, 23, 3)),
+    152: (bottleneck, (3, 8, 36, 3)),
+    18: (basic_block, (2, 2, 2, 2)),
+    34: (basic_block, (3, 4, 6, 3)),
+}
+
+
+def resnet_imagenet(depth: int = 50, class_num: int = 1000) -> nn.Sequential:
+    """ImageNet ResNet (ref: ResNet.apply with dataSet=ImageNet). 224x224
+    NCHW input; depth 50 is the BASELINE north-star training model."""
+    if depth not in _IMAGENET_CFG:
+        raise ValueError(f"unsupported depth {depth}")
+    block, stages = _IMAGENET_CFG[depth]
+    expansion = 4 if block is bottleneck else 1
+    model = (nn.Sequential()
+             .add(conv_bn(3, 64, 7, 2))
+             .add(nn.SpatialMaxPooling(3, 3, 2, 2, -1, -1)))
+    n_in = 64
+    width = 64
+    for stage_idx, n_blocks in enumerate(stages):
+        stride = 1 if stage_idx == 0 else 2
+        if block is bottleneck:
+            model.add(block(n_in, width, stride))
+            n_in = width * expansion
+            for _ in range(n_blocks - 1):
+                model.add(block(n_in, width, 1))
+        else:
+            model.add(block(n_in, width, stride))
+            n_in = width
+            for _ in range(n_blocks - 1):
+                model.add(block(n_in, width, 1))
+        width *= 2
+    return (model
+            .add(nn.GlobalAveragePooling2D())
+            .add(nn.Linear(n_in, class_num))
+            .add(nn.LogSoftMax()))
+
+
+def build_model(depth: int = 50, class_num: int = 1000,
+                dataset: str = "imagenet") -> nn.Sequential:
+    if dataset == "cifar10":
+        return resnet_cifar(depth if depth != 50 else 20, class_num)
+    return resnet_imagenet(depth, class_num)

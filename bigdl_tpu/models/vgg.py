@@ -1,0 +1,67 @@
+"""VGG (ref: .../dllib/models/vgg/VggForCifar10.scala and the VGG-16
+ImageNet graph used by the reference's examples)."""
+
+from __future__ import annotations
+
+import bigdl_tpu.nn as nn
+
+
+def _conv_relu(n_in, n_out):
+    return (nn.Sequential()
+            .add(nn.SpatialConvolution(n_in, n_out, 3, 3, 1, 1, 1, 1))
+            .add(nn.ReLU()))
+
+
+def vgg_cifar(class_num: int = 10) -> nn.Sequential:
+    """ref: VggForCifar10 — conv-BN stacks over 32x32 with 512-wide head."""
+    def conv_bn(n_in, n_out):
+        return (nn.Sequential()
+                .add(nn.SpatialConvolution(n_in, n_out, 3, 3, 1, 1, 1, 1))
+                .add(nn.SpatialBatchNormalization(n_out))
+                .add(nn.ReLU()))
+
+    model = nn.Sequential()
+    cfg = [(3, 64), (64, 64), "M", (64, 128), (128, 128), "M",
+           (128, 256), (256, 256), (256, 256), "M",
+           (256, 512), (512, 512), (512, 512), "M",
+           (512, 512), (512, 512), (512, 512), "M"]
+    for c in cfg:
+        if c == "M":
+            model.add(nn.SpatialMaxPooling(2, 2, 2, 2))
+        else:
+            model.add(conv_bn(*c))
+    return (model
+            .add(nn.Flatten())
+            .add(nn.Linear(512, 512))
+            .add(nn.BatchNormalization(512))
+            .add(nn.ReLU())
+            .add(nn.Dropout(0.5))
+            .add(nn.Linear(512, class_num))
+            .add(nn.LogSoftMax()))
+
+
+def vgg16(class_num: int = 1000) -> nn.Sequential:
+    """VGG-16 ImageNet, 224x224 NCHW."""
+    model = nn.Sequential()
+    cfg = [(3, 64), (64, 64), "M", (64, 128), (128, 128), "M",
+           (128, 256), (256, 256), (256, 256), "M",
+           (256, 512), (512, 512), (512, 512), "M",
+           (512, 512), (512, 512), (512, 512), "M"]
+    for c in cfg:
+        if c == "M":
+            model.add(nn.SpatialMaxPooling(2, 2, 2, 2))
+        else:
+            model.add(_conv_relu(*c))
+    return (model
+            .add(nn.Flatten())
+            .add(nn.Linear(512 * 7 * 7, 4096))
+            .add(nn.ReLU())
+            .add(nn.Dropout(0.5))
+            .add(nn.Linear(4096, 4096))
+            .add(nn.ReLU())
+            .add(nn.Dropout(0.5))
+            .add(nn.Linear(4096, class_num))
+            .add(nn.LogSoftMax()))
+
+
+build_model = vgg_cifar

@@ -1,0 +1,133 @@
+"""Graph (DAG) models — ref: nn/Graph.scala, StaticGraph.scala, Node.scala.
+
+The reference builds DAGs of modules via ``layer.inputs(node...)``, executes
+them with a topological forward and reverse-order backward. Here the DAG is
+compiled into one pure ``apply`` (jax traces it; autodiff gives backward),
+matching the reference's StaticGraph semantics. Multi-input nodes receive a
+:class:`Table` (list) of parent outputs, like the reference's Activity
+tables.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import List, Optional, Sequence, Union
+
+from bigdl_tpu.nn.module import Module, _to_jax
+
+
+class Node:
+    """A vertex: a module plus its input edges (ref: utils/Node.scala)."""
+
+    _counter = [0]
+
+    def __init__(self, module: Optional[Module],
+                 inputs: Sequence["Node"] = ()):
+        self.module = module
+        self.inputs = list(inputs)
+        Node._counter[0] += 1
+        base = module.name if module is not None else "input"
+        self.name = f"{base}_node{Node._counter[0]}"
+
+    def __repr__(self):
+        return f"Node({self.name})"
+
+
+def Input(name: Optional[str] = None) -> Node:
+    """Placeholder node (ref: nn/Input.scala)."""
+    n = Node(None)
+    if name:
+        n.name = name
+    return n
+
+
+def _node_inputs(module_or_node, *nodes):
+    """BigDL's ``layer.inputs(...)`` — attach a module to parent nodes."""
+    flat: List[Node] = []
+    for x in nodes:
+        if isinstance(x, (list, tuple)):
+            flat.extend(x)
+        else:
+            flat.append(x)
+    return Node(module_or_node, flat)
+
+
+# attach .inputs to Module for reference-parity construction style
+def _module_inputs(self, *nodes):
+    return _node_inputs(self, *nodes)
+
+
+Module.inputs = _module_inputs  # type: ignore[attr-defined]
+
+
+class Graph(Module):
+    """DAG container (ref: nn/StaticGraph.scala).
+
+    ``Graph(inputs=[node...], outputs=[node...])``. Submodules register
+    under their node names; execution is a topological sweep captured in
+    the pure ``_apply`` so the whole DAG jits as one program.
+    """
+
+    def __init__(self, inputs: Union[Node, Sequence[Node]],
+                 outputs: Union[Node, Sequence[Node]],
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.input_nodes = [inputs] if isinstance(inputs, Node) else \
+            list(inputs)
+        self.output_nodes = [outputs] if isinstance(outputs, Node) else \
+            list(outputs)
+        self._order = self._topo_sort()
+        # register modules so params/states nest under node names
+        for node in self._order:
+            if node.module is not None:
+                self._modules[node.name] = node.module
+
+    def _topo_sort(self) -> List[Node]:
+        seen = OrderedDict()
+
+        def visit(node, stack):
+            if node in stack:
+                raise ValueError("graph contains a cycle")
+            if node in seen:
+                return
+            for p in node.inputs:
+                visit(p, stack + [node])
+            seen[node] = True
+
+        for out in self.output_nodes:
+            visit(out, [])
+        for inp in self.input_nodes:
+            if inp not in seen:
+                raise ValueError(
+                    f"input node {inp.name} unreachable from outputs")
+        return list(seen.keys())
+
+    def _apply(self, params, states, x, *, training, rng):
+        from bigdl_tpu.nn.module import fold_name
+
+        xs = x if isinstance(x, (list, tuple)) else [x]
+        if len(xs) != len(self.input_nodes):
+            raise ValueError(
+                f"graph expects {len(self.input_nodes)} inputs, got "
+                f"{len(xs)}")
+        values = {}
+        new_states = dict(states)
+        for node, xv in zip(self.input_nodes, xs):
+            values[node] = xv
+        for node in self._order:
+            if node in values:      # an Input node
+                continue
+            parents = [values[p] for p in node.inputs]
+            arg = parents[0] if len(parents) == 1 else list(parents)
+            sub_rng = None if rng is None else fold_name(rng, node.name)
+            y, s2 = node.module.apply(
+                params.get(node.name, {}), states.get(node.name, {}), arg,
+                training=training, rng=sub_rng)
+            if s2:
+                new_states[node.name] = s2
+            values[node] = y
+        outs = [values[n] for n in self.output_nodes]
+        return (outs[0] if len(outs) == 1 else outs), new_states
+
+    def forward(self, x):
+        return super().forward(_to_jax(x))

@@ -1,0 +1,148 @@
+"""Keras-API tests (ref pattern: keras layer specs + fit smoke tests,
+SURVEY.md §4 'Keras-parity')."""
+
+import numpy as np
+import pytest
+
+import bigdl_tpu.keras as K
+from bigdl_tpu.nn.module import set_seed
+
+
+class TestShapeInference:
+    def test_dense_chain(self):
+        m = K.Sequential()
+        m.add(K.Dense(32, activation="relu", input_shape=(16,)))
+        m.add(K.Dense(8))
+        assert m.get_output_shape() == (8,)
+
+    def test_conv_pool_flatten(self):
+        m = K.Sequential()
+        m.add(K.Convolution2D(6, 5, 5, input_shape=(1, 28, 28)))
+        assert m.get_output_shape() == (6, 24, 24)
+        m.add(K.MaxPooling2D((2, 2)))
+        assert m.get_output_shape() == (6, 12, 12)
+        m.add(K.Flatten())
+        assert m.get_output_shape() == (6 * 12 * 12,)
+
+    def test_same_padding(self):
+        m = K.Sequential()
+        m.add(K.Convolution2D(4, 3, 3, border_mode="same",
+                              subsample=(2, 2), input_shape=(3, 32, 32)))
+        assert m.get_output_shape() == (4, 16, 16)
+
+    def test_first_layer_needs_shape(self):
+        m = K.Sequential()
+        with pytest.raises(ValueError):
+            m.add(K.Dense(4))
+
+    def test_rnn_shapes(self):
+        m = K.Sequential()
+        m.add(K.LSTM(7, return_sequences=True, input_shape=(5, 3)))
+        assert m.get_output_shape() == (5, 7)
+        m.add(K.GRU(4))
+        assert m.get_output_shape() == (4,)
+
+    def test_embedding_shape(self):
+        m = K.Sequential()
+        m.add(K.Embedding(100, 8, input_length=10))
+        assert m.get_output_shape() == (10, 8)
+
+
+class TestForwardShapes:
+    @pytest.mark.parametrize("layer,shape", [
+        (lambda: K.Convolution1D(4, 3), (5, 10, 6)),
+        (lambda: K.MaxPooling1D(2), (5, 10, 6)),
+        (lambda: K.AveragePooling1D(2), (5, 10, 6)),
+        (lambda: K.GlobalMaxPooling1D(), (5, 10, 6)),
+        (lambda: K.GlobalAveragePooling1D(), (5, 10, 6)),
+        (lambda: K.ZeroPadding2D((1, 2)), (5, 3, 8, 8)),
+        (lambda: K.UpSampling2D((2, 2)), (5, 3, 8, 8)),
+        (lambda: K.BatchNormalization(), (5, 3, 8, 8)),
+        (lambda: K.Permute((2, 1)), (5, 4, 6)),
+        (lambda: K.RepeatVector(3), (5, 7)),
+        (lambda: K.LeakyReLU(), (5, 7)),
+        (lambda: K.Bidirectional(K.LSTM(4)), (5, 6, 3)),
+        (lambda: K.TimeDistributed(K.Dense(4)), (5, 6, 3)),
+    ])
+    def test_forward_matches_inferred_shape(self, layer, shape):
+        set_seed(0)
+        lay = layer()
+        mod = lay.build(shape[1:])
+        out = mod.forward(np.random.rand(*shape).astype(np.float32))
+        assert tuple(out.shape) == (shape[0],) + tuple(lay.output_shape), \
+            f"{type(lay).__name__}: {out.shape} vs {lay.output_shape}"
+
+
+class TestTraining:
+    def test_mlp_fit_evaluate_predict(self):
+        set_seed(1)
+        rs = np.random.RandomState(0)
+        x = rs.rand(256, 10).astype(np.float32)
+        w = rs.randn(10, 3).astype(np.float32)
+        y = (x @ w).argmax(1).astype(np.int32)  # zero-based labels
+
+        from bigdl_tpu.optim.optim_method import Adam
+        m = K.Sequential()
+        m.add(K.Dense(32, activation="relu", input_shape=(10,)))
+        m.add(K.Dense(3, activation="softmax"))
+        m.compile(optimizer=Adam(learning_rate=0.01),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+        m.fit(x, y, batch_size=32, nb_epoch=40, distributed=False)
+        res = m.evaluate(x, y)
+        assert res[0].result > 0.9, f"accuracy {res[0].result}"
+        pred = m.predict(x[:7])
+        assert pred.shape == (7, 3)
+        np.testing.assert_allclose(pred.sum(1), 1.0, rtol=1e-4)
+        cls = m.predict_classes(x[:7])
+        assert cls.shape == (7,)
+
+    def test_regression_mse(self):
+        set_seed(2)
+        rs = np.random.RandomState(1)
+        x = rs.rand(128, 4).astype(np.float32)
+        y = (x.sum(axis=1, keepdims=True) * 2).astype(np.float32)
+        m = K.Sequential()
+        m.add(K.Dense(16, activation="tanh", input_shape=(4,)))
+        m.add(K.Dense(1))
+        m.compile(optimizer="sgd", loss="mse")
+        m.fit(x, y, batch_size=16, nb_epoch=80, distributed=False)
+        pred = m.predict(x)
+        assert float(np.mean((pred - y) ** 2)) < 0.05
+
+
+class TestFunctionalModel:
+    def test_two_branch_merge(self):
+        set_seed(3)
+        a = K.Input(shape=(8,))
+        b = K.Input(shape=(6,))
+        ha = K.Dense(4, activation="relu")(a)
+        hb = K.Dense(4, activation="relu")(b)
+        joined = K.merge([ha, hb], mode="concat")
+        assert joined.shape == (8,)
+        out = K.Dense(2)(joined)
+        model = K.Model(input=[a, b], output=out)
+        xa = np.random.rand(5, 8).astype(np.float32)
+        xb = np.random.rand(5, 6).astype(np.float32)
+        y = model.module.forward([xa, xb])
+        assert tuple(y.shape) == (5, 2)
+
+    def test_merge_sum_and_residual(self):
+        set_seed(4)
+        a = K.Input(shape=(6,))
+        h = K.Dense(6, activation="relu")(a)
+        s = K.merge([a, h], mode="sum")
+        model = K.Model(input=a, output=s)
+        x = np.random.rand(3, 6).astype(np.float32)
+        y = model.module.forward(x)
+        assert tuple(y.shape) == (3, 6)
+
+    def test_graph_cycle_detection(self):
+        from bigdl_tpu.nn.graph import Graph, Input as GInput, Node
+        import bigdl_tpu.nn as nn
+        a = GInput()
+        lin = nn.Linear(4, 4)
+        n1 = lin.inputs(a)
+        n1.inputs.append(n1)  # malformed self-loop
+        with pytest.raises(ValueError):
+            Graph([a], [n1])
