@@ -1,0 +1,11 @@
+"""bigdl_tpu.chronos — time-series toolkit (ref: python/chronos —
+TSDataset, forecasters, detectors; BASELINE config 3 = TCN/Seq2Seq)."""
+
+from bigdl_tpu.chronos.data import TSDataset
+from bigdl_tpu.chronos.forecaster import (
+    LSTMForecaster, NBeatsForecaster, Seq2SeqForecaster, TCNForecaster)
+from bigdl_tpu.chronos.detector import AEDetector, ThresholdDetector
+
+__all__ = ["TSDataset", "TCNForecaster", "Seq2SeqForecaster",
+           "LSTMForecaster", "NBeatsForecaster", "ThresholdDetector",
+           "AEDetector"]

@@ -1,0 +1,3 @@
+from bigdl_tpu.chronos.autots.auto_ts import AutoTSEstimator, TSPipeline
+
+__all__ = ["AutoTSEstimator", "TSPipeline"]

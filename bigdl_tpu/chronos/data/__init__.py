@@ -1,0 +1,3 @@
+from bigdl_tpu.chronos.data.tsdataset import TSDataset
+
+__all__ = ["TSDataset"]

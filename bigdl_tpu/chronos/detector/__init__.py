@@ -1,0 +1,4 @@
+from bigdl_tpu.chronos.detector.anomaly import (
+    AEDetector, DBScanDetector, ThresholdDetector)
+
+__all__ = ["ThresholdDetector", "AEDetector", "DBScanDetector"]

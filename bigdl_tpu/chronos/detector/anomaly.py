@@ -1,0 +1,143 @@
+"""Anomaly detectors (ref: P:chronos/detector/anomaly — ThresholdDetector,
+AEDetector, DBScanDetector)."""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+class ThresholdDetector:
+    """ref: ThresholdDetector — absolute bounds or pattern-drift threshold
+    between actual and forecast; fit() can estimate bounds from a normal
+    sample via a ratio-of-outliers target."""
+
+    def __init__(self):
+        self.th: Tuple[float, float] = (-np.inf, np.inf)
+        self.ratio = 0.01
+
+    def set_params(self, threshold: Optional[Tuple[float, float]] = None,
+                   ratio: Optional[float] = None):
+        if threshold is not None:
+            self.th = threshold
+        if ratio is not None:
+            self.ratio = ratio
+        return self
+
+    def fit(self, y: np.ndarray, y_pred: Optional[np.ndarray] = None):
+        """Estimate the residual threshold from normal data."""
+        resid = np.abs(y - y_pred) if y_pred is not None else np.asarray(y)
+        hi = float(np.quantile(resid, 1 - self.ratio))
+        self.th = (-np.inf, hi)
+        return self
+
+    def score(self, y: np.ndarray,
+              y_pred: Optional[np.ndarray] = None) -> np.ndarray:
+        v = np.abs(y - y_pred) if y_pred is not None else np.asarray(y)
+        return v.astype(np.float64)
+
+    def anomaly_indexes(self, y: np.ndarray,
+                        y_pred: Optional[np.ndarray] = None) -> np.ndarray:
+        s = self.score(y, y_pred)
+        lo, hi = self.th
+        return np.where((s < lo) | (s > hi))[0]
+
+
+class AEDetector:
+    """ref: AEDetector — autoencoder reconstruction error over rolled
+    windows; anomaly = error above the (1-ratio) quantile."""
+
+    def __init__(self, roll_len: int = 24, ratio: float = 0.1,
+                 hidden: int = 16, epochs: int = 30, lr: float = 1e-2,
+                 seed: int = 0):
+        self.roll_len = roll_len
+        self.ratio = ratio
+        self.hidden = hidden
+        self.epochs = epochs
+        self.lr = lr
+        self.seed = seed
+        self._model = None
+        self._th = None
+
+    def _windows(self, y: np.ndarray) -> np.ndarray:
+        y = np.asarray(y, np.float32).reshape(-1)
+        n = len(y) - self.roll_len + 1
+        if n <= 0:
+            raise ValueError("series shorter than roll_len")
+        return np.stack([y[i:i + self.roll_len] for i in range(n)])
+
+    def fit(self, y: np.ndarray):
+        import jax
+        import jax.numpy as jnp
+
+        import bigdl_tpu.nn as nn
+        from bigdl_tpu.nn.module import set_seed
+        from bigdl_tpu.optim.optim_method import Adam
+
+        set_seed(self.seed)
+        w = self._windows(y)
+        model = (nn.Sequential()
+                 .add(nn.Linear(self.roll_len, self.hidden))
+                 .add(nn.Tanh())
+                 .add(nn.Linear(self.hidden, self.roll_len)))
+        optim = Adam(learning_rate=self.lr)
+        params = model.parameters_dict()
+        opt_state = optim.init_state(params)
+        xb = jnp.asarray(w)
+
+        @jax.jit
+        def step(p, o):
+            def loss_fn(pp):
+                out, _ = model.apply(pp, {}, xb, training=True,
+                                     rng=jax.random.PRNGKey(0))
+                return jnp.mean((out - xb) ** 2)
+
+            loss, g = jax.value_and_grad(loss_fn)(p)
+            p2, o2 = optim.step(p, g, o, self.lr)
+            return p2, o2, loss
+
+        for _ in range(self.epochs):
+            params, opt_state, _ = step(params, opt_state)
+        model.load_parameters_dict(
+            jax.tree_util.tree_map(np.asarray, params))
+        self._model = model
+        scores = self.score(y)
+        self._th = float(np.quantile(scores, 1 - self.ratio))
+        return self
+
+    def score(self, y: np.ndarray) -> np.ndarray:
+        if self._model is None:
+            raise RuntimeError("fit() first")
+        w = self._windows(y)
+        recon = np.asarray(self._model.evaluate().forward(w))
+        err = ((recon - w) ** 2).mean(axis=1)
+        # per-sample score: max window error covering the point
+        scores = np.zeros(len(np.asarray(y).reshape(-1)))
+        counts = np.zeros_like(scores)
+        for i, e in enumerate(err):
+            scores[i:i + self.roll_len] = np.maximum(
+                scores[i:i + self.roll_len], e)
+            counts[i:i + self.roll_len] += 1
+        return scores
+
+    def anomaly_indexes(self, y: np.ndarray) -> np.ndarray:
+        s = self.score(y)
+        return np.where(s > self._th)[0]
+
+
+class DBScanDetector:
+    """ref: DBScanDetector — sklearn DBSCAN over the series values;
+    anomalies = points labeled as noise."""
+
+    def __init__(self, eps: float = 0.5, min_samples: int = 5):
+        self.eps = eps
+        self.min_samples = min_samples
+
+    def anomaly_indexes(self, y: np.ndarray) -> np.ndarray:
+        from sklearn.cluster import DBSCAN
+
+        y = np.asarray(y, np.float64).reshape(-1, 1)
+        labels = DBSCAN(eps=self.eps,
+                        min_samples=self.min_samples).fit_predict(y)
+        return np.where(labels == -1)[0]

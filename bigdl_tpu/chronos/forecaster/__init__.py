@@ -1,0 +1,8 @@
+from bigdl_tpu.chronos.forecaster.base import BaseForecaster
+from bigdl_tpu.chronos.forecaster.tcn import TCNForecaster
+from bigdl_tpu.chronos.forecaster.seq2seq import Seq2SeqForecaster
+from bigdl_tpu.chronos.forecaster.lstm import LSTMForecaster
+from bigdl_tpu.chronos.forecaster.nbeats import NBeatsForecaster
+
+__all__ = ["BaseForecaster", "TCNForecaster", "Seq2SeqForecaster",
+           "LSTMForecaster", "NBeatsForecaster"]

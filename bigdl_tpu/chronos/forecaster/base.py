@@ -1,0 +1,123 @@
+"""Forecaster contract (ref: P:chronos/forecaster/base_forecaster.py —
+fit/predict/evaluate over numpy or TSDataset, pytorch(-lightning) models
+underneath; here our nn + a jitted Adam train loop)."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.chronos import metric as M
+from bigdl_tpu.optim.optim_method import Adam
+
+
+def _unpack(data) -> Tuple[np.ndarray, np.ndarray]:
+    from bigdl_tpu.chronos.data import TSDataset
+
+    if isinstance(data, TSDataset):
+        return data.to_numpy()
+    x, y = data
+    return np.asarray(x, np.float32), np.asarray(y, np.float32)
+
+
+class BaseForecaster:
+    """fit/predict/evaluate driver. Subclasses implement _build_model."""
+
+    def __init__(self, past_seq_len: int, future_seq_len: int,
+                 input_feature_num: int, output_feature_num: int,
+                 lr: float = 1e-3, loss: str = "mse", seed: int = 0):
+        self.past_seq_len = past_seq_len
+        self.future_seq_len = future_seq_len
+        self.input_feature_num = input_feature_num
+        self.output_feature_num = output_feature_num
+        self.lr = lr
+        from bigdl_tpu.nn.module import set_seed
+        set_seed(seed)
+        self.model = self._build_model()
+        self.criterion = {"mse": nn.MSECriterion,
+                          "mae": nn.AbsCriterion}[loss]()
+        self._fitted = False
+
+    def _build_model(self) -> nn.Module:
+        raise NotImplementedError
+
+    # -- training -------------------------------------------------------------
+    def fit(self, data, epochs: int = 1, batch_size: int = 32,
+            validation_data=None, shuffle: bool = True):
+        x, y = _unpack(data)
+        optim = Adam(learning_rate=self.lr)
+        model, criterion = self.model, self.criterion
+        params = jax.tree_util.tree_map(jnp.asarray, model.parameters_dict())
+        states = jax.tree_util.tree_map(jnp.asarray, model.states_dict())
+        opt_state = optim.init_state(params)
+
+        @jax.jit
+        def step(params, states, opt_state, xb, yb, rng):
+            def loss_fn(p):
+                out, s2 = model.apply(p, states, xb, training=True, rng=rng)
+                return criterion.apply_loss(out, yb), s2
+
+            (loss, s2), g = jax.value_and_grad(loss_fn, has_aux=True)(params)
+            p2, o2 = optim.step(params, g, opt_state, self.lr)
+            return p2, s2, o2, loss
+
+        n = x.shape[0]
+        rs = np.random.RandomState(0)
+        key = jax.random.PRNGKey(0)
+        loss = None
+        for _ in range(epochs):
+            order = rs.permutation(n) if shuffle else np.arange(n)
+            for i in range(0, n - batch_size + 1, batch_size):
+                idx = order[i:i + batch_size]
+                key, sub = jax.random.split(key)
+                params, states, opt_state, loss = step(
+                    params, states, opt_state, jnp.asarray(x[idx]),
+                    jnp.asarray(y[idx]), sub)
+        model.load_parameters_dict(
+            jax.tree_util.tree_map(np.asarray, params))
+        model.load_states_dict(jax.tree_util.tree_map(np.asarray, states))
+        self._fitted = True
+        return float(loss) if loss is not None else None
+
+    # -- inference ------------------------------------------------------------
+    def predict(self, data, batch_size: int = 128) -> np.ndarray:
+        if isinstance(data, tuple):
+            x = np.asarray(data[0], np.float32)
+        else:
+            from bigdl_tpu.chronos.data import TSDataset
+            x = data.to_numpy()[0] if isinstance(data, TSDataset) \
+                else np.asarray(data, np.float32)
+        model = self.model.evaluate()
+        params = model.parameters_dict()
+        states = model.states_dict()
+
+        @jax.jit
+        def fwd(p, s, xb):
+            y, _ = model.apply(p, s, xb, training=False, rng=None)
+            return y
+
+        outs = [np.asarray(fwd(params, states, jnp.asarray(
+            x[i:i + batch_size])))
+            for i in range(0, len(x), batch_size)]
+        return np.concatenate(outs, 0) if outs else np.zeros(
+            (0, self.future_seq_len, self.output_feature_num), np.float32)
+
+    def evaluate(self, data, metrics: Sequence[str] = ("mse",),
+                 batch_size: int = 128):
+        x, y = _unpack(data)
+        pred = self.predict(x, batch_size)
+        return M.evaluate(y, pred, metrics)
+
+    # -- persistence ----------------------------------------------------------
+    def save(self, path: str):
+        self.model.save_module(path)
+        return self
+
+    def load(self, path: str):
+        self.model = nn.Module.load_module(path)
+        self._fitted = True
+        return self

@@ -1,0 +1,7 @@
+"""bigdl_tpu.friesian — recommender toolkit (ref: python/friesian offline
+FeatureTable + scala online recall/ranking services)."""
+
+from bigdl_tpu.friesian.feature import FeatureTable
+from bigdl_tpu.friesian.recall import BruteForceRecall
+
+__all__ = ["FeatureTable", "BruteForceRecall"]

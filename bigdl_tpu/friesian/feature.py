@@ -1,0 +1,184 @@
+"""FeatureTable (ref: P:friesian/feature/table.py — a pyspark-DataFrame
+wrapper with recsys feature engineering verbs; here the frame substrate is
+pandas, the verbs keep the reference names/semantics)."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+import pandas as pd
+
+
+def _as_list(c):
+    return [c] if isinstance(c, str) else list(c)
+
+
+class StringIndex:
+    """Category → id mapping (ref: friesian StringIndex table)."""
+
+    def __init__(self, mapping: pd.DataFrame, col_name: str):
+        self.df = mapping          # columns: [col_name, "id"]
+        self.col_name = col_name
+
+    def to_dict(self) -> Dict:
+        return dict(zip(self.df[self.col_name], self.df["id"]))
+
+
+class FeatureTable:
+    def __init__(self, df: pd.DataFrame):
+        self.df = df.copy()
+
+    # -- io ------------------------------------------------------------------
+    @classmethod
+    def read_csv(cls, path: str, **kwargs) -> "FeatureTable":
+        return cls(pd.read_csv(path, **kwargs))
+
+    @classmethod
+    def read_parquet(cls, path: str, **kwargs) -> "FeatureTable":
+        return cls(pd.read_parquet(path, **kwargs))
+
+    def write_parquet(self, path: str):
+        self.df.to_parquet(path)
+        return self
+
+    # -- basic verbs ---------------------------------------------------------
+    def select(self, *cols) -> "FeatureTable":
+        return FeatureTable(self.df[list(cols)])
+
+    def drop(self, *cols) -> "FeatureTable":
+        return FeatureTable(self.df.drop(columns=list(cols)))
+
+    def rename(self, mapping: Dict[str, str]) -> "FeatureTable":
+        return FeatureTable(self.df.rename(columns=mapping))
+
+    def filter(self, condition) -> "FeatureTable":
+        return FeatureTable(self.df[condition(self.df)])
+
+    def fillna(self, value, columns: Union[str, Sequence[str], None]
+               = None) -> "FeatureTable":
+        df = self.df.copy()
+        cols = _as_list(columns) if columns else df.columns
+        df[cols] = df[cols].fillna(value)
+        return FeatureTable(df)
+
+    def dropna(self, columns=None) -> "FeatureTable":
+        return FeatureTable(self.df.dropna(
+            subset=_as_list(columns) if columns else None))
+
+    def distinct(self) -> "FeatureTable":
+        return FeatureTable(self.df.drop_duplicates())
+
+    def size(self) -> int:
+        return len(self.df)
+
+    def to_pandas(self) -> pd.DataFrame:
+        return self.df.copy()
+
+    # -- recsys feature engineering (ref verbs) ------------------------------
+    def encode_string(self, columns: Union[str, Sequence[str]],
+                      indices: Optional[Sequence[StringIndex]] = None
+                      ) -> Tuple["FeatureTable", List[StringIndex]]:
+        """Map string categories to 1-based int ids (ref: encode_string —
+        id 0 is reserved for OOV/missing)."""
+        cols = _as_list(columns)
+        df = self.df.copy()
+        out_indices = []
+        for i, c in enumerate(cols):
+            if indices is not None:
+                mapping = indices[i].to_dict()
+            else:
+                cats = pd.unique(df[c].dropna())
+                mapping = {v: j + 1 for j, v in enumerate(cats)}
+                out_indices.append(StringIndex(
+                    pd.DataFrame({c: list(mapping), "id":
+                                  list(mapping.values())}), c))
+            df[c] = df[c].map(mapping).fillna(0).astype(np.int64)
+        return FeatureTable(df), (list(indices) if indices is not None
+                                  else out_indices)
+
+    def category_encode(self, columns) -> Tuple["FeatureTable",
+                                                List[StringIndex]]:
+        return self.encode_string(columns)
+
+    def cross_columns(self, crossed_columns: Sequence[Sequence[str]],
+                      bucket_sizes: Sequence[int]) -> "FeatureTable":
+        """Hash-cross column tuples into buckets (ref: cross_columns)."""
+        df = self.df.copy()
+        for cols, bucket in zip(crossed_columns, bucket_sizes):
+            name = "_".join(cols)
+            key = df[cols[0]].astype(str)
+            for c in cols[1:]:
+                key = key + "_" + df[c].astype(str)
+            df[name] = key.map(lambda s: hash(s) % bucket)
+        return FeatureTable(df)
+
+    def min_max_scale(self, columns) -> Tuple["FeatureTable", Dict]:
+        cols = _as_list(columns)
+        df = self.df.copy()
+        stats = {}
+        for c in cols:
+            lo, hi = float(df[c].min()), float(df[c].max())
+            rng = (hi - lo) or 1.0
+            df[c] = (df[c] - lo) / rng
+            stats[c] = (lo, hi)
+        return FeatureTable(df), stats
+
+    def add_negative_samples(self, item_size: int, item_col: str = "item",
+                             label_col: str = "label",
+                             neg_num: int = 1,
+                             seed: int = 0) -> "FeatureTable":
+        """For each positive row, append neg_num rows with random items and
+        label 0 (ref: add_negative_samples; items are 1-based)."""
+        rs = np.random.RandomState(seed)
+        df = self.df.copy()
+        df[label_col] = 1
+        negs = df.loc[df.index.repeat(neg_num)].copy()
+        negs[item_col] = rs.randint(1, item_size + 1, len(negs))
+        negs[label_col] = 0
+        out = pd.concat([df, negs], ignore_index=True)
+        return FeatureTable(out)
+
+    def gen_hist_seq(self, user_col: str, cols: Union[str, Sequence[str]],
+                     sort_col: Optional[str] = None,
+                     min_len: int = 1, max_len: int = 10) -> "FeatureTable":
+        """Per-user rolling history of past items (ref: gen_his_seq)."""
+        cols = _as_list(cols)
+        df = self.df.sort_values(
+            [user_col] + ([sort_col] if sort_col else []))
+        rows = []
+        for _, g in df.groupby(user_col, sort=False):
+            vals = {c: g[c].tolist() for c in cols}
+            for i in range(len(g)):
+                if i < min_len:
+                    continue
+                rec = g.iloc[i].to_dict()
+                for c in cols:
+                    rec[f"{c}_hist_seq"] = vals[c][max(0, i - max_len):i]
+                rows.append(rec)
+        return FeatureTable(pd.DataFrame(rows))
+
+    def pad(self, columns, seq_len: int = 10,
+            mask_token: int = 0) -> "FeatureTable":
+        cols = _as_list(columns)
+        df = self.df.copy()
+        for c in cols:
+            df[c] = df[c].map(
+                lambda s: (list(s)[:seq_len]
+                           + [mask_token] * max(0, seq_len - len(s))))
+        return FeatureTable(df)
+
+    def apply(self, in_col: str, out_col: str, fn) -> "FeatureTable":
+        df = self.df.copy()
+        df[out_col] = df[in_col].map(fn)
+        return FeatureTable(df)
+
+    def join(self, other: "FeatureTable", on: Union[str, Sequence[str]],
+             how: str = "inner") -> "FeatureTable":
+        return FeatureTable(self.df.merge(other.df, on=on, how=how))
+
+    def group_by(self, columns, agg: Dict[str, str]) -> "FeatureTable":
+        out = self.df.groupby(_as_list(columns)).agg(agg).reset_index()
+        out.columns = ["_".join(c) if isinstance(c, tuple) else c
+                       for c in out.columns]
+        return FeatureTable(out)

@@ -1,0 +1,60 @@
+"""Vector recall (ref: friesian online recall service — faiss similarity
+search behind gRPC). TPU-native design: brute-force inner-product top-k
+IS the fast path on the MXU — a (batch, dim) x (dim, n_items) matmul +
+jax.lax.top_k beats an IVF index for corpus sizes that fit HBM, with
+exact results."""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class BruteForceRecall:
+    def __init__(self, dim: int, metric: str = "ip"):
+        self.dim = dim
+        self.metric = metric
+        self._items = None
+        self._search = None
+
+    def add(self, embeddings: np.ndarray):
+        emb = jnp.asarray(np.asarray(embeddings, np.float32))
+        if self.metric == "l2":
+            self._sq = jnp.sum(emb * emb, axis=1)
+        if self.metric == "cosine":
+            emb = emb / (jnp.linalg.norm(emb, axis=1, keepdims=True)
+                         + 1e-12)
+        self._items = emb
+
+        metric = self.metric
+        sq = getattr(self, "_sq", None)
+        from functools import partial
+
+        @partial(jax.jit, static_argnums=1)
+        def search(q, k):
+            if metric == "l2":
+                scores = -(sq[None, :]
+                           - 2 * (q @ emb.T)
+                           + jnp.sum(q * q, axis=1, keepdims=True))
+            else:
+                qq = q
+                if metric == "cosine":
+                    qq = q / (jnp.linalg.norm(q, axis=1, keepdims=True)
+                              + 1e-12)
+                scores = qq @ emb.T
+            return jax.lax.top_k(scores, k)
+
+        self._search_fn = search
+        return self
+
+    def search(self, queries: np.ndarray,
+               k: int = 10) -> Tuple[np.ndarray, np.ndarray]:
+        """Returns (scores (B, k), indices (B, k))."""
+        if self._items is None:
+            raise RuntimeError("add() embeddings first")
+        q = jnp.asarray(np.atleast_2d(np.asarray(queries, np.float32)))
+        scores, idx = self._search_fn(q, k)
+        return np.asarray(scores), np.asarray(idx)

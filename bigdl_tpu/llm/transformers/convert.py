@@ -20,11 +20,18 @@ def ggml_convert_low_bit(model: Module, qtype: str = "sym_int4",
     by default for quality; pass e.g. ``["lm_head"]``)."""
     skip = set(modules_to_not_convert or ())
 
+    from bigdl_tpu.llm.ggml.quantize import QK
+
     def walk(mod: Module):
         for key, child in list(mod._modules.items()):
             if isinstance(child, Linear) and not \
                     isinstance(child, LowBitLinear):
                 if child.name in skip or key in skip:
+                    continue
+                if qtype not in ("bf16", "fp8") and \
+                        child.input_size % QK != 0:
+                    # block formats need K % 32 == 0 (the reference keeps
+                    # such layers fp too); bf16/fp8 have no block shape
                     continue
                 low = LowBitLinear.from_linear(child, qtype)
                 mod._modules[key] = low

@@ -1,0 +1,11 @@
+"""bigdl_tpu.nano — single-node acceleration toolkit (ref: python/nano:
+Trainer + InferenceOptimizer.quantize/trace over IPEX/ONNX/OpenVINO/INC).
+
+On TPU the acceleration levers are dtype (bf16), quantization (our ggml
+low-bit surgery) and AOT jit — so InferenceOptimizer maps precision
+choices onto those, keeping the reference's API verbs."""
+
+from bigdl_tpu.nano.inference_optimizer import InferenceOptimizer
+from bigdl_tpu.nano.trainer import Trainer
+
+__all__ = ["InferenceOptimizer", "Trainer"]

@@ -1,0 +1,107 @@
+"""InferenceOptimizer (ref: P:nano/pytorch/inference/optimizer.py —
+quantize(precision=int8/bf16, accelerator=onnxruntime/openvino/jit) and
+trace; plus optimize() which tries all pipelines and reports latency)."""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.nn.module import Module
+
+
+class _CompiledModel:
+    """A jitted, possibly re-precisioned forward with the Module API bit
+    users touch (forward/__call__)."""
+
+    def __init__(self, model: Module, dtype=None):
+        self._model = model
+        params = model.parameters_dict()
+        if dtype is not None:
+            params = jax.tree_util.tree_map(
+                lambda a: a.astype(dtype)
+                if a.dtype in (jnp.float32, jnp.float64) else a, params)
+        self._params = params
+        self._states = model.states_dict()
+
+        @jax.jit
+        def fwd(p, s, x):
+            y, _ = model.apply(p, s, x, training=False, rng=None)
+            return y
+
+        self._fwd = fwd
+
+    def forward(self, x):
+        return np.asarray(self._fwd(self._params, self._states,
+                                    jnp.asarray(x)))
+
+    __call__ = forward
+
+
+class InferenceOptimizer:
+    @staticmethod
+    def quantize(model, precision: str = "bf16",
+                 calib_data=None, **kwargs):
+        """precision: bf16 | fp16 | int8 | sym_int4/asym_int4/nf4/fp4.
+
+        int8/int4 run the LowBitLinear surgery (ggml blocks, Pallas
+        kernels); bf16/fp16 cast params (XLA computes in bf16 on MXU)."""
+        model = getattr(model, "module", model)   # keras models
+        if precision in ("bf16",):
+            return _CompiledModel(model, jnp.bfloat16)
+        if precision in ("fp16", "float16"):
+            return _CompiledModel(model, jnp.float16)
+        qtype = {"int8": "sym_int8", "int4": "sym_int4"}.get(
+            precision, precision)
+        from bigdl_tpu.llm.transformers.convert import ggml_convert_low_bit
+        import copy
+
+        qmodel = ggml_convert_low_bit(copy.deepcopy(model), qtype)
+        return _CompiledModel(qmodel)
+
+    @staticmethod
+    def trace(model, accelerator: str = "jit", input_sample=None,
+              **kwargs):
+        """ref: trace(accelerator=jit/onnxruntime/openvino) — here every
+        accelerator is XLA; input_sample warms the compile cache."""
+        model = getattr(model, "module", model)
+        compiled = _CompiledModel(model)
+        if input_sample is not None:
+            compiled.forward(np.asarray(input_sample))
+        return compiled
+
+    @staticmethod
+    def optimize(model, x: np.ndarray,
+                 latency_sample_num: int = 10) -> Dict[str, dict]:
+        """Try the available pipelines, time them, return a report (ref:
+        InferenceOptimizer.optimize's trial table)."""
+        report = {}
+        for name, builder in {
+            "original(jit)": lambda: InferenceOptimizer.trace(model),
+            "bf16": lambda: InferenceOptimizer.quantize(model, "bf16"),
+            "int8": lambda: InferenceOptimizer.quantize(model, "int8"),
+            "int4": lambda: InferenceOptimizer.quantize(model, "sym_int4"),
+        }.items():
+            try:
+                m = builder()
+                m.forward(x)  # compile
+                t0 = time.perf_counter()
+                for _ in range(latency_sample_num):
+                    m.forward(x)
+                dt = (time.perf_counter() - t0) / latency_sample_num
+                report[name] = {"latency_ms": dt * 1000, "model": m,
+                                "status": "successful"}
+            except Exception as e:  # pipeline not applicable to model
+                report[name] = {"status": f"failed: {e}"}
+        return report
+
+    @staticmethod
+    def get_best_model(report: Dict[str, dict]):
+        ok = {k: v for k, v in report.items()
+              if v.get("status") == "successful"}
+        best = min(ok, key=lambda k: ok[k]["latency_ms"])
+        return ok[best]["model"], best

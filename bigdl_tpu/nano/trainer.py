@@ -1,0 +1,43 @@
+"""nano Trainer (ref: P:nano/pytorch/trainer.py — a pytorch-lightning
+Trainer subclass with channels_last/ipex/bf16 knobs. Here: a thin
+fit/validate driver over our Optimizer with the precision knob mapped to
+bf16 params)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from bigdl_tpu.nn.module import Criterion, Module
+
+
+class Trainer:
+    def __init__(self, max_epochs: int = 1, precision: str = "32",
+                 use_ipex: bool = False, **kwargs):
+        self.max_epochs = max_epochs
+        self.precision = str(precision)
+
+    def fit(self, model: Module, criterion: Criterion, x: np.ndarray,
+            y: np.ndarray, batch_size: int = 32,
+            optim_method=None):
+        import jax
+        import jax.numpy as jnp
+
+        from bigdl_tpu.optim.optimizer import LocalOptimizer
+        from bigdl_tpu.optim.trigger import Trigger
+
+        model = getattr(model, "module", model)
+        if self.precision in ("bf16", "16-mixed", "bf16-mixed"):
+            model.load_parameters_dict(jax.tree_util.tree_map(
+                lambda a: a.astype(jnp.bfloat16)
+                if a.dtype == jnp.float32 else a,
+                model.parameters_dict()))
+        opt = LocalOptimizer(model, (np.asarray(x), np.asarray(y)),
+                             criterion, batch_size=batch_size,
+                             end_trigger=Trigger.max_epoch(
+                                 self.max_epochs))
+        if optim_method is not None:
+            opt.set_optim_method(optim_method)
+        opt.optimize()
+        return model

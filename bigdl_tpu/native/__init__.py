@@ -1,0 +1,17 @@
+"""Native C++ components (ref: the reference's BigDL-core / llm.cpp
+sidecars — prebuilt .so shipped in wheels, SURVEY.md §2.2).
+
+Built lazily with g++ on first use and cached next to the source; all
+callers keep a pure-numpy fallback, so a missing toolchain degrades
+gracefully (matching the reference's "native optional, JVM fallback"
+posture for BigQuant).
+"""
+
+from bigdl_tpu.native.build import available, get_lib
+from bigdl_tpu.native.quantize import (
+    native_dequantize_q4_0, native_matmul_q4_0, native_quantize_q4_0,
+    native_quantize_q8_0)
+
+__all__ = ["available", "get_lib", "native_quantize_q4_0",
+           "native_dequantize_q4_0", "native_quantize_q8_0",
+           "native_matmul_q4_0"]

@@ -1,0 +1,72 @@
+"""Build + load the native library via g++ and ctypes (no pybind11 in the
+image; the C API + ctypes is the binding layer, like the reference's
+ctypes-into-libllama path, SURVEY.md §2.8)."""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import Optional
+
+logger = logging.getLogger("bigdl_tpu.native")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+_SRC = os.path.join(os.path.dirname(__file__), "quant.cpp")
+_OUT = os.path.join(os.path.dirname(__file__), "libbigdl_tpu_quant.so")
+
+
+def _build() -> Optional[str]:
+    if os.path.exists(_OUT) and \
+            os.path.getmtime(_OUT) >= os.path.getmtime(_SRC):
+        return _OUT
+    for flags in (["-fopenmp"], []):   # openmp when available
+        cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC",
+               *flags, _SRC, "-o", _OUT]
+        try:
+            r = subprocess.run(cmd, capture_output=True, timeout=120)
+            if r.returncode == 0:
+                logger.info("built %s (%s)", _OUT,
+                            "openmp" if flags else "single-thread")
+                return _OUT
+            logger.debug("native build failed: %s", r.stderr.decode())
+        except (OSError, subprocess.TimeoutExpired) as e:
+            logger.debug("native build error: %s", e)
+    return None
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """The loaded library, building on first call; None if unavailable."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        path = _build()
+        if path is None:
+            logger.info("native quant lib unavailable; numpy fallback")
+            return None
+        lib = ctypes.CDLL(path)
+        i64, f32p = ctypes.c_int64, ctypes.POINTER(ctypes.c_float)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        u16p = ctypes.POINTER(ctypes.c_uint16)
+        i8p = ctypes.POINTER(ctypes.c_int8)
+        lib.quantize_q4_0.argtypes = [f32p, i64, i64, u8p, u16p]
+        lib.dequantize_q4_0.argtypes = [u8p, u16p, i64, i64, f32p]
+        lib.quantize_q8_0.argtypes = [f32p, i64, i64, i8p, u16p]
+        lib.dequantize_q8_0.argtypes = [i8p, u16p, i64, i64, f32p]
+        lib.matmul_q4_0.argtypes = [f32p, u8p, u16p, i64, i64, i64, f32p]
+        for fn in ("quantize_q4_0", "dequantize_q4_0", "quantize_q8_0",
+                   "dequantize_q8_0", "matmul_q4_0"):
+            getattr(lib, fn).restype = None
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None

@@ -57,6 +57,12 @@ class BaseOptimizer:
                  criterion: Criterion, batch_size: int = 32,
                  end_trigger: Optional[Trigger] = None):
         self.model = model
+        if isinstance(dataset, tuple) and len(dataset) == 2 and \
+                not isinstance(dataset[0], (Module,)) and \
+                hasattr(dataset[0], "__len__"):
+            # (x, y) array-pair sugar; tuples of Samples go through
+            # LocalDataSet directly
+            dataset = LocalDataSet(*dataset)
         self.dataset = dataset
         self.criterion = criterion
         self.batch_size = batch_size
@@ -380,8 +386,7 @@ class Optimizer:
     def __new__(cls, model: Module, dataset, criterion,
                 batch_size: int = 32, end_trigger=None,
                 distributed: Optional[bool] = None, **kwargs):
-        if isinstance(dataset, tuple):
-            dataset = LocalDataSet(*dataset)
+        # tuple sugar handled once, in BaseOptimizer.__init__
         if distributed is None:
             distributed = Engine.is_initialized() and \
                 len(jax.devices()) > 1

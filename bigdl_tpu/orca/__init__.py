@@ -1,0 +1,22 @@
+"""bigdl_tpu.orca — scale-out runtime (ref: python/orca).
+
+The reference's Orca turns a Spark/Ray cluster into a scale-out substrate
+for foreign frameworks: ``init_orca_context`` builds the cluster,
+``XShards`` partitions data across it, per-backend ``Estimator``s run each
+framework's training loop on the workers (SURVEY.md §2.7). Here the
+substrate is the jax device mesh: ``init_orca_context`` wires
+``Engine.init`` (host process ↔ TPU chips), XShards partitions map onto
+the ``data`` mesh axis, and the Estimator backends are:
+
+- ``bigdl`` — our nn/keras models through DistriOptimizer (SPMD);
+- ``torch`` — foreign-framework hosting: a real torch (CPU) train loop
+  driven shard-by-shard, mirroring the reference's TorchRunner-per-
+  partition design (torch has no TPU backend here; parity, not perf).
+"""
+
+from bigdl_tpu.orca.common import (
+    OrcaContext, init_orca_context, stop_orca_context)
+from bigdl_tpu.orca.data import XShards
+
+__all__ = ["init_orca_context", "stop_orca_context", "OrcaContext",
+           "XShards"]

@@ -1,0 +1,4 @@
+from bigdl_tpu.orca.automl.auto_estimator import AutoEstimator
+from bigdl_tpu.orca.automl.hp import hp
+
+__all__ = ["AutoEstimator", "hp"]

@@ -1,0 +1,72 @@
+"""AutoEstimator (ref: P:orca/automl/auto_estimator.py — HPO driver that
+Ray-Tunes a model_creator over a search space; here a sequential
+random/grid search with the same creator-function contract — on a single
+host the chip is the scarce resource, so trials run serially on it)."""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import random
+from typing import Callable, Optional
+
+import numpy as np
+
+from bigdl_tpu.orca.automl.hp import _Choice, grid_axes, sample_config
+
+logger = logging.getLogger("bigdl_tpu.orca.automl")
+
+
+class AutoEstimator:
+    def __init__(self, model_builder: Callable[[dict], object],
+                 metric: str = "mse", mode: str = "min"):
+        """model_builder(config) -> object with fit(data, ...) and
+        evaluate(data, metrics=[metric]) -> [value]."""
+        self.model_builder = model_builder
+        self.metric = metric
+        self.mode = mode
+        self.best_config: Optional[dict] = None
+        self.best_model = None
+        self.best_score: Optional[float] = None
+        self.trials = []
+
+    def fit(self, data, validation_data=None, search_space: dict = None,
+            n_sampling: int = 8, epochs: int = 3, batch_size: int = 32,
+            seed: int = 0):
+        rng = random.Random(seed)
+        grids = grid_axes(search_space)
+        if grids:
+            grid_values = [search_space[k].options for k in grids]
+            combos = list(itertools.product(*grid_values))
+            configs = []
+            for combo in combos:
+                cfg = sample_config(
+                    {k: v for k, v in search_space.items()
+                     if k not in grids}, rng)
+                cfg.update(dict(zip(grids, combo)))
+                configs.append(cfg)
+        else:
+            configs = [sample_config(search_space, rng)
+                       for _ in range(n_sampling)]
+
+        val = validation_data if validation_data is not None else data
+        better = (lambda a, b: a < b) if self.mode == "min" \
+            else (lambda a, b: a > b)
+        for i, cfg in enumerate(configs):
+            model = self.model_builder(dict(cfg))
+            model.fit(data, epochs=epochs, batch_size=batch_size)
+            score = float(model.evaluate(val, metrics=[self.metric])[0])
+            self.trials.append({"config": cfg, self.metric: score})
+            logger.info("trial %d/%d %s=%.6f %s", i + 1, len(configs),
+                        self.metric, score, cfg)
+            if self.best_score is None or better(score, self.best_score):
+                self.best_score = score
+                self.best_config = cfg
+                self.best_model = model
+        return self
+
+    def get_best_model(self):
+        return self.best_model
+
+    def get_best_config(self) -> dict:
+        return self.best_config

@@ -1,0 +1,3 @@
+from bigdl_tpu.orca.data.shard import XShards, read_csv, read_parquet
+
+__all__ = ["XShards", "read_csv", "read_parquet"]

@@ -1,0 +1,3 @@
+from bigdl_tpu.orca.learn.estimator import Estimator
+
+__all__ = ["Estimator"]

@@ -1,0 +1,17 @@
+"""bigdl_tpu.ppml — privacy-preserving ML (ref: scala/ppml + python/ppml:
+gRPC FL server/client with HFL/VFL linear models, FGBoost federated GBDT,
+PSI, SGX enclaves).
+
+Scope here: the federated-learning core — FLServer/FLClient (length-
+prefixed pickle over TCP standing in for the reference's gRPC), FedAvg
+aggregation, PSI (salted-hash intersection; the reference uses ECDH-PSI —
+documented gap), and an FLEstimator that federates any of our nn models.
+SGX/Gramine enclave packaging and KMS/attestation are hardware/deploy
+tooling with no TPU-environment analog — documented as out of scope.
+"""
+
+from bigdl_tpu.ppml.fl_server import FLServer
+from bigdl_tpu.ppml.fl_client import FLClient
+from bigdl_tpu.ppml.estimator import FLEstimator
+
+__all__ = ["FLServer", "FLClient", "FLEstimator"]

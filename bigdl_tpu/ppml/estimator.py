@@ -1,0 +1,47 @@
+"""FLEstimator (ref: python ppml HFL logistic/linear regression — local
+epochs on the party's data, FedAvg sync each round via FLClient)."""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import numpy as np
+
+from bigdl_tpu.nn.module import Criterion, Module
+from bigdl_tpu.ppml.fl_client import FLClient
+
+
+class FLEstimator:
+    def __init__(self, model: Module, criterion: Criterion,
+                 client: FLClient, lr: float = 0.1):
+        self.model = model
+        self.criterion = criterion
+        self.client = client
+        self.lr = lr
+
+    def fit(self, x: np.ndarray, y: np.ndarray, rounds: int = 5,
+            local_epochs: int = 1, batch_size: int = 32):
+        from bigdl_tpu.optim.optim_method import SGD
+        from bigdl_tpu.optim.optimizer import LocalOptimizer
+        from bigdl_tpu.optim.trigger import Trigger
+
+        for _ in range(rounds):
+            opt = LocalOptimizer(self.model,
+                                 (np.asarray(x), np.asarray(y)),
+                                 self.criterion, batch_size=batch_size,
+                                 end_trigger=Trigger.max_epoch(
+                                     local_epochs))
+            opt.set_optim_method(SGD(learning_rate=self.lr))
+            opt.optimize()
+            flat = jax.tree_util.tree_leaves(self.model.parameters_dict())
+            averaged = self.client.sync_round(
+                [np.asarray(w) for w in flat])
+            tree = jax.tree_util.tree_structure(
+                self.model.parameters_dict())
+            self.model.load_parameters_dict(
+                jax.tree_util.tree_unflatten(tree, averaged))
+        return self
+
+    def predict(self, x: np.ndarray) -> np.ndarray:
+        return np.asarray(self.model.evaluate().forward(np.asarray(x)))

@@ -1,0 +1,62 @@
+"""FLClient (ref: scala/ppml FLClient + python ppml fl context)."""
+
+from __future__ import annotations
+
+import socket
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from bigdl_tpu.ppml.fl_server import FLServer
+from bigdl_tpu.ppml.protocol import recv_msg, send_msg
+
+
+class FLClient:
+    def __init__(self, client_id: str, target: str = "127.0.0.1:8980"):
+        host, port = target.rsplit(":", 1)
+        self.client_id = client_id
+        self._sock = socket.create_connection((host, int(port)))
+        self.version = 0
+
+    def _call(self, msg: dict) -> dict:
+        msg["client_id"] = self.client_id
+        send_msg(self._sock, msg)
+        return recv_msg(self._sock)
+
+    # -- FedAvg --------------------------------------------------------------
+    def upload(self, weights: Sequence[np.ndarray]) -> dict:
+        return self._call({"type": "upload", "version": self.version,
+                           "weights": [np.asarray(w) for w in weights]})
+
+    def download(self, timeout: float = 60.0) -> List[np.ndarray]:
+        resp = self._call({"type": "download", "version": self.version,
+                           "timeout": timeout})
+        if resp["status"] != "ok":
+            raise TimeoutError("FL round did not complete")
+        self.version = resp["version"]
+        return resp["weights"]
+
+    def sync_round(self, weights: Sequence[np.ndarray],
+                   timeout: float = 60.0) -> List[np.ndarray]:
+        """upload local weights, wait for the FedAvg of this round."""
+        self.upload(weights)
+        return self.download(timeout)
+
+    # -- PSI -----------------------------------------------------------------
+    def psi_get_salt(self) -> str:
+        return self._call({"type": "psi_salt"})["salt"]
+
+    def psi_upload_set(self, ids: Sequence[str], salt: str):
+        hashed = [FLServer.hash_id(i, salt) for i in ids]
+        self._hash_to_id = dict(zip(hashed, ids))
+        return self._call({"type": "psi_upload", "hashed_ids": hashed})
+
+    def psi_download_intersection(self, timeout: float = 60.0):
+        resp = self._call({"type": "psi_download", "timeout": timeout})
+        if resp["status"] != "ok":
+            raise TimeoutError("PSI did not complete")
+        return sorted(self._hash_to_id[h] for h in resp["intersection"]
+                      if h in self._hash_to_id)
+
+    def close(self):
+        self._sock.close()

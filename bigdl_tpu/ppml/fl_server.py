@@ -1,0 +1,140 @@
+"""FLServer (ref: scala/ppml FLServer — gRPC NNService/PSIService with
+client-number-gated synchronous rounds and FedAvg aggregation)."""
+
+from __future__ import annotations
+
+import hashlib
+import socket
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from bigdl_tpu.ppml.protocol import recv_msg, send_msg
+
+
+class FLServer:
+    def __init__(self, client_num: int = 2, port: int = 0,
+                 host: str = "127.0.0.1"):
+        self.client_num = client_num
+        self.host = host
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self.port = self._sock.getsockname()[1]
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        # nn aggregation state
+        self._version = 0
+        self._uploads: Dict[str, List[np.ndarray]] = {}
+        self._aggregated: Optional[List[np.ndarray]] = None
+        # psi state
+        self._psi_salt = "bigdl_tpu_psi"
+        self._psi_sets: Dict[str, set] = {}
+        self._psi_result: Optional[set] = None
+
+    # -- lifecycle -----------------------------------------------------------
+    def build(self):  # ref API name
+        return self
+
+    def start(self):
+        self._sock.listen(16)
+        t = threading.Thread(target=self._accept_loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+        return self
+
+    def stop(self):
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            t = threading.Thread(target=self._serve_client,
+                                 args=(conn,), daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    # -- per-connection handler ---------------------------------------------
+    def _serve_client(self, conn: socket.socket):
+        try:
+            while not self._stop.is_set():
+                msg = recv_msg(conn)
+                handler = getattr(self, f"_on_{msg['type']}", None)
+                if handler is None:
+                    send_msg(conn, {"status": "error",
+                                    "error": f"unknown {msg['type']}"})
+                    continue
+                send_msg(conn, handler(msg))
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    # -- FedAvg rounds (ref: NNServiceImpl train logic) ----------------------
+    def _on_upload(self, msg) -> dict:
+        with self._cond:
+            if msg["version"] != self._version:
+                return {"status": "rejected", "version": self._version}
+            self._uploads[msg["client_id"]] = msg["weights"]
+            if len(self._uploads) >= self.client_num:
+                ws = list(self._uploads.values())
+                self._aggregated = [
+                    np.mean([w[i] for w in ws], axis=0)
+                    for i in range(len(ws[0]))]
+                self._uploads.clear()
+                self._version += 1
+                self._cond.notify_all()
+            return {"status": "ok", "version": self._version}
+
+    def _on_download(self, msg) -> dict:
+        with self._cond:
+            target = msg["version"]
+            ok = self._cond.wait_for(
+                lambda: self._version > target or self._stop.is_set(),
+                timeout=msg.get("timeout", 60.0))
+            if not ok or self._aggregated is None:
+                return {"status": "timeout"}
+            return {"status": "ok", "version": self._version,
+                    "weights": self._aggregated}
+
+    # -- PSI (ref: PSIServiceImpl; salted-hash intersection) -----------------
+    def _on_psi_salt(self, msg) -> dict:
+        return {"status": "ok", "salt": self._psi_salt}
+
+    def _on_psi_upload(self, msg) -> dict:
+        with self._cond:
+            self._psi_sets[msg["client_id"]] = set(msg["hashed_ids"])
+            if len(self._psi_sets) >= self.client_num:
+                sets = list(self._psi_sets.values())
+                inter = sets[0]
+                for s in sets[1:]:
+                    inter = inter & s
+                self._psi_result = inter
+                self._cond.notify_all()
+            return {"status": "ok"}
+
+    def _on_psi_download(self, msg) -> dict:
+        with self._cond:
+            ok = self._cond.wait_for(
+                lambda: self._psi_result is not None
+                or self._stop.is_set(),
+                timeout=msg.get("timeout", 60.0))
+            if not ok or self._psi_result is None:
+                return {"status": "timeout"}
+            return {"status": "ok",
+                    "intersection": sorted(self._psi_result)}
+
+    @staticmethod
+    def hash_id(value: str, salt: str) -> str:
+        return hashlib.sha256((salt + str(value)).encode()).hexdigest()

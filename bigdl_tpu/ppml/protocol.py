@@ -1,0 +1,31 @@
+"""Wire protocol: length-prefixed pickled dicts over TCP (the reference
+uses gRPC protobuf services — FLServer/NNService/PSIService; same message
+shapes, simpler transport)."""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+from typing import Any
+
+
+def send_msg(sock: socket.socket, obj: Any):
+    payload = pickle.dumps(obj)
+    sock.sendall(struct.pack(">I", len(payload)) + payload)
+
+
+def recv_msg(sock: socket.socket) -> Any:
+    header = _recv_exact(sock, 4)
+    (length,) = struct.unpack(">I", header)
+    return pickle.loads(_recv_exact(sock, length))
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return buf

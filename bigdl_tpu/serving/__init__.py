@@ -1,0 +1,9 @@
+"""bigdl_tpu.serving — model serving (ref: scala/serving + python/serving
+Cluster Serving: Redis streams in → Flink batcher → InferenceModel →
+Redis out; and orca InferenceModel)."""
+
+from bigdl_tpu.serving.inference_model import InferenceModel
+from bigdl_tpu.serving.cluster_serving import (
+    ClusterServing, InputQueue, OutputQueue)
+
+__all__ = ["InferenceModel", "ClusterServing", "InputQueue", "OutputQueue"]

@@ -1,0 +1,184 @@
+"""Cluster-Serving-shaped queue serving (ref: scala/serving — Redis stream
+in → batch collector (batchSize/timeout) → InferenceModel → Redis stream
+out; python client InputQueue/OutputQueue).
+
+Queue backends:
+- ``redis`` — the reference's wire protocol home, used when a redis
+  server + client lib are reachable;
+- ``inproc`` — in-process queues with the same API (the test/dev
+  substrate, standing in for local Redis exactly like the reference's
+  tests run against a local redis-server).
+"""
+
+from __future__ import annotations
+
+import base64
+import pickle
+import queue
+import threading
+import time
+import uuid
+from typing import Dict, Optional
+
+import numpy as np
+
+from bigdl_tpu.serving.inference_model import InferenceModel
+
+_INPROC: Dict[str, "queue.Queue"] = {}
+
+
+def _get_queue(name: str) -> "queue.Queue":
+    return _INPROC.setdefault(name, queue.Queue())
+
+
+class _Backend:
+    def push(self, stream: str, payload: bytes):
+        raise NotImplementedError
+
+    def pop(self, stream: str, timeout: float) -> Optional[bytes]:
+        raise NotImplementedError
+
+
+class _InprocBackend(_Backend):
+    def push(self, stream, payload):
+        _get_queue(stream).put(payload)
+
+    def pop(self, stream, timeout):
+        try:
+            return _get_queue(stream).get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+
+class _RedisBackend(_Backend):
+    def __init__(self, host: str, port: int):
+        import redis  # gated: not in the image by default
+
+        self._r = redis.Redis(host=host, port=port)
+        self._r.ping()
+
+    def push(self, stream, payload):
+        self._r.rpush(stream, payload)
+
+    def pop(self, stream, timeout):
+        out = self._r.blpop([stream], timeout=max(int(timeout), 1))
+        return out[1] if out else None
+
+
+def _make_backend(backend: str, host: str, port: int) -> _Backend:
+    if backend == "redis":
+        return _RedisBackend(host, port)
+    return _InprocBackend()
+
+
+class InputQueue:
+    """Client input side (ref: P:serving InputQueue.enqueue)."""
+
+    def __init__(self, name: str = "serving_stream",
+                 backend: str = "inproc", host: str = "localhost",
+                 port: int = 6379):
+        self.name = name
+        self._b = _make_backend(backend, host, port)
+
+    def enqueue(self, uri: Optional[str] = None, **data) -> str:
+        uri = uri or str(uuid.uuid4())
+        arrays = {k: np.asarray(v) for k, v in data.items()}
+        payload = pickle.dumps({"uri": uri, "data": arrays})
+        self._b.push(self.name, payload)
+        return uri
+
+
+class OutputQueue:
+    """Client output side (ref: OutputQueue.query/dequeue)."""
+
+    def __init__(self, name: str = "serving_stream",
+                 backend: str = "inproc", host: str = "localhost",
+                 port: int = 6379):
+        self.name = name + ":out"
+        self._b = _make_backend(backend, host, port)
+        self._cache: Dict[str, np.ndarray] = {}
+
+    def query(self, uri: str, timeout: float = 10.0):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if uri in self._cache:
+                return self._cache.pop(uri)
+            payload = self._b.pop(self.name, timeout=0.1)
+            if payload is None:
+                continue
+            rec = pickle.loads(payload)
+            self._cache[rec["uri"]] = rec["result"]
+        raise TimeoutError(f"no result for {uri}")
+
+    def dequeue(self, timeout: float = 10.0):
+        payload = self._b.pop(self.name, timeout=timeout)
+        if payload is None:
+            return None
+        rec = pickle.loads(payload)
+        return rec["uri"], rec["result"]
+
+
+class ClusterServing:
+    """The serving job (ref: ClusterServing Flink pipeline): poll input
+    stream, collect up to batch_size (or batch_timeout), run the
+    InferenceModel once per batch, push per-record results."""
+
+    def __init__(self, model: InferenceModel,
+                 stream_name: str = "serving_stream",
+                 batch_size: int = 8, batch_timeout: float = 0.01,
+                 backend: str = "inproc", host: str = "localhost",
+                 port: int = 6379):
+        self.model = model
+        self.stream = stream_name
+        self.batch_size = batch_size
+        self.batch_timeout = batch_timeout
+        self._b = _make_backend(backend, host, port)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.served = 0
+
+    def _collect_batch(self):
+        recs = []
+        deadline = time.time() + self.batch_timeout
+        while len(recs) < self.batch_size:
+            remaining = deadline - time.time()
+            payload = self._b.pop(self.stream,
+                                  timeout=max(remaining, 0.005))
+            if payload is None:
+                break
+            recs.append(pickle.loads(payload))
+            if time.time() > deadline:
+                break
+        return recs
+
+    def _serve_once(self) -> int:
+        recs = self._collect_batch()
+        if not recs:
+            return 0
+        key = next(iter(recs[0]["data"]))
+        x = np.concatenate([r["data"][key] for r in recs], axis=0)
+        y = self.model.predict(x)
+        off = 0
+        for r in recs:
+            n = r["data"][key].shape[0]
+            payload = pickle.dumps({"uri": r["uri"],
+                                    "result": y[off:off + n]})
+            self._b.push(self.stream + ":out", payload)
+            off += n
+        self.served += len(recs)
+        return len(recs)
+
+    def start(self):
+        def loop():
+            while not self._stop.is_set():
+                if self._serve_once() == 0:
+                    time.sleep(0.002)
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)

@@ -1,0 +1,64 @@
+"""InferenceModel (ref: scala orca .../inference/InferenceModel.scala —
+thread-safe pooled inference over a loaded model; backends BigDL/TF/
+OpenVINO/Torch. Here: our nn modules AOT-compiled with jax.jit; the
+"OpenVINO inference executable" role is played by the compiled XLA
+program, and concurrency is one compiled program reused across threads
+(XLA executables are thread-safe; no replica pool needed)."""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.nn.module import Module
+
+
+class InferenceModel:
+    def __init__(self, supported_concurrent_num: int = 1):
+        self._model: Optional[Module] = None
+        self._fwd = None
+        self._params = None
+        self._states = None
+        self._lock = threading.Lock()
+
+    # -- loaders (ref: doLoadBigDL/doLoadTF/doLoadOpenVINO/doLoadPytorch) ----
+    def load_bigdl(self, model_path: str = None, model: Module = None):
+        if model is None:
+            model = Module.load_module(model_path)
+        self._model = model.evaluate()
+        self._params = jax.tree_util.tree_map(
+            jnp.asarray, model.parameters_dict())
+        self._states = jax.tree_util.tree_map(
+            jnp.asarray, model.states_dict())
+        mdl = self._model
+
+        @jax.jit
+        def fwd(p, s, x):
+            y, _ = mdl.apply(p, s, x, training=False, rng=None)
+            return y
+
+        self._fwd = fwd
+        return self
+
+    load = load_bigdl
+
+    def load_keras(self, keras_model):
+        return self.load_bigdl(model=keras_model.module)
+
+    def do_predict(self, x: np.ndarray) -> np.ndarray:
+        if self._fwd is None:
+            raise RuntimeError("load a model first")
+        return np.asarray(self._fwd(self._params, self._states,
+                                    jnp.asarray(x)))
+
+    predict = do_predict
+
+    def aot_compile(self, example_shape, dtype=np.float32) -> "InferenceModel":
+        """Warm the executable for a given shape (the reference's OpenVINO
+        compile-ahead analog; first jit call compiles, later calls reuse)."""
+        self.do_predict(np.zeros(example_shape, dtype))
+        return self

@@ -1,0 +1,152 @@
+"""Chronos tests (ref pattern: chronos tests train tiny models on synthetic
+series, SURVEY.md §4). BASELINE config 3 = TCN/Seq2Seq forecasters."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from bigdl_tpu.chronos import (
+    AEDetector, LSTMForecaster, NBeatsForecaster, Seq2SeqForecaster,
+    TCNForecaster, ThresholdDetector, TSDataset)
+
+
+def _sine_df(n=300, ids=None):
+    t = np.arange(n)
+    base = {"dt": pd.date_range("2025-01-01", periods=n, freq="h"),
+            "value": np.sin(t * 0.3) + 0.05 * np.cos(t * 1.7),
+            "extra": np.cos(t * 0.3)}
+    if ids is None:
+        return pd.DataFrame(base)
+    dfs = []
+    for i in ids:
+        d = pd.DataFrame(base)
+        d["id"] = i
+        dfs.append(d)
+    return pd.concat(dfs, ignore_index=True)
+
+
+class TestTSDataset:
+    def test_roll_shapes(self):
+        ts = TSDataset.from_pandas(_sine_df(100), dt_col="dt",
+                                   target_col="value",
+                                   extra_feature_col="extra")
+        x, y = ts.roll(lookback=12, horizon=3).to_numpy()
+        assert x.shape == (100 - 12 - 3 + 1, 12, 2)
+        assert y.shape == (100 - 12 - 3 + 1, 3, 1)
+
+    def test_multi_id_roll_no_leakage(self):
+        ts = TSDataset.from_pandas(_sine_df(50, ids=["a", "b"]),
+                                   dt_col="dt", target_col="value",
+                                   extra_feature_col="extra", id_col="id")
+        x, y = ts.roll(lookback=10, horizon=2).to_numpy()
+        # windows never cross id boundaries: (50-10-2+1) per id
+        assert x.shape[0] == 2 * 39
+
+    def test_impute_modes(self):
+        df = _sine_df(30)
+        df.loc[5, "value"] = np.nan
+        df.loc[0, "extra"] = np.nan
+        ts = TSDataset.from_pandas(df, "dt", "value", "extra")
+        ts.impute("linear")
+        assert not ts.df[["value", "extra"]].isna().any().any()
+
+    def test_scale_roundtrip(self):
+        ts = TSDataset.from_pandas(_sine_df(60), "dt", "value", "extra")
+        orig = ts.df["value"].to_numpy().copy()
+        ts.scale()
+        assert abs(ts.df["value"].mean()) < 1e-6
+        ts.unscale()
+        np.testing.assert_allclose(ts.df["value"].to_numpy(), orig,
+                                   atol=1e-9)
+
+    def test_unscale_numpy_inverts_targets(self):
+        ts = TSDataset.from_pandas(_sine_df(80), "dt", "value", "extra")
+        ts.scale().roll(lookback=8, horizon=2)
+        _, y = ts.to_numpy()
+        y_un = ts.unscale_numpy(y)
+        ts2 = TSDataset.from_pandas(_sine_df(80), "dt", "value", "extra")
+        x2, y2 = ts2.roll(lookback=8, horizon=2).to_numpy()
+        np.testing.assert_allclose(y_un, y2, atol=1e-5)
+
+    def test_split_and_dt_features(self):
+        tr, va, te = TSDataset.from_pandas(
+            _sine_df(100), "dt", "value", with_split=True,
+            val_ratio=0.2, test_ratio=0.2)
+        assert len(tr.df) == 60 and len(va.df) == 20 and len(te.df) == 20
+        tr.gen_dt_feature(["HOUR", "IS_WEEKEND"])
+        assert "HOUR(dt)" in tr.feature_cols
+
+
+class TestForecasters:
+    @pytest.mark.parametrize("cls,kwargs", [
+        (TCNForecaster, dict(num_channels=(16, 16))),
+        (Seq2SeqForecaster, dict(lstm_hidden_dim=32)),
+        (LSTMForecaster, dict(hidden_dim=32, future_seq_len=4)),
+    ])
+    def test_fit_improves_and_beats_persistence(self, cls, kwargs):
+        ts = TSDataset.from_pandas(_sine_df(400), "dt", "value")
+        x, y = ts.roll(lookback=24, horizon=4).to_numpy()
+        f = cls(past_seq_len=24, future_seq_len=4, input_feature_num=1,
+                output_feature_num=1, lr=5e-3, **{
+                    k: v for k, v in kwargs.items()
+                    if k != "future_seq_len"})
+        f.fit((x, y), epochs=10, batch_size=32)
+        mse = f.evaluate((x, y), metrics=["mse"])[0]
+        persistence = float(np.mean((y - x[:, -1:, :1]) ** 2))
+        assert mse < persistence, (mse, persistence)
+        pred = f.predict(x[:5])
+        assert pred.shape == (5, 4, 1)
+
+    def test_nbeats_univariate(self):
+        ts = TSDataset.from_pandas(_sine_df(300), "dt", "value")
+        x, y = ts.roll(lookback=16, horizon=2).to_numpy()
+        f = NBeatsForecaster(past_seq_len=16, future_seq_len=2,
+                             nbeats_units=32, num_blocks=2, lr=5e-3)
+        f.fit((x, y), epochs=10, batch_size=32)
+        mse = f.evaluate((x, y), metrics=["mse", "smape"])[0]
+        assert mse < 0.05, mse
+
+    def test_save_load_roundtrip(self, tmp_path):
+        ts = TSDataset.from_pandas(_sine_df(200), "dt", "value")
+        x, y = ts.roll(lookback=12, horizon=2).to_numpy()
+        f = LSTMForecaster(past_seq_len=12, input_feature_num=1,
+                           output_feature_num=1, future_seq_len=2,
+                           hidden_dim=16)
+        f.fit((x, y), epochs=3)
+        p1 = f.predict(x[:3])
+        path = str(tmp_path / "model.bin")
+        f.save(path)
+        g = LSTMForecaster(past_seq_len=12, input_feature_num=1,
+                           output_feature_num=1, future_seq_len=2,
+                           hidden_dim=16)
+        g.load(path)
+        np.testing.assert_allclose(p1, g.predict(x[:3]), atol=1e-6)
+
+
+class TestDetectors:
+    def test_threshold_detector(self):
+        rs = np.random.RandomState(0)
+        y = np.sin(np.arange(500) * 0.1) + rs.randn(500) * 0.05
+        y_pred = np.sin(np.arange(500) * 0.1)
+        y[100] += 3.0
+        y[400] -= 3.0
+        d = ThresholdDetector().set_params(ratio=0.02)
+        d.fit(np.delete(y, [100, 400]), np.delete(y_pred, [100, 400]))
+        idx = d.anomaly_indexes(y, y_pred)
+        assert 100 in idx and 400 in idx
+        assert len(idx) < 30
+
+    def test_ae_detector(self):
+        rs = np.random.RandomState(1)
+        y = np.sin(np.arange(400) * 0.2) + rs.randn(400) * 0.02
+        y[200:204] += 2.5
+        d = AEDetector(roll_len=16, ratio=0.05, epochs=60)
+        d.fit(y)
+        idx = d.anomaly_indexes(y)
+        assert any(195 <= i <= 210 for i in idx)
+
+    def test_dbscan_detector(self):
+        y = np.concatenate([np.zeros(100), [10.0], np.zeros(100)])
+        from bigdl_tpu.chronos.detector import DBScanDetector
+        idx = DBScanDetector(eps=0.5, min_samples=5).anomaly_indexes(y)
+        assert 100 in idx

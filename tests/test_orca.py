@@ -1,0 +1,179 @@
+"""Orca tests (ref pattern: orca tests run local Ray / local[4] Spark,
+SURVEY.md §4). BASELINE config 4 = Estimator BERT-base fine-tune (tiny
+config here, as the reference's tests use)."""
+
+import numpy as np
+import pytest
+
+from bigdl_tpu.orca import XShards, init_orca_context, stop_orca_context
+from bigdl_tpu.orca.learn import Estimator
+
+
+@pytest.fixture(autouse=True)
+def orca_ctx():
+    ctx = init_orca_context(cluster_mode="local-cpu", some_spark_arg=1)
+    yield ctx
+    stop_orca_context()
+
+
+class TestXShards:
+    def test_partition_and_collect(self):
+        data = {"x": np.arange(20).reshape(10, 2),
+                "y": np.arange(10)}
+        shards = XShards.partition(data, num_shards=3)
+        assert shards.num_partitions() == 3
+        merged = shards.merged()
+        np.testing.assert_array_equal(merged["x"], data["x"])
+
+    def test_transform_and_repartition(self):
+        shards = XShards.partition(np.arange(12), num_shards=4)
+        doubled = shards.transform_shard(lambda a: a * 2)
+        np.testing.assert_array_equal(doubled.merged(), np.arange(12) * 2)
+        re = doubled.repartition(2)
+        assert re.num_partitions() == 2
+
+    def test_read_csv(self, tmp_path):
+        import pandas as pd
+        from bigdl_tpu.orca.data import read_csv
+
+        df = pd.DataFrame({"a": range(10), "b": range(10)})
+        p = tmp_path / "data.csv"
+        df.to_csv(p, index=False)
+        shards = read_csv(str(p), num_shards=2)
+        assert shards.num_partitions() == 2
+        assert sum(len(s) for s in shards.collect()) == 10
+
+
+class TestBigDLEstimator:
+    def test_fit_evaluate_predict(self):
+        import bigdl_tpu.keras as K
+        from bigdl_tpu.optim.optim_method import Adam
+
+        rs = np.random.RandomState(0)
+        x = rs.rand(128, 6).astype(np.float32)
+        w = rs.randn(6, 2).astype(np.float32)
+        y = (x @ w).argmax(1).astype(np.int32)
+
+        model = K.Sequential()
+        model.add(K.Dense(16, activation="relu", input_shape=(6,)))
+        model.add(K.Dense(2, activation="softmax"))
+        est = Estimator.from_bigdl(
+            model=model, loss="sparse_categorical_crossentropy",
+            optimizer=Adam(learning_rate=0.02), metrics=["accuracy"])
+        shards = XShards.partition({"x": x, "y": y}, num_shards=4)
+        est.fit(shards, epochs=25, batch_size=32)
+        res = est.evaluate(shards)
+        assert res[0].result > 0.9, res[0].result
+        pred = est.predict(shards)
+        assert pred.shape == (128, 2)
+
+
+class TestTorchEstimator:
+    def test_torch_regression_shards(self):
+        torch = pytest.importorskip("torch")
+
+        def model_creator(config):
+            torch.manual_seed(0)
+            return torch.nn.Sequential(
+                torch.nn.Linear(4, 16), torch.nn.ReLU(),
+                torch.nn.Linear(16, 1))
+
+        def optim_creator(model, config):
+            return torch.optim.Adam(model.parameters(),
+                                    lr=config.get("lr", 1e-2))
+
+        est = Estimator.from_torch(
+            model_creator=model_creator, optimizer_creator=optim_creator,
+            loss_creator=lambda cfg: torch.nn.MSELoss(),
+            config={"lr": 5e-3}, backend="spark")
+
+        rs = np.random.RandomState(1)
+        x = rs.rand(200, 4).astype(np.float32)
+        y = (x.sum(1, keepdims=True) * 1.5).astype(np.float32)
+        shards = XShards.partition({"x": x, "y": y}, num_shards=4)
+        est.fit(shards, epochs=30, batch_size=32)
+        res = est.evaluate((x, y))
+        assert res["MSE"] < 0.05, res
+
+    def test_bert_tiny_finetune(self):
+        """BASELINE config 4: BERT fine-tune through the Orca torch path
+        (tiny random-init config; no network)."""
+        torch = pytest.importorskip("torch")
+        transformers = pytest.importorskip("transformers")
+
+        cfg = transformers.BertConfig(
+            vocab_size=100, hidden_size=32, num_hidden_layers=2,
+            num_attention_heads=2, intermediate_size=64,
+            max_position_embeddings=64, num_labels=2)
+
+        class BertClassifier(torch.nn.Module):
+            def __init__(self):
+                super().__init__()
+                self.bert = transformers.BertForSequenceClassification(cfg)
+
+            def forward(self, ids):
+                return self.bert(input_ids=ids).logits
+
+        est = Estimator.from_torch(
+            model_creator=lambda c: BertClassifier(),
+            optimizer_creator=lambda m, c: torch.optim.Adam(
+                m.parameters(), lr=5e-4),
+            loss_creator=lambda c: torch.nn.CrossEntropyLoss())
+
+        rs = np.random.RandomState(2)
+        # learnable rule: label = first token > 50
+        x = rs.randint(1, 100, (96, 12)).astype(np.int64)
+        y = (x[:, 0] > 50).astype(np.int64)
+        shards = XShards.partition({"x": x, "y": y}, num_shards=2)
+        est.fit(shards, epochs=6, batch_size=16)
+        res = est.evaluate((x, y))
+        assert res["Accuracy"] > 0.8, res
+
+
+class TestAutoML:
+    def test_auto_estimator_random_search(self):
+        from bigdl_tpu.chronos.forecaster import LSTMForecaster
+        from bigdl_tpu.orca.automl import AutoEstimator, hp
+
+        rs = np.random.RandomState(3)
+        t = np.arange(200)
+        series = np.sin(t * 0.3).astype(np.float32)
+        x = np.stack([series[i:i + 12] for i in range(180)])[..., None]
+        y = np.stack([series[i + 12:i + 13] for i in range(180)])[..., None]
+
+        def builder(config):
+            return LSTMForecaster(past_seq_len=12, input_feature_num=1,
+                                  output_feature_num=1,
+                                  hidden_dim=config["hidden_dim"],
+                                  lr=config["lr"])
+
+        auto = AutoEstimator(builder, metric="mse", mode="min")
+        auto.fit((x, y), search_space={
+            "hidden_dim": hp.grid_search([8, 16]),
+            "lr": hp.loguniform(1e-3, 1e-2),
+        }, epochs=4, batch_size=32)
+        assert auto.get_best_config()["hidden_dim"] in (8, 16)
+        assert auto.best_score < 0.05
+        assert len(auto.trials) == 2
+
+    def test_autots_pipeline(self):
+        import pandas as pd
+        from bigdl_tpu.chronos.autots import AutoTSEstimator
+        from bigdl_tpu.chronos.data import TSDataset
+        from bigdl_tpu.orca.automl import hp
+
+        n = 260
+        df = pd.DataFrame({
+            "dt": pd.date_range("2025-01-01", periods=n, freq="h"),
+            "value": np.sin(np.arange(n) * 0.25)})
+        ts = TSDataset.from_pandas(df, "dt", "value")
+        auto = AutoTSEstimator(
+            model="lstm", past_seq_len=hp.choice([12, 16]),
+            future_seq_len=1,
+            search_space={"hidden_dim": hp.choice([16, 32]),
+                          "lr": hp.choice([5e-3, 1e-2])})
+        pipe = auto.fit(ts, n_sampling=2, epochs=8)
+        mse = pipe.evaluate(ts, metrics=["mse"])[0]
+        assert mse < 0.1, mse
+        pred = pipe.predict(ts)
+        assert pred.shape[1:] == (1, 1)

@@ -1,0 +1,208 @@
+"""Serving / Friesian / Nano / PPML capability-layer tests."""
+
+import threading
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.nn.module import set_seed
+
+
+def _mlp(in_dim=6, out_dim=3):
+    set_seed(0)
+    return (nn.Sequential()
+            .add(nn.Linear(in_dim, 16)).add(nn.ReLU())
+            .add(nn.Linear(16, out_dim)).add(nn.SoftMax()))
+
+
+class TestServing:
+    def test_inference_model_predict(self):
+        from bigdl_tpu.serving import InferenceModel
+
+        m = InferenceModel().load_bigdl(model=_mlp())
+        m.aot_compile((4, 6))
+        y = m.predict(np.random.rand(4, 6).astype(np.float32))
+        assert y.shape == (4, 3)
+        np.testing.assert_allclose(y.sum(1), 1.0, rtol=1e-4)
+
+    def test_cluster_serving_roundtrip(self):
+        from bigdl_tpu.serving import (
+            ClusterServing, InferenceModel, InputQueue, OutputQueue)
+
+        model = InferenceModel().load_bigdl(model=_mlp())
+        serving = ClusterServing(model, stream_name="t1",
+                                 batch_size=4).start()
+        try:
+            inq = InputQueue("t1")
+            outq = OutputQueue("t1")
+            xs = {f"r{i}": np.random.rand(1, 6).astype(np.float32)
+                  for i in range(10)}
+            for uri, x in xs.items():
+                inq.enqueue(uri, input=x)
+            for uri, x in xs.items():
+                res = outq.query(uri, timeout=15)
+                assert res.shape == (1, 3)
+                direct = model.predict(x)
+                np.testing.assert_allclose(res, direct, rtol=1e-4)
+            assert serving.served == 10
+        finally:
+            serving.stop()
+
+
+class TestFriesian:
+    def test_encode_string_and_reuse_index(self):
+        from bigdl_tpu.friesian import FeatureTable
+
+        df = pd.DataFrame({"cat": ["a", "b", "a", "c"],
+                           "v": [1.0, 2.0, 3.0, 4.0]})
+        tbl = FeatureTable(df)
+        enc, idx = tbl.encode_string("cat")
+        assert enc.df["cat"].tolist() == [1, 2, 1, 3]
+        df2 = pd.DataFrame({"cat": ["c", "zzz"], "v": [0.0, 0.0]})
+        enc2, _ = FeatureTable(df2).encode_string("cat", indices=idx)
+        assert enc2.df["cat"].tolist() == [3, 0]   # OOV -> 0
+
+    def test_negative_sampling_and_cross(self):
+        from bigdl_tpu.friesian import FeatureTable
+
+        df = pd.DataFrame({"user": [1, 2], "item": [5, 7]})
+        out = FeatureTable(df).add_negative_samples(
+            item_size=100, item_col="item", neg_num=2)
+        assert len(out.df) == 6
+        assert (out.df["label"] == 1).sum() == 2
+        crossed = out.cross_columns([["user", "item"]], [50])
+        assert crossed.df["user_item"].between(0, 49).all()
+
+    def test_hist_seq_and_pad(self):
+        from bigdl_tpu.friesian import FeatureTable
+
+        df = pd.DataFrame({"user": [1, 1, 1, 2, 2],
+                           "item": [10, 11, 12, 20, 21],
+                           "t": [1, 2, 3, 1, 2]})
+        out = FeatureTable(df).gen_hist_seq("user", "item", sort_col="t",
+                                            min_len=1, max_len=2)
+        padded = out.pad("item_hist_seq", seq_len=3)
+        for s in padded.df["item_hist_seq"]:
+            assert len(s) == 3
+
+    def test_brute_force_recall(self):
+        from bigdl_tpu.friesian import BruteForceRecall
+
+        rs = np.random.RandomState(0)
+        items = rs.randn(100, 8).astype(np.float32)
+        recall = BruteForceRecall(dim=8, metric="cosine").add(items)
+        scores, idx = recall.search(items[17], k=5)
+        assert idx[0, 0] == 17   # own nearest neighbor under cosine
+        assert scores.shape == (1, 5)
+
+
+class TestNano:
+    def test_quantize_and_trace_agree(self):
+        from bigdl_tpu.nano import InferenceOptimizer
+
+        model = _mlp(in_dim=32)   # block quant needs K % 32 == 0
+        x = np.random.rand(4, 32).astype(np.float32)
+        base = InferenceOptimizer.trace(model, input_sample=x)
+        ref = base(x)
+        bf16 = InferenceOptimizer.quantize(model, "bf16")
+        np.testing.assert_allclose(bf16(x), ref, atol=0.05)
+        int8 = InferenceOptimizer.quantize(model, "int8")
+        np.testing.assert_allclose(int8(x), ref, atol=0.05)
+
+    def test_optimize_report_and_best(self):
+        from bigdl_tpu.nano import InferenceOptimizer
+
+        model = _mlp()
+        x = np.random.rand(2, 6).astype(np.float32)
+        report = InferenceOptimizer.optimize(model, x,
+                                             latency_sample_num=3)
+        assert report["original(jit)"]["status"] == "successful"
+        best, name = InferenceOptimizer.get_best_model(report)
+        assert best(x).shape == (2, 3)
+
+    def test_trainer_fit(self):
+        from bigdl_tpu.nano import Trainer
+
+        rs = np.random.RandomState(0)
+        x = rs.rand(64, 4).astype(np.float32)
+        y = (x.sum(1, keepdims=True)).astype(np.float32)
+        set_seed(1)
+        from bigdl_tpu.optim.optim_method import SGD
+        model = nn.Sequential().add(nn.Linear(4, 1))
+        Trainer(max_epochs=30).fit(model, nn.MSECriterion(), x, y,
+                                   batch_size=16,
+                                   optim_method=SGD(learning_rate=0.3))
+        pred = model.evaluate().forward(x)
+        assert float(np.mean((np.asarray(pred) - y) ** 2)) < 0.05
+
+
+class TestPPML:
+    def test_fedavg_two_parties(self):
+        from bigdl_tpu.ppml import FLClient, FLEstimator, FLServer
+
+        server = FLServer(client_num=2).build().start()
+        try:
+            rs = np.random.RandomState(0)
+            w_true = rs.randn(4, 1).astype(np.float32)
+            # two parties with disjoint data from the same distribution
+            xs = [rs.rand(64, 4).astype(np.float32) for _ in range(2)]
+            ys = [x @ w_true for x in xs]
+
+            results = {}
+
+            def party(pid):
+                set_seed(42)   # same init on both parties (ref behavior)
+                model = nn.Sequential().add(nn.Linear(4, 1))
+                client = FLClient(f"p{pid}",
+                                  f"127.0.0.1:{server.port}")
+                est = FLEstimator(model, nn.MSECriterion(), client,
+                                  lr=0.3)
+                est.fit(xs[pid], ys[pid], rounds=15, local_epochs=3,
+                        batch_size=16)
+                results[pid] = est
+                client.close()
+
+            threads = [threading.Thread(target=party, args=(i,))
+                       for i in range(2)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=120)
+            assert len(results) == 2
+            # both parties converge to the same averaged model
+            p0 = results[0].predict(xs[0])
+            p1 = results[1].model.evaluate().forward(xs[0])
+            np.testing.assert_allclose(p0, np.asarray(p1), atol=1e-5)
+            mse = float(np.mean((p0 - ys[0]) ** 2))
+            assert mse < 0.02, mse
+        finally:
+            server.stop()
+
+    def test_psi_intersection(self):
+        from bigdl_tpu.ppml import FLClient, FLServer
+
+        server = FLServer(client_num=2).build().start()
+        try:
+            ids_a = ["alice", "bob", "carol", "dave"]
+            ids_b = ["bob", "dave", "erin"]
+            out = {}
+
+            def party(name, ids):
+                c = FLClient(name, f"127.0.0.1:{server.port}")
+                salt = c.psi_get_salt()
+                c.psi_upload_set(ids, salt)
+                out[name] = c.psi_download_intersection()
+                c.close()
+
+            ts = [threading.Thread(target=party, args=("a", ids_a)),
+                  threading.Thread(target=party, args=("b", ids_b))]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(timeout=60)
+            assert out["a"] == ["bob", "dave"]
+            assert out["b"] == ["bob", "dave"]
+        finally:
+            server.stop()
