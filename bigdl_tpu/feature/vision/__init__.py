@@ -1,0 +1,23 @@
+"""Vision pipeline (ref: S:dllib/feature/transform/vision/image/ —
+ImageFrame/ImageFeature + OpenCV-JNI-backed augmentation ops).
+
+Host-side preprocessing stays on CPU (SURVEY.md §2.2: the OpenCV JNI role
+maps to host numpy/PIL); the output of the pipeline is NCHW float arrays
+ready to shard onto the mesh."""
+
+from bigdl_tpu.feature.vision.image_frame import (
+    ImageFeature, ImageFrame, LocalImageFrame)
+from bigdl_tpu.feature.vision.transforms import (
+    AspectScale, CenterCrop, ChannelNormalize, ChannelScaledNormalizer,
+    ColorJitter, FeatureTransformer, Hue, ImageFrameToSample, MatToTensor,
+    PixelBytesToMat, RandomCrop, RandomHFlip, RandomTransformer, Resize,
+    Brightness, Contrast, Saturation, HFlip)
+
+__all__ = [
+    "ImageFeature", "ImageFrame", "LocalImageFrame",
+    "FeatureTransformer", "Resize", "AspectScale", "CenterCrop",
+    "RandomCrop", "RandomHFlip", "HFlip", "ChannelNormalize",
+    "ChannelScaledNormalizer", "MatToTensor", "ImageFrameToSample",
+    "PixelBytesToMat", "Brightness", "Contrast", "Saturation", "Hue",
+    "ColorJitter", "RandomTransformer",
+]

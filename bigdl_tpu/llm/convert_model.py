@@ -1,0 +1,97 @@
+"""Checkpoint conversion tools (ref: P:llm/ggml/convert_model.py — the
+``convert_model``/``quantize`` CLI that turns an HF checkpoint into an
+on-disk ggml file).
+
+Our on-disk format: ``<out>/config.json`` + ``<out>/weights.npz`` holding
+the stacked-layer q4 planes/scales exactly as the runtime consumes them —
+load is a mmap-friendly npz read + device_put, no requantization."""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Optional
+
+import numpy as np
+
+
+def _flatten(tree, prefix=""):
+    out = {}
+    for k, v in tree.items():
+        key = f"{prefix}{k}"
+        if isinstance(v, dict):
+            out.update(_flatten(v, key + "."))
+        else:
+            a = np.asarray(v)
+            if a.dtype.name == "bfloat16":
+                # npz has no bf16; f32 widening is lossless and the loader
+                # narrows back to bf16
+                a = a.astype(np.float32)
+            out[key] = a
+    return out
+
+
+def _unflatten(flat):
+    tree = {}
+    for key, v in flat.items():
+        parts = key.split(".")
+        node = tree
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = v
+    return tree
+
+
+def save_model(model, out_dir: str):
+    """Persist a (quantized or dense) LlamaForCausalLM to disk."""
+    os.makedirs(out_dir, exist_ok=True)
+    with open(os.path.join(out_dir, "config.json"), "w") as f:
+        json.dump(dataclasses.asdict(model.config), f, indent=2)
+    np.savez(os.path.join(out_dir, "weights.npz"),
+             **_flatten(model.params))
+    return out_dir
+
+
+def load_model(model_dir: str, max_cache_len: int = 512):
+    """Load a converted model directory."""
+    import jax.numpy as jnp
+
+    from bigdl_tpu.llm.models.llama import LlamaConfig, LlamaForCausalLM
+
+    with open(os.path.join(model_dir, "config.json")) as f:
+        cfg = LlamaConfig(**json.load(f))
+    with np.load(os.path.join(model_dir, "weights.npz")) as z:
+        flat = {k: z[k] for k in z.files}
+    params = _unflatten(flat)
+
+    def to_dev(a):
+        if a.dtype == np.float32:       # norms/embeds saved via bf16→f32
+            return jnp.asarray(a, jnp.bfloat16)
+        return jnp.asarray(a)
+
+    import jax
+    params = jax.tree_util.tree_map(to_dev, params)
+    return LlamaForCausalLM(cfg, params, max_cache_len=max_cache_len)
+
+
+def convert_model(input_path, output_path: str,
+                  model_family: str = "llama",
+                  dtype: str = "int4",
+                  max_cache_len: int = 512) -> str:
+    """ref CLI: convert_model(input_path, output_path, model_family, dtype).
+
+    ``input_path`` may be an HF checkpoint dir/hub id or a LlamaConfig
+    (random init, for tests). dtype int4→sym_int4, int8→sym_int8.
+    """
+    if model_family != "llama":
+        raise NotImplementedError(
+            f"model_family {model_family!r}: llama is the implemented "
+            "family; gptneox/bloom/starcoder route through the same "
+            "convert once their jax blocks land")
+    from bigdl_tpu.llm.transformers.model import AutoModelForCausalLM
+
+    qtype = {"int4": "sym_int4", "int8": "sym_int8"}.get(dtype, dtype)
+    model = AutoModelForCausalLM.from_pretrained(
+        input_path, load_in_low_bit=qtype, max_cache_len=max_cache_len)
+    return save_model(model, output_path)

@@ -1,0 +1,104 @@
+"""LangChain integration (ref: P:llm/langchain — LLM + Embeddings
+wrappers over the ggml models).
+
+langchain isn't a baked-in dependency; the classes duck-type the
+``langchain_core`` interfaces (``invoke``/``_call``, ``embed_documents``/
+``embed_query``) so they drop into chains when langchain is installed and
+stay usable standalone when it isn't."""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import numpy as np
+
+
+class BigdlTpuLLM:
+    """ref: BigdlLLM / LlamaLLM — text-in/text-out over a converted model."""
+
+    def __init__(self, model_path: str, tokenizer=None,
+                 max_new_tokens: int = 64, temperature: float = 0.0,
+                 ctx_size: int = 512):
+        from bigdl_tpu.llm.convert_model import load_model
+
+        self.model = load_model(model_path, max_cache_len=ctx_size)
+        self.tokenizer = tokenizer
+        self.max_new_tokens = max_new_tokens
+        self.temperature = temperature
+
+    @classmethod
+    def from_model(cls, model, tokenizer=None, **kwargs) -> "BigdlTpuLLM":
+        self = cls.__new__(cls)
+        self.model = model
+        self.tokenizer = tokenizer
+        self.max_new_tokens = kwargs.get("max_new_tokens", 64)
+        self.temperature = kwargs.get("temperature", 0.0)
+        return self
+
+    # langchain LLM protocol
+    @property
+    def _llm_type(self) -> str:
+        return "bigdl_tpu"
+
+    def _encode(self, text: str) -> np.ndarray:
+        if self.tokenizer is not None:
+            return np.asarray([self.tokenizer.encode(text)], np.int32)
+        return np.asarray([[b % 256 for b in text.encode()]], np.int32)
+
+    def _decode(self, ids) -> str:
+        if self.tokenizer is not None:
+            return self.tokenizer.decode(list(ids),
+                                         skip_special_tokens=True)
+        return bytes(int(i) % 256 for i in ids).decode(errors="replace")
+
+    def _call(self, prompt: str, stop: Optional[List[str]] = None,
+              **kwargs: Any) -> str:
+        ids = self._encode(prompt)
+        out = self.model.generate(
+            ids, max_new_tokens=self.max_new_tokens,
+            do_sample=self.temperature > 0,
+            temperature=max(self.temperature, 1e-6))
+        text = self._decode(out[0, ids.shape[1]:])
+        if stop:
+            for s in stop:
+                cut = text.find(s)
+                if cut >= 0:
+                    text = text[:cut]
+        return text
+
+    invoke = _call
+    __call__ = _call
+
+
+class BigdlTpuEmbeddings:
+    """ref: llm embeddings wrapper — mean-pooled final hidden states."""
+
+    def __init__(self, model, tokenizer=None):
+        self.model = model
+        self.tokenizer = tokenizer
+
+    def _encode(self, text: str) -> np.ndarray:
+        if self.tokenizer is not None:
+            return np.asarray([self.tokenizer.encode(text)], np.int32)
+        return np.asarray([[b % 256 for b in text.encode()]], np.int32)
+
+    def embed_query(self, text: str) -> List[float]:
+        import jax.numpy as jnp
+
+        from bigdl_tpu.llm.models.llama import forward, init_cache
+
+        ids = self._encode(text)
+        cfg = self.model.config
+        cache = init_cache(cfg, 1, ids.shape[1])
+        pos = jnp.arange(ids.shape[1])[None, :]
+        # logits are a poor embedding; pool the pre-head hidden state by
+        # re-running forward without lm_head
+        params = dict(self.model.params)
+        params.pop("lm_head", None)
+        logits, _ = forward(params, cfg, jnp.asarray(ids), cache, pos)
+        # tied-embedding logits = h @ E^T; mean-pool over sequence
+        emb = np.asarray(logits).mean(axis=1)[0]
+        return [float(v) for v in emb]
+
+    def embed_documents(self, texts: List[str]) -> List[List[float]]:
+        return [self.embed_query(t) for t in texts]

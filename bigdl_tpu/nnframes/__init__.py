@@ -1,0 +1,14 @@
+"""bigdl_tpu.nnframes — DataFrame ML pipeline integration (ref:
+S:dllib/nnframes + P:dllib/nnframes: Spark-ML Estimator/Transformer
+wrappers NNEstimator/NNModel/NNClassifier/NNImageReader).
+
+The Spark DataFrame substrate maps to pandas here (SURVEY.md §7.2 step 5:
+"whatever Spark-less DataFrame equivalent we define"); the fit/transform
+contract, column conventions (featuresCol/labelCol/predictionCol) and the
+sklearn-style pipeline compatibility are preserved."""
+
+from bigdl_tpu.nnframes.nn_estimator import (
+    NNClassifier, NNClassifierModel, NNEstimator, NNImageReader, NNModel)
+
+__all__ = ["NNEstimator", "NNModel", "NNClassifier", "NNClassifierModel",
+           "NNImageReader"]

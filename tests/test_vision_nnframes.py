@@ -1,0 +1,125 @@
+"""Vision pipeline + NNFrames tests (ref patterns: vision transformer
+specs + NNEstimator/NNClassifier specs, SURVEY.md §4)."""
+
+import io
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.feature.vision import (
+    AspectScale, CenterCrop, ChannelNormalize, ColorJitter, HFlip,
+    ImageFeature, ImageFrame, ImageFrameToSample, MatToTensor,
+    PixelBytesToMat, RandomCrop, RandomHFlip, Resize)
+from bigdl_tpu.nn.module import set_seed
+from bigdl_tpu.nnframes import NNClassifier, NNEstimator
+from bigdl_tpu.optim.optim_method import Adam
+
+
+def _png_bytes(h=32, w=48):
+    from PIL import Image
+
+    rs = np.random.RandomState(0)
+    img = Image.fromarray(rs.randint(0, 255, (h, w, 3), dtype=np.uint8))
+    buf = io.BytesIO()
+    img.save(buf, format="PNG")
+    return buf.getvalue()
+
+
+class TestVisionPipeline:
+    def test_decode_resize_crop_normalize_chain(self):
+        feat = ImageFeature(data=_png_bytes(), label=1.0)
+        pipeline = (PixelBytesToMat() >> Resize(40, 40)
+                    >> CenterCrop(32, 32)
+                    >> ChannelNormalize(123, 117, 104, 58, 57, 57)
+                    >> MatToTensor() >> ImageFrameToSample())
+        feat = pipeline(feat)
+        sample = feat[ImageFeature.SAMPLE]
+        assert sample.feature().shape == (3, 32, 32)
+        assert float(sample.labels[0]) == 1.0
+        assert abs(float(sample.feature().mean())) < 3.0  # normalized
+
+    def test_aspect_scale_keeps_ratio(self):
+        feat = ImageFeature(data=_png_bytes(h=100, w=200))
+        feat = (PixelBytesToMat() >> AspectScale(50))(feat)
+        h, w = feat[ImageFeature.MAT].shape[:2]
+        assert h == 50 and w == 100
+
+    def test_hflip_and_random_ops(self):
+        mat = np.arange(2 * 4 * 3).reshape(2, 4, 3).astype(np.uint8)
+        feat = ImageFeature()
+        feat[ImageFeature.MAT] = mat
+        flipped = HFlip()(feat)[ImageFeature.MAT]
+        np.testing.assert_array_equal(flipped, mat[:, ::-1])
+        feat2 = ImageFeature()
+        feat2[ImageFeature.MAT] = np.zeros((8, 8, 3), np.uint8)
+        out = (RandomCrop(4, 4, seed=0) >> RandomHFlip(seed=0))(feat2)
+        assert out[ImageFeature.MAT].shape == (4, 4, 3)
+
+    def test_color_jitter_stays_in_range(self):
+        feat = ImageFeature(data=_png_bytes())
+        feat = (PixelBytesToMat() >> ColorJitter(seed=0))(feat)
+        mat = feat[ImageFeature.MAT]
+        assert mat.min() >= 0 and mat.max() <= 255
+
+    def test_image_frame_read_and_transform(self, tmp_path):
+        p = tmp_path / "img0.png"
+        p.write_bytes(_png_bytes())
+        frame = ImageFrame.read(str(tmp_path / "*.png"))
+        assert len(frame) == 1
+        frame.transform(PixelBytesToMat() >> Resize(16, 16)
+                        >> MatToTensor() >> ImageFrameToSample())
+        samples = frame.to_samples()
+        assert samples[0].feature().shape == (3, 16, 16)
+
+    def test_failure_isolation(self):
+        bad = ImageFeature(data=b"not an image")
+        out = PixelBytesToMat()(bad)
+        assert out.get("isValid") is False
+
+
+class TestNNFrames:
+    def test_nnclassifier_fit_transform(self):
+        set_seed(0)
+        rs = np.random.RandomState(0)
+        x = rs.rand(128, 8).astype(np.float32)
+        w = rs.randn(8, 3).astype(np.float32)
+        labels = (x @ w).argmax(1) + 1.0  # 1-based like Spark ML
+        df = pd.DataFrame({"features": [list(r) for r in x],
+                           "label": labels})
+        model = (nn.Sequential().add(nn.Linear(8, 16)).add(nn.ReLU())
+                 .add(nn.Linear(16, 3)).add(nn.LogSoftMax()))
+        clf = (NNClassifier(model, nn.ClassNLLCriterion())
+               .set_batch_size(32).set_max_epoch(30)
+               .set_optim_method(Adam(learning_rate=0.01)))
+        fitted = clf.fit(df)
+        out = fitted.transform(df)
+        acc = float((out["prediction"].to_numpy() == labels).mean())
+        assert acc > 0.9, acc
+
+    def test_nnestimator_regression_feature_size(self):
+        set_seed(1)
+        rs = np.random.RandomState(1)
+        x = rs.rand(96, 4).astype(np.float32)
+        y = x.sum(1) * 2
+        df = pd.DataFrame({"feat": [list(r) for r in x],
+                           "target": [[v] for v in y]})
+        from bigdl_tpu.optim.optim_method import SGD
+        model = nn.Sequential().add(nn.Linear(4, 1))
+        est = (NNEstimator(model, nn.MSECriterion(), feature_size=[4])
+               .set_features_col("feat").set_label_col("target")
+               .set_batch_size(16).set_max_epoch(60)
+               .set_optim_method(SGD(learning_rate=0.3)))
+        fitted = est.fit(df)
+        res = fitted.transform(df)
+        pred = np.stack(res["prediction"].to_numpy()).squeeze()
+        assert float(np.mean((pred - y) ** 2)) < 0.05
+
+    def test_nn_image_reader(self, tmp_path):
+        from bigdl_tpu.nnframes import NNImageReader
+
+        (tmp_path / "a.png").write_bytes(_png_bytes(16, 16))
+        df = NNImageReader.read_images(str(tmp_path / "*.png"))
+        assert len(df) == 1
+        assert df["image"][0].shape == (16, 16, 3)
