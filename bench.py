@@ -1,16 +1,24 @@
 """Benchmark entry point — prints ONE JSON line for the driver.
 
 Headline: ResNet-50 ImageNet-shape synchronous training throughput in
-images/sec/chip (the BASELINE.json north-star metric) in bf16 on whatever
-accelerator jax exposes (one real TPU chip under the driver). ``--llama``
-reports the second north-star, Llama-2-7B q4_0 decode tokens/sec.
+images/sec/chip (BASELINE.json north-star config 2) in bf16, with MFU
+computed from XLA's compiled cost analysis and asserted ``<= 1.0`` —
+round 1 recorded a physically impossible number (~196% MFU) because the
+timed window trusted ``block_until_ready`` over a 10-iteration async
+dispatch; this harness instead closes every timed window with a literal
+device-to-host fetch of a value that data-depends on the whole loop
+(donated params chain each step to the next), which cannot complete
+before the compute has actually run.
 
-The reference published no harvestable numbers this round (BASELINE.md):
+The default run also folds in the second north star (BASELINE config 5,
+Llama-2-7B q4_0 decode tokens/sec) plus an int4-vs-dense matmul kernel
+micro-bench under ``extra``, so one driver invocation records all of it.
+
+The reference published no harvestable numbers (BASELINE.md):
 ``vs_baseline`` is ``null``. ``--quick`` shrinks configs for CPU smoke
-runs and prefixes the metric name with ``smoke_`` so dashboards never
-ingest smoke numbers as flagship results; ``--cpu`` forces the CPU
-backend (the env-var route is ineffective under this image's
-sitecustomize).
+runs and prefixes metric names with ``smoke_`` so dashboards never ingest
+smoke numbers as flagship results; ``--cpu`` forces the CPU backend (the
+env-var route is ineffective under this image's sitecustomize).
 """
 
 from __future__ import annotations
@@ -20,11 +28,51 @@ import time
 
 import numpy as np
 
+# Peak dense bf16 FLOP/s per chip by PJRT device_kind (public spec sheets).
+# Matched by substring, lowercased. Used only for the MFU sanity number.
+_PEAK_BF16_FLOPS = [
+    ("v6", 918e12),           # Trillium / v6e
+    ("v5p", 459e12),
+    ("v5", 197e12),           # v5e / "TPU v5 lite"
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+]
+
+
+def _peak_flops(device) -> float | None:
+    kind = getattr(device, "device_kind", "").lower()
+    if "tpu" not in kind and device.platform != "tpu":
+        return None
+    for key, peak in _PEAK_BF16_FLOPS:
+        if key in kind:
+            return peak
+    return None
+
+
+def _flops_of(compiled) -> float | None:
+    """Model FLOPs per step from XLA cost analysis (version-tolerant)."""
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:
+        return None
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    flops = ca.get("flops")
+    return float(flops) if flops else None
+
 
 def _bench_train(model, make_batch, metric: str, batch_size: int,
                  warmup: int, iters: int, lr: float, optim,
-                 extra: dict) -> dict:
-    """Shared train-step timing harness: jit+donate, warmup, timed loop."""
+                 extra: dict, unit: str = "images/sec/chip",
+                 n_batches: int = 4) -> dict:
+    """Shared train-step timing harness: jit+donate, warmup, timed loop.
+
+    The timed window ends with a host fetch of the final loss scalar; the
+    loss of iteration i depends (via donated params) on every iteration
+    before it, so the fetch bounds the true wall-clock of all ``iters``
+    steps regardless of how the runtime implements readiness.
+    """
     import jax
     import jax.numpy as jnp
 
@@ -47,37 +95,75 @@ def _bench_train(model, make_batch, metric: str, batch_size: int,
         return new_params, new_states, new_opt, loss
 
     step = jax.jit(train_step, donate_argnums=(0, 1, 2))
-    x, t = make_batch()
+    # rotate over several distinct batches so the loop is not single-batch
+    # memorization (VERDICT r1 weak #10)
+    batches = [make_batch() for _ in range(n_batches)]
     key = jax.random.PRNGKey(0)
 
-    for _ in range(warmup):
+    key, sub = jax.random.split(key)
+    lowered = step.lower(params, states, opt_state, *batches[0], sub)
+    compiled = lowered.compile()
+    flops_per_step = _flops_of(compiled)
+
+    for i in range(warmup):
         key, sub = jax.random.split(key)
+        x, t = batches[i % n_batches]
         params, states, opt_state, loss = step(params, states, opt_state,
                                                x, t, sub)
-    jax.block_until_ready(loss)
+    float(loss)  # full sync before the timed window opens
 
     t0 = time.perf_counter()
-    for _ in range(iters):
+    for i in range(iters):
         key, sub = jax.random.split(key)
+        x, t = batches[i % n_batches]
         params, states, opt_state, loss = step(params, states, opt_state,
                                                x, t, sub)
-    jax.block_until_ready(loss)
+    final_loss = float(loss)  # host fetch closes the window
     dt = time.perf_counter() - t0
 
-    import jax as _jax
+    # per-step latency, synchronously (separate from the pipelined window)
+    sync_times = []
+    for _ in range(min(10, iters)):
+        key, sub = jax.random.split(key)
+        s0 = time.perf_counter()
+        params, states, opt_state, loss = step(params, states, opt_state,
+                                               *batches[0], sub)
+        float(loss)
+        sync_times.append(time.perf_counter() - s0)
+
+    dev = jax.devices()[0]
+    peak = _peak_flops(dev)
+    mfu = None
+    if peak and flops_per_step:
+        mfu = flops_per_step * iters / dt / peak
+        assert mfu <= 1.0, (
+            f"measured MFU {mfu:.2%} exceeds hardware peak — the timing is "
+            f"broken (flops/step={flops_per_step:.3e}, steps/s={iters/dt:.2f}, "
+            f"peak={peak:.3e} FLOP/s on {dev.device_kind}); refusing to "
+            f"report an impossible number")
+
     return {
         "metric": metric,
         "value": round(batch_size * iters / dt, 2),
-        "unit": "images/sec/chip",
+        "unit": unit,
         "vs_baseline": None,  # no reference number harvestable (BASELINE.md)
         "extra": {**extra, "batch_size": batch_size, "iters": iters,
-                  "backend": _jax.default_backend(),
-                  "final_loss": float(loss)},
+                  "step_ms": round(dt / iters * 1e3, 3),
+                  "step_ms_sync_median": round(
+                      float(np.median(sync_times)) * 1e3, 3),
+                  "flops_per_step": flops_per_step,
+                  "achieved_tflops": (round(flops_per_step * iters / dt / 1e12,
+                                            2) if flops_per_step else None),
+                  "mfu": round(mfu, 4) if mfu is not None else None,
+                  "peak_flops": peak,
+                  "device_kind": getattr(dev, "device_kind", str(dev)),
+                  "backend": jax.default_backend(),
+                  "final_loss": final_loss},
     }
 
 
 def bench_lenet_train(batch_size: int = 512, warmup: int = 5,
-                      iters: int = 30) -> dict:
+                      iters: int = 50) -> dict:
     import jax.numpy as jnp
 
     from bigdl_tpu.models import lenet
@@ -97,8 +183,8 @@ def bench_lenet_train(batch_size: int = 512, warmup: int = 5,
                         extra={})
 
 
-def bench_resnet50_train(batch_size: int = 32, warmup: int = 3,
-                         iters: int = 10, image: int = 224,
+def bench_resnet50_train(batch_size: int = 32, warmup: int = 5,
+                         iters: int = 50, image: int = 224,
                          depth: int = 50, classes: int = 1000,
                          smoke: bool = False) -> dict:
     """North-star: ResNet train-step throughput, bf16 params/compute."""
@@ -169,23 +255,45 @@ def _synthetic_q4_llama_params(cfg, seed: int = 0):
     }
 
 
+def _q4_param_bytes(cfg) -> int:
+    """On-device bytes of the quantized decoder weights that each decoded
+    token must stream from HBM (q nibbles + fp16 scales), for the
+    bandwidth-roofline sanity number."""
+    from bigdl_tpu.llm.ggml.quantize import QK
+    from bigdl_tpu.llm.models.llama import _LAYER_LINEARS, linear_shapes
+
+    shapes = linear_shapes(cfg)
+    L = cfg.num_hidden_layers
+    total = 0
+    for name in _LAYER_LINEARS:
+        n, k = shapes[name]
+        total += L * (n * k // 2 + n * (k // QK) * 2)
+    # lm_head is bf16 in this build
+    total += cfg.vocab_size * cfg.hidden_size * 2
+    return total
+
+
 def bench_llama_int4_decode(model_size: str = "7b", batch: int = 1,
                             prompt_len: int = 128, decode_tokens: int = 64,
                             max_cache: int = 256,
                             smoke: bool = False) -> dict:
     """North-star 2: Llama q4_0 decode throughput — prefill runs OUTSIDE
-    the timed window; only the autoregressive decode loop is measured."""
+    the timed window; only the autoregressive decode loop is measured.
+    The timed window closes with a host fetch of the last-step logits
+    (each decode step feeds the argmax of the previous step's fetch-free
+    logits, so the chain serializes on real compute)."""
     import jax
     import jax.numpy as jnp
 
     from bigdl_tpu.llm.models.llama import (
-        LlamaConfig, LlamaForCausalLM, init_cache)
+        LlamaConfig, LlamaForCausalLM)
 
     cfg = {"7b": LlamaConfig.llama2_7b,
            "8b": LlamaConfig.llama3_8b,
            "tiny": LlamaConfig.tiny}[model_size]()
     limit = min(max_cache, cfg.max_position_embeddings)
-    prompt_len = min(prompt_len, limit - decode_tokens - 1)
+    # cache budget: prompt + 2 warm-up decode steps + the timed window
+    prompt_len = min(prompt_len, limit - decode_tokens - 2)
     params = _synthetic_q4_llama_params(cfg)
     model = LlamaForCausalLM(cfg, params, max_cache_len=limit)
 
@@ -199,29 +307,104 @@ def bench_llama_int4_decode(model_size: str = "7b", batch: int = 1,
             nxt = jnp.argmax(last, axis=-1).astype(jnp.int32)[:, None]
             logits, cache = model(nxt, cache)
             last = logits[:, -1]
-        jax.block_until_ready(last)
-        return logits, cache
+        return last, logits, cache
 
     # prefill + decode-step compile happen before the timer
     logits, cache = model(ids)
-    logits, cache = decode_loop(logits, cache, 2)
+    last, logits, cache = decode_loop(logits, cache, 2)
+    np.asarray(last)  # full sync
 
     t0 = time.perf_counter()
-    decode_loop(logits, cache, decode_tokens)
+    last, logits, cache = decode_loop(logits, cache, decode_tokens)
+    np.asarray(last)  # host fetch closes the window
     dt = time.perf_counter() - t0
+
+    tok_s = decode_tokens * batch / dt
+    weight_bytes = _q4_param_bytes(cfg)
+    hbm_gbs = tok_s * weight_bytes / 1e9  # lower bound: weights re-read/token
 
     name = "llama2_7b_int4_decode_throughput"
     return {
         "metric": ("smoke_" + name) if smoke else name,
-        "value": round(decode_tokens * batch / dt, 2),
+        "value": round(tok_s, 2),
         "unit": "tokens/sec",
         "vs_baseline": None,  # no reference number harvestable (BASELINE.md)
         "extra": {
             "model": model_size, "batch": batch, "prompt_len": prompt_len,
             "decode_tokens": decode_tokens, "qtype": "sym_int4",
+            "step_ms": round(dt / decode_tokens * 1e3, 3),
+            "weight_bytes": weight_bytes,
+            "implied_hbm_gbs": round(hbm_gbs, 1),
             "backend": jax.default_backend(),
         },
     }
+
+
+def bench_int4_kernel_micro(m: int = 1, k: int = 4096, n: int = 11008,
+                            iters: int = 30) -> dict:
+    """Kernel roofline check: Pallas q4_0 matmul vs dense bf16 matmul at a
+    7B ffn shape. Decode (m=1) should be HBM-bound, so int4 at ~4.5
+    bits/weight targets >2.5x the dense bf16 step time."""
+    import jax
+    import jax.numpy as jnp
+
+    from bigdl_tpu.llm.ggml.quantize import QK
+    from bigdl_tpu.llm.models.llama import _linear
+
+    key = jax.random.PRNGKey(0)
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    x = jax.random.normal(k1, (m, k), jnp.bfloat16)
+    q = jax.random.randint(k2, (n, k // 2), 0, 256, jnp.uint8)
+    scale = jax.random.uniform(k3, (n, k // QK), jnp.float32,
+                               0.001, 0.02).astype(jnp.float16)
+    w_dense = jax.random.normal(k4, (n, k), jnp.bfloat16)
+
+    # same dispatch the model uses: Pallas q4_0 kernel on TPU, dequant
+    # matmul elsewhere
+    f_int4 = jax.jit(lambda x, q, s: _linear({"q": q, "scale": s}, x))
+    f_dense = jax.jit(lambda x, w: _linear({"w": w}, x))
+
+    def timeit(f, *args):
+        np.asarray(f(*args))  # compile + sync
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = f(*args)
+        np.asarray(out)
+        return (time.perf_counter() - t0) / iters
+
+    t_int4 = timeit(f_int4, x, q, scale)
+    t_dense = timeit(f_dense, x, w_dense)
+    return {
+        "shape": [m, k, n], "iters": iters,
+        "int4_us": round(t_int4 * 1e6, 1),
+        "dense_bf16_us": round(t_dense * 1e6, 1),
+        "int4_speedup_vs_dense": round(t_dense / t_int4, 2),
+    }
+
+
+def _default_run(quick: bool) -> dict:
+    """The driver-captured output: resnet headline + llama decode +
+    kernel micro-bench folded into one JSON object."""
+    if quick:
+        out = bench_resnet50_train(batch_size=4, warmup=1, iters=5,
+                                   image=64, depth=18, classes=100,
+                                   smoke=True)
+        try:
+            out["extra"]["llama_int4_decode"] = bench_llama_int4_decode(
+                model_size="tiny", smoke=True)
+        except Exception as e:  # never lose the headline to a side metric
+            out["extra"]["llama_int4_decode"] = {"error": repr(e)}
+        return out
+    out = bench_resnet50_train()
+    try:
+        out["extra"]["llama_int4_decode"] = bench_llama_int4_decode()
+    except Exception as e:
+        out["extra"]["llama_int4_decode"] = {"error": repr(e)}
+    try:
+        out["extra"]["int4_kernel_micro"] = bench_int4_kernel_micro()
+    except Exception as e:
+        out["extra"]["int4_kernel_micro"] = {"error": repr(e)}
+    return out
 
 
 if __name__ == "__main__":
@@ -233,6 +416,9 @@ if __name__ == "__main__":
         # ineffective — the in-process config update is the working override
         import jax
         jax.config.update("jax_platforms", "cpu")
+    if "--profile" in sys.argv:
+        import jax
+        jax.profiler.start_trace("/tmp/bigdl_tpu_trace")
     quick = "--quick" in sys.argv or bool(os.environ.get(
         "BIGDL_TPU_BENCH_QUICK"))
     if "--lenet" in sys.argv:
@@ -243,9 +429,10 @@ if __name__ == "__main__":
                 model_size="tiny", smoke=True)))
         else:
             print(json.dumps(bench_llama_int4_decode()))
-    elif quick:
-        print(json.dumps(bench_resnet50_train(
-            batch_size=4, warmup=1, iters=3, image=64, depth=18,
-            classes=100, smoke=True)))
+    elif "--kernels" in sys.argv:
+        print(json.dumps(bench_int4_kernel_micro()))
     else:
-        print(json.dumps(bench_resnet50_train()))
+        print(json.dumps(_default_run(quick)))
+    if "--profile" in sys.argv:
+        import jax
+        jax.profiler.stop_trace()

@@ -128,7 +128,7 @@ class _Compiled:
         opt = _optim.Optimizer(
             self.module, data, self._criterion, batch_size=batch_size,
             end_trigger=Trigger.max_epoch(nb_epoch),
-            distributed=distributed if distributed else None)
+            distributed=distributed)
         opt.set_optim_method(self._optim_method)
         if validation_data is not None and self._metrics:
             opt.set_validation(Trigger.every_epoch(), validation_data,

@@ -108,12 +108,14 @@ void quantize_q4_0(const float* w, int64_t n, int64_t k,
             uint16_t sh = f32_to_f16_bits(amax / 7.0f);
             srow[b] = sh;
             float s = f16_bits_to_f32(sh);
-            float inv = s > 0.f ? 1.0f / s : 0.0f;
+            // divide (not multiply-by-reciprocal): bit-parity with np.divide
+            float div = s > 0.f ? s : 1.0f;
+            float z = s > 0.f ? 1.0f : 0.0f;
             uint8_t* qb = qrow + b * (QK / 2);
             for (int i = 0; i < QK / 2; ++i) {
                 // plane-split packing: low nibble = even k, high = odd k
-                int lo = clampi(blk[2 * i] * inv, -7, 7) + 8;
-                int hi = clampi(blk[2 * i + 1] * inv, -7, 7) + 8;
+                int lo = clampi(blk[2 * i] * z / div, -7, 7) + 8;
+                int hi = clampi(blk[2 * i + 1] * z / div, -7, 7) + 8;
                 qb[i] = (uint8_t)((lo & 0xF) | (hi << 4));
             }
         }
@@ -158,10 +160,12 @@ void quantize_q8_0(const float* w, int64_t n, int64_t k,
             uint16_t sh = f32_to_f16_bits(amax / 127.0f);
             srow[b] = sh;
             float s = f16_bits_to_f32(sh);
-            float inv = s > 0.f ? 1.0f / s : 0.0f;
+            // divide (not multiply-by-reciprocal): bit-parity with np.divide
+            float div = s > 0.f ? s : 1.0f;
+            float z = s > 0.f ? 1.0f : 0.0f;
             int8_t* qb = qrow + b * QK;
             for (int i = 0; i < QK; ++i)
-                qb[i] = clampi(blk[i] * inv, -127, 127);
+                qb[i] = clampi(blk[i] * z / div, -127, 127);
         }
     }
 }

@@ -28,9 +28,13 @@ NEG_INF = -1e30
 def _varying(x, like):
     """Make a locally-created array inherit ``like``'s varying-manual-axes
     type — required by jax>=0.9 shard_map VMA typing when the array enters a
-    scan carry whose other leg went through a collective. The zero-valued
-    summand is DCE'd by XLA."""
-    return x + jnp.zeros((), x.dtype) * like.astype(x.dtype).ravel()[0]
+    scan carry whose other leg went through a collective. Uses ``lax.pcast``
+    (a pure type cast, no data dependence on ``like``'s values, so a
+    poisoned inf/NaN in ``like`` cannot corrupt ``x``)."""
+    vma = tuple(jax.typeof(like).vma - jax.typeof(x).vma)
+    if not vma:
+        return x
+    return lax.pcast(x, vma, to="varying")
 
 
 def _block_attn(q, k, v, acc, row_max, row_sum, *, scale,

@@ -69,7 +69,15 @@ class FLServer:
     def _serve_client(self, conn: socket.socket):
         try:
             while not self._stop.is_set():
-                msg = recv_msg(conn)
+                try:
+                    msg = recv_msg(conn)
+                except (ValueError, TypeError, KeyError) as e:
+                    # malformed message from an untrusted peer: reply with
+                    # an error and drop the connection (the stream offset
+                    # can no longer be trusted)
+                    send_msg(conn, {"status": "error",
+                                    "error": f"malformed message: {e}"})
+                    return
                 handler = getattr(self, f"_on_{msg['type']}", None)
                 if handler is None:
                     send_msg(conn, {"status": "error",

@@ -1,24 +1,171 @@
-"""Wire protocol: length-prefixed pickled dicts over TCP (the reference
-uses gRPC protobuf services — FLServer/NNService/PSIService; same message
-shapes, simpler transport)."""
+"""Wire protocol for the FL server/client: length-prefixed messages of
+JSON structure + raw numpy buffers over TCP.
+
+The reference uses gRPC/protobuf services (FLServer/NNService/PSIService);
+we keep the same message shapes over a simpler transport. Crucially the
+format is **data-only** — federated peers are across a trust boundary, so
+the wire format must not be able to execute code on decode (pickle would).
+Supported values: None/bool/int/float/str/bytes, lists/tuples, dicts with
+str keys, and numpy arrays of a whitelisted numeric dtype. Message size is
+capped at :data:`MAX_MESSAGE_BYTES`.
+
+Layout per message::
+
+    >I total_len | >I header_len | header JSON (utf-8) | raw array/bytes blobs
+
+The header JSON mirrors the object tree; array/bytes leaves are replaced by
+``{"__blob__": i, "dtype": ..., "shape": ...}`` descriptors indexing the
+blob section in order.
+"""
 
 from __future__ import annotations
 
-import pickle
+import json
 import socket
 import struct
-from typing import Any
+from typing import Any, List
+
+import numpy as np
+
+
+def _np_dtype(name: str) -> np.dtype:
+    if name == "bfloat16":  # not a stock numpy dtype; jax ships ml_dtypes
+        import ml_dtypes
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(name)
+
+MAX_MESSAGE_BYTES = 256 * 1024 * 1024
+
+_ALLOWED_DTYPES = {
+    "float16", "float32", "float64", "bfloat16",
+    "int8", "int16", "int32", "int64",
+    "uint8", "uint16", "uint32", "uint64", "bool",
+}
+
+
+def _encode(obj: Any, blobs: List[bytes]) -> Any:
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return obj
+    if isinstance(obj, bytes):
+        blobs.append(obj)
+        return {"__blob__": len(blobs) - 1, "dtype": "bytes", "shape": None,
+                "size": len(obj)}
+    if isinstance(obj, (list, tuple)):
+        node = [_encode(v, blobs) for v in obj]
+        return node if isinstance(obj, list) else {"__tuple__": node}
+    if isinstance(obj, dict):
+        out = {}
+        for k, v in obj.items():
+            if not isinstance(k, str):
+                raise TypeError(f"dict keys must be str, got {type(k)}")
+            if k.startswith("__") and k.endswith("__"):
+                raise ValueError(f"reserved key name on the wire: {k!r}")
+            out[k] = _encode(v, blobs)
+        return out
+    arr = np.asarray(obj)
+    name = arr.dtype.name
+    if name not in _ALLOWED_DTYPES:
+        raise TypeError(f"dtype {name} not allowed on the FL wire")
+    raw = np.ascontiguousarray(arr).tobytes()
+    blobs.append(raw)
+    return {"__blob__": len(blobs) - 1, "dtype": name,
+            "shape": list(arr.shape), "size": len(raw)}
+
+
+def _decode(node: Any, blobs: List[bytes]) -> Any:
+    if node is None or isinstance(node, (bool, int, float, str)):
+        return node
+    if isinstance(node, list):
+        return [_decode(v, blobs) for v in node]
+    if isinstance(node, dict):
+        if "__tuple__" in node:
+            return tuple(_decode(v, blobs) for v in node["__tuple__"])
+        if "__blob__" in node:
+            raw = blobs[node["__blob__"]]
+            if node["dtype"] == "bytes":
+                return raw
+            if node["dtype"] not in _ALLOWED_DTYPES:
+                raise TypeError(f"dtype {node['dtype']} not allowed")
+            arr = np.frombuffer(raw, dtype=_np_dtype(node["dtype"]))
+            return arr.reshape(node["shape"]).copy()
+        return {k: _decode(v, blobs) for k, v in node.items()}
+    raise TypeError(f"undecodable node type {type(node)}")
+
+
+def dumps(obj: Any) -> bytes:
+    blobs: List[bytes] = []
+    header = json.dumps(_encode(obj, blobs)).encode("utf-8")
+    body = struct.pack(">I", len(header)) + header + b"".join(blobs)
+    if len(body) > MAX_MESSAGE_BYTES:
+        raise ValueError(f"message of {len(body)} bytes exceeds cap")
+    return body
+
+
+def loads(data: bytes) -> Any:
+    (hlen,) = struct.unpack(">I", data[:4])
+    header = json.loads(data[4:4 + hlen].decode("utf-8"))
+    blob_section = data[4 + hlen:]
+    # Re-slice the blob section in the order descriptors were emitted.
+    # The header is attacker-controlled: indices must be exactly 0..n-1,
+    # sizes non-negative, and the section length must match exactly.
+    sizes = _blob_sizes(header)
+    if any(s < 0 for s in sizes):
+        raise ValueError("negative blob size in message header")
+    if sum(sizes) != len(blob_section):
+        raise ValueError(
+            f"blob section is {len(blob_section)} bytes but header "
+            f"declares {sum(sizes)}")
+    blobs: List[bytes] = []
+    offset = 0
+    for size in sizes:
+        blobs.append(blob_section[offset:offset + size])
+        offset += size
+    return _decode(header, blobs)
+
+
+def _blob_sizes(node: Any) -> List[int]:
+    """Walk the header collecting each blob's byte size by blob index.
+
+    Raises ``ValueError`` unless the blob indices are exactly ``0..n-1``
+    with no duplicates (the header comes from an untrusted peer).
+    """
+    sizes: dict = {}
+
+    def walk(n):
+        if isinstance(n, list):
+            for v in n:
+                walk(v)
+        elif isinstance(n, dict):
+            if "__tuple__" in n:
+                walk(n["__tuple__"])
+            elif "__blob__" in n:
+                idx = n["__blob__"]
+                if not isinstance(idx, int) or idx in sizes:
+                    raise ValueError("bad or duplicate blob index")
+                if not isinstance(n.get("size"), int):
+                    raise ValueError("missing blob size")
+                sizes[idx] = n["size"]
+            else:
+                for v in n.values():
+                    walk(v)
+
+    walk(node)
+    if sorted(sizes) != list(range(len(sizes))):
+        raise ValueError("non-contiguous blob indices in message header")
+    return [sizes[i] for i in sorted(sizes)]
 
 
 def send_msg(sock: socket.socket, obj: Any):
-    payload = pickle.dumps(obj)
+    payload = dumps(obj)
     sock.sendall(struct.pack(">I", len(payload)) + payload)
 
 
 def recv_msg(sock: socket.socket) -> Any:
     header = _recv_exact(sock, 4)
     (length,) = struct.unpack(">I", header)
-    return pickle.loads(_recv_exact(sock, length))
+    if length > MAX_MESSAGE_BYTES:
+        raise ValueError(f"incoming message of {length} bytes exceeds cap")
+    return loads(_recv_exact(sock, length))
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
