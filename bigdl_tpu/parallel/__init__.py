@@ -20,7 +20,9 @@ from bigdl_tpu.parallel.collectives import (
 )
 from bigdl_tpu.parallel.ring_attention import ring_attention, ring_self_attention
 from bigdl_tpu.parallel.ulysses import ulysses_attention
-from bigdl_tpu.parallel.pipeline import pipeline_stage_fn, PipelineModule
+from bigdl_tpu.parallel.pipeline import (
+    make_pipeline_train_step, pipeline_stage_fn, PipelineModule,
+    split_microbatches)
 from bigdl_tpu.parallel.data_parallel import (
     dp_train_step, tp_linear_spec, param_shardings,
 )
@@ -32,5 +34,6 @@ __all__ = [
     "ppermute_next", "barrier_sum", "compressed_all_reduce",
     "ring_attention", "ring_self_attention", "ulysses_attention",
     "pipeline_stage_fn", "PipelineModule",
+    "make_pipeline_train_step", "split_microbatches",
     "dp_train_step", "tp_linear_spec", "param_shardings",
 ]

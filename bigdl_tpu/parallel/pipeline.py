@@ -88,7 +88,7 @@ class PipelineModule:
     """
 
     def __init__(self, stage_apply: Callable, n_stages: int,
-                 mesh: Mesh, axis: str = "pipe"):
+                 mesh: Mesh, axis: str = "pipe", remat: bool = False):
         if axis not in mesh.axis_names:
             raise ValueError(f"mesh has no axis {axis!r}")
         if mesh.shape[axis] != n_stages:
@@ -99,6 +99,11 @@ class PipelineModule:
         self.n_stages = n_stages
         from jax import shard_map
 
+        if remat:
+            # recompute stage activations in the backward schedule instead
+            # of storing every tick's outputs (GPipe's activation memory
+            # trade — jax.checkpoint is the XLA-native rematerialization)
+            stage_apply = jax.checkpoint(stage_apply)
         body = pipeline_stage_fn(
             lambda p, x: stage_apply(
                 jax.tree_util.tree_map(lambda l: l[0], p), x),
@@ -117,3 +122,49 @@ class PipelineModule:
         sh = NamedSharding(self.mesh, P(self.axis))
         return jax.tree_util.tree_map(
             lambda l: jax.device_put(l, sh), stacked_params)
+
+
+def split_microbatches(batch, n_micro: int):
+    """(B, ...) -> (n_micro, B/n_micro, ...) pytree-wise."""
+    def split(a):
+        b = a.shape[0]
+        if b % n_micro:
+            raise ValueError(f"batch {b} not divisible by {n_micro}")
+        return a.reshape((n_micro, b // n_micro) + a.shape[1:])
+
+    return jax.tree_util.tree_map(split, batch)
+
+
+def make_pipeline_train_step(pipe: PipelineModule, loss_fn: Callable,
+                             optim, lr: float):
+    """Pipeline *training*: GPipe schedule with gradient accumulation.
+
+    The forward schedule in :func:`pipeline_stage_fn` is pure jax (scan +
+    ppermute + select), so reverse-mode autodiff through it IS the GPipe
+    backward schedule: XLA transposes the scan into the reverse tick
+    order, ppermutes flow the activation cotangents stage-to-stage the
+    opposite way around the ring, and each stage's weight gradient
+    accumulates over its microbatches inside the scan transpose — the
+    hand-written backward ring of the GPU frameworks falls out of the
+    program transform. Use ``PipelineModule(remat=True)`` to recompute
+    activations in the backward pass instead of storing every tick.
+
+    ``loss_fn(outputs, targets) -> scalar`` sees the full
+    ``(n_micro, mb, ...)`` stacks (mean over both axes for the standard
+    per-example mean loss).
+
+    Returns ``step(stacked_params, opt_state, microbatches, targets) ->
+    (new_params, new_opt_state, loss)``, jitted with donated state.
+    """
+
+    def step(stacked_params, opt_state, microbatches, micro_targets):
+        def loss(p):
+            outs = pipe(p, microbatches)
+            return loss_fn(outs, micro_targets)
+
+        l, grads = jax.value_and_grad(loss)(stacked_params)
+        new_params, new_opt = optim.step(stacked_params, grads,
+                                         opt_state, lr)
+        return new_params, new_opt, l
+
+    return jax.jit(step, donate_argnums=(0, 1))

@@ -37,30 +37,55 @@ def _varying(x, like):
     return lax.pcast(x, vma, to="varying")
 
 
-def _block_attn(q, k, v, acc, row_max, row_sum, *, scale,
-                q_pos, k_pos, causal):
-    """One (q-chunk × kv-chunk) blockwise update with online softmax.
+def online_block_update(qg, k, v, mask, acc, row_max, row_sum, *, scale):
+    """One kv-block flash-style online-softmax update, GQA grouped layout.
 
-    q: (B, Sq, H, D); k, v: (B, Sk, H, D)
-    acc: (B, H, Sq, D); row_max/row_sum: (B, H, Sq)
+    The single implementation of the max/correction/exp/accumulate
+    recurrence shared by the ring kernel here and the cache-window
+    blockwise path in ``bigdl_tpu.llm.models.llama._attention``.
+
+    qg: (B, Tq, Hkv, G, D) — query heads grouped onto their kv head
+        (q head ``h`` = group ``h % G`` of kv head ``h // G``, the HF/GQA
+        convention); repeated K/V is never materialized.
+    k, v: (B, Sk, Hkv, D); mask: (B, Tq, Sk) (or broadcastable), True
+        where attending is allowed.
+    acc: (B, Hkv, G, Tq, D) f32; row_max/row_sum: (B, Hkv, G, Tq) f32.
     """
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+    logits = jnp.einsum("bthgd,bshd->bhgts", qg, k,
                         preferred_element_type=jnp.float32) * scale
-    if causal:
-        mask = q_pos[:, None] >= k_pos[None, :]          # (Sq, Sk)
-        logits = jnp.where(mask[None, None], logits, NEG_INF)
-    blk_max = jnp.max(logits, axis=-1)                    # (B, H, Sq)
+    logits = jnp.where(mask[:, None, None], logits, NEG_INF)
+    blk_max = jnp.max(logits, axis=-1)                 # (B, Hkv, G, Tq)
     new_max = jnp.maximum(row_max, blk_max)
     correction = jnp.exp(row_max - new_max)
-    p = jnp.exp(logits - new_max[..., None])              # (B, H, Sq, Sk)
-    if causal:
-        # rows with no valid key yet: keep p's zeros (exp(NEG_INF-max)=0)
-        p = jnp.where(mask[None, None], p, 0.0)
+    p = jnp.exp(logits - new_max[..., None])
+    # rows with no valid key in this block: exp(NEG_INF - max) underflows
+    # to 0 except when the row max itself is NEG_INF — zero explicitly
+    p = jnp.where(mask[:, None, None], p, 0.0)
     acc = acc * correction[..., None] + jnp.einsum(
-        "bhqk,bkhd->bhqd", p, v.astype(p.dtype),
+        "bhgts,bshd->bhgtd", p, v.astype(jnp.float32),
         preferred_element_type=jnp.float32)
     row_sum = row_sum * correction + jnp.sum(p, axis=-1)
     return acc, new_max, row_sum
+
+
+def _block_attn(q, k, v, acc, row_max, row_sum, *, scale,
+                q_pos, k_pos, causal):
+    """Ring-step wrapper over :func:`online_block_update`.
+
+    q: (B, Sq, Hq, D); k, v: (B, Sk, Hkv, D)
+    acc: (B, Hkv, G, Sq, D); row_max/row_sum: (B, Hkv, G, Sq)
+    """
+    b, sq, hq, d = q.shape
+    sk, hkv = k.shape[1], k.shape[2]
+    g = hq // hkv
+    qg = q.reshape(b, sq, hkv, g, d)
+    if causal:
+        mask = jnp.broadcast_to((q_pos[:, None] >= k_pos[None, :]),
+                                (b, sq, sk))
+    else:
+        mask = jnp.ones((b, sq, sk), bool)
+    return online_block_update(qg, k, v, mask, acc, row_max, row_sum,
+                               scale=scale)
 
 
 def ring_self_attention(q, k, v, axis_name: str = "seq",
@@ -71,12 +96,14 @@ def ring_self_attention(q, k, v, axis_name: str = "seq",
     n = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
     b, s_local, h, d = q.shape
+    hkv = k.shape[2]
+    g = h // hkv
     scale = scale if scale is not None else d ** -0.5
 
     q_pos = my * s_local + jnp.arange(s_local)
-    acc0 = _varying(jnp.zeros((b, h, s_local, d), jnp.float32), q)
-    max0 = _varying(jnp.full((b, h, s_local), NEG_INF, jnp.float32), q)
-    sum0 = _varying(jnp.zeros((b, h, s_local), jnp.float32), q)
+    acc0 = _varying(jnp.zeros((b, hkv, g, s_local, d), jnp.float32), q)
+    max0 = _varying(jnp.full((b, hkv, g, s_local), NEG_INF, jnp.float32), q)
+    sum0 = _varying(jnp.zeros((b, hkv, g, s_local), jnp.float32), q)
 
     def step(carry, i):
         k_blk, v_blk, acc, row_max, row_sum = carry
@@ -94,8 +121,9 @@ def ring_self_attention(q, k, v, axis_name: str = "seq",
 
     (k, v, acc, row_max, row_sum), _ = lax.scan(
         step, (k, v, acc0, max0, sum0), jnp.arange(n))
-    out = acc / jnp.maximum(row_sum, 1e-30)[..., None]    # (B, H, Sq, D)
-    return out.transpose(0, 2, 1, 3).astype(q.dtype)      # (B, Sq, H, D)
+    out = acc / jnp.maximum(row_sum, 1e-30)[..., None]  # (B,Hkv,G,Sq,D)
+    return (out.transpose(0, 3, 1, 2, 4)                # (B,Sq,Hkv,G,D)
+            .reshape(b, s_local, h, d).astype(q.dtype))
 
 
 def ring_attention(q, k, v, mesh: Mesh, axis: str = "seq",
