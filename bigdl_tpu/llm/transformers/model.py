@@ -3,21 +3,130 @@ patched ``from_pretrained(load_in_4bit=True)`` entry that is bigdl-llm's
 public API).
 
 Loading paths:
-- HF checkpoint dir / hub id (requires the baked-in ``transformers``):
-  config + weights are read via torch on CPU, transposed into the jax
-  Llama layout, then ggml-quantized.
+- HF checkpoint dir with safetensors weights: read **directly** into the
+  stacked jax layout (no torch model materialized), quantizing each layer
+  on load when low-bit is requested — the memory-lean default.
+- HF checkpoint dir / hub id without safetensors (requires the baked-in
+  ``transformers``): weights read via torch on CPU, transposed into the
+  jax layout, then ggml-quantized.
 - ``LlamaConfig`` instance (or ``config=``): random-init weights —
   the test/benchmark path (the reference's tests use tiny dummy ckpts).
 """
 
 from __future__ import annotations
 
+import glob
+import json
+import os
 from typing import Any, Dict, Optional
 
 import numpy as np
 
 from bigdl_tpu.llm.models.llama import (
     LlamaConfig, LlamaForCausalLM, init_params, quantize_params)
+
+
+# ---------------------------------------------------------------------------
+# direct safetensors loading (no torch)
+# ---------------------------------------------------------------------------
+
+def _st_key_map(path: str) -> Dict[str, str]:
+    """HF tensor name -> containing safetensors file (handles both the
+    single-file and the sharded index.json layouts)."""
+    index = os.path.join(path, "model.safetensors.index.json")
+    if os.path.exists(index):
+        with open(index) as f:
+            weight_map = json.load(f)["weight_map"]
+        return {k: os.path.join(path, v) for k, v in weight_map.items()}
+    from safetensors import safe_open
+    out = {}
+    for fname in sorted(glob.glob(os.path.join(path, "*.safetensors"))):
+        with safe_open(fname, framework="numpy") as f:
+            for k in f.keys():
+                out[k] = fname
+    return out
+
+
+def _read_hf_config(path: str) -> LlamaConfig:
+    """config.json → LlamaConfig (attribute-shim over the raw dict)."""
+    with open(os.path.join(path, "config.json")) as f:
+        raw = json.load(f)
+    return LlamaConfig.from_hf(type("HFConfig", (), raw)())
+
+
+def load_hf_llama_safetensors(path: str, cfg: Optional[LlamaConfig] = None,
+                              qtype: Optional[str] = None,
+                              dtype=None) -> Dict[str, Any]:
+    """Read a HF Llama checkpoint (config.json + *.safetensors) straight
+    into our stacked jax layout — per-layer streaming, so the fp32 torch
+    model is never materialized; with ``qtype`` each linear is
+    ggml-quantized the moment it is read (quantize-on-load)."""
+    import jax.numpy as jnp
+
+    from bigdl_tpu.llm.ggml.quantize import quantize
+    from bigdl_tpu.llm.models.llama import _LAYER_LINEARS
+
+    if qtype and qtype != "sym_int4":
+        # same contract as quantize_params: the scanned decoder implements
+        # q4_0 only; other qtypes go through LowBitLinear module surgery
+        raise NotImplementedError(
+            "the scanned decoder path implements q4_0 (sym_int4); other "
+            "qtypes are available through LowBitLinear module surgery")
+    dtype = dtype or jnp.bfloat16
+    if cfg is None:
+        cfg = _read_hf_config(path)
+    key_map = _st_key_map(path)
+    from safetensors import safe_open
+
+    handles: Dict[str, Any] = {}
+
+    def get(name: str) -> np.ndarray:
+        fname = key_map[name]
+        if fname not in handles:
+            handles[fname] = safe_open(fname, framework="numpy")
+        return handles[fname].get_tensor(name)
+
+    hf_linear = {
+        "q_proj": "model.layers.{}.self_attn.q_proj.weight",
+        "k_proj": "model.layers.{}.self_attn.k_proj.weight",
+        "v_proj": "model.layers.{}.self_attn.v_proj.weight",
+        "o_proj": "model.layers.{}.self_attn.o_proj.weight",
+        "gate_proj": "model.layers.{}.mlp.gate_proj.weight",
+        "up_proj": "model.layers.{}.mlp.up_proj.weight",
+        "down_proj": "model.layers.{}.mlp.down_proj.weight",
+    }
+    L = cfg.num_hidden_layers
+    layers: Dict[str, Any] = {}
+    for name in _LAYER_LINEARS:
+        fmt = hf_linear[name]
+        if qtype:
+            qs, ss = [], []
+            for l in range(L):
+                qd = quantize(np.asarray(get(fmt.format(l)), np.float32),
+                              qtype)
+                qs.append(qd["q"])
+                ss.append(qd["scale"])
+            layers[name] = {"q": jnp.asarray(np.stack(qs)),
+                            "scale": jnp.asarray(np.stack(ss))}
+        else:
+            layers[name] = {"w": jnp.asarray(np.stack(
+                [np.asarray(get(fmt.format(l)), np.float32)
+                 for l in range(L)]), dtype)}
+    for norm in ("input_layernorm", "post_attention_layernorm"):
+        layers[norm] = jnp.asarray(np.stack(
+            [np.asarray(get(f"model.layers.{l}.{norm}.weight"), np.float32)
+             for l in range(L)]), dtype)
+    params: Dict[str, Any] = {
+        "embed_tokens": jnp.asarray(
+            np.asarray(get("model.embed_tokens.weight"), np.float32), dtype),
+        "norm": jnp.asarray(
+            np.asarray(get("model.norm.weight"), np.float32), dtype),
+        "layers": layers,
+    }
+    if not cfg.tie_word_embeddings and "lm_head.weight" in key_map:
+        params["lm_head"] = {"w": jnp.asarray(
+            np.asarray(get("lm_head.weight"), np.float32), dtype)}
+    return params
 
 
 def _hf_to_params(model, cfg: LlamaConfig) -> Dict[str, Any]:
@@ -89,6 +198,15 @@ class AutoModelForCausalLM:
         if pretrained_model_name_or_path is None:
             cfg = config or LlamaConfig.tiny()
             params = init_params(cfg, seed)
+        elif (os.path.isdir(pretrained_model_name_or_path)
+              and glob.glob(os.path.join(pretrained_model_name_or_path,
+                                         "*.safetensors"))):
+            # direct safetensors path: stream per-layer, quantize on load
+            cfg = _read_hf_config(pretrained_model_name_or_path)
+            params = load_hf_llama_safetensors(
+                pretrained_model_name_or_path, cfg, qtype=qtype)
+            return LlamaForCausalLM(cfg, params,
+                                    max_cache_len=max_cache_len)
         else:
             import transformers
 

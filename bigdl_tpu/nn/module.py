@@ -38,6 +38,15 @@ from bigdl_tpu.utils.table import Table
 _instance_counters: Dict[str, int] = {}
 
 
+def _flat_keys(tree, prefix=""):
+    """Yield (dotted_path, leaf) for a nested-dict pytree."""
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            yield from _flat_keys(v, f"{prefix}{k}.")
+    else:
+        yield prefix.rstrip("."), tree
+
+
 def _auto_name(cls_name: str) -> str:
     n = _instance_counters.get(cls_name, 0)
     _instance_counters[cls_name] = n + 1
@@ -307,18 +316,79 @@ class Module:
         self._states = OrderedDict(
             (k, jnp.asarray(v)) for k, v in state["_states"].items())
 
+    def save_weights(self, path: str):
+        """Persist params+states in the stable versioned checkpoint format
+        (manifest.json + arrays.safetensors — no code execution on load);
+        reload into user-constructed code with :meth:`load_weights`."""
+        from bigdl_tpu.utils.checkpoint import save_checkpoint
+        save_checkpoint(path,
+                        {"params": self.parameters_dict(),
+                         "states": self.states_dict()},
+                        metadata={"class": type(self).__name__})
+        return self
+
+    def load_weights(self, path: str, strict: bool = True) -> "Module":
+        """Load params/states saved by :meth:`save_weights`. With
+        ``strict`` (default) the checkpoint must structurally match this
+        module — a mismatched checkpoint raising beats silently keeping
+        random init weights."""
+        from bigdl_tpu.utils.checkpoint import load_checkpoint
+        tree, meta = load_checkpoint(path)
+        if strict:
+            saved_cls = meta.get("class")
+            if saved_cls is not None and saved_cls != type(self).__name__:
+                raise ValueError(
+                    f"checkpoint was saved from {saved_cls}, loading into "
+                    f"{type(self).__name__} (pass strict=False to force)")
+            want = {p for p, _ in _flat_keys(self.parameters_dict())}
+            have = {p for p, _ in _flat_keys(tree["params"])}
+            if want != have:
+                raise ValueError(
+                    f"checkpoint params do not match module: missing="
+                    f"{sorted(want - have)[:5]} unexpected="
+                    f"{sorted(have - want)[:5]} (pass strict=False)")
+        self.load_parameters_dict(tree["params"])
+        if tree.get("states"):
+            self.load_states_dict(tree["states"])
+        return self
+
     def save_module(self, path: str, overwrite: bool = True):
+        """Persist the module as a checkpoint DIRECTORY: the stable
+        manifest + safetensors weights (readable by any version via
+        ``load_checkpoint``) plus a ``structure.pkl`` sidecar holding the
+        weight-stripped module object for same-version reconstruction.
+        (ref role: ModuleSerializer protobuf persistence.)"""
         import os
         import pickle
         if not overwrite and os.path.exists(path):
             raise IOError(f"{path} exists and overwrite=False")
-        with open(path, "wb") as f:
-            pickle.dump(self, f)
+        if os.path.isfile(path):
+            os.remove(path)   # overwrite a legacy single-file checkpoint
+        self.save_weights(path)
+        params, states = self.parameters_dict(), self.states_dict()
+        try:
+            # strip weights from the pickled structure: arrays live only
+            # in the safetensors file
+            self.load_parameters_dict(jax.tree_util.tree_map(
+                lambda a: np.zeros((0,), np.asarray(a).dtype), params))
+            self.load_states_dict(jax.tree_util.tree_map(
+                lambda a: np.zeros((0,), np.asarray(a).dtype), states))
+            with open(os.path.join(path, "structure.pkl"), "wb") as f:
+                pickle.dump(self, f)
+        finally:
+            self.load_parameters_dict(params)
+            self.load_states_dict(states)
         return self
 
     @staticmethod
     def load_module(path: str) -> "Module":
+        import os
         import pickle
+        if os.path.isdir(path):
+            with open(os.path.join(path, "structure.pkl"), "rb") as f:
+                module = pickle.load(f)
+            return module.load_weights(path)
+        # legacy round-1 single-file pickle checkpoints
         with open(path, "rb") as f:
             return pickle.load(f)
 

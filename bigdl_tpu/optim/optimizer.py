@@ -309,21 +309,25 @@ class BaseOptimizer:
             jax.tree_util.tree_map(np.asarray, states))
         self.model.save_module(
             os.path.join(self._checkpoint_path, f"model.{tag}"))
-        with open(os.path.join(self._checkpoint_path, f"optim.{tag}"),
-                  "wb") as f:
-            pickle.dump({
-                "opt_state": jax.tree_util.tree_map(np.asarray, opt_state),
-                "host_state": self.optim_method.get_state(),
-                "train_state": dict(state),
-            }, f)
+        from bigdl_tpu.utils.checkpoint import save_checkpoint
+        save_checkpoint(
+            os.path.join(self._checkpoint_path, f"optim.{tag}"),
+            {"opt_state": jax.tree_util.tree_map(np.asarray, opt_state),
+             "host_state": self.optim_method.get_state(),
+             "train_state": dict(state)})
         logger.info("checkpoint saved: %s @ %s", self._checkpoint_path, tag)
 
     def resume_from_checkpoint(self, path: str, tag: str):
         """Resume (ref: Optimizer resume = loadModule + OptimMethod.load)."""
         self.model = Module.load_module(os.path.join(path, f"model.{tag}"))
         self._step_fn = None   # compiled step closed over the old model
-        with open(os.path.join(path, f"optim.{tag}"), "rb") as f:
-            blob = pickle.load(f)
+        optim_path = os.path.join(path, f"optim.{tag}")
+        if os.path.isdir(optim_path):
+            from bigdl_tpu.utils.checkpoint import load_checkpoint
+            blob, _ = load_checkpoint(optim_path, to_jax=False)
+        else:  # legacy round-1 pickle checkpoints
+            with open(optim_path, "rb") as f:
+                blob = pickle.load(f)
         self.optim_method.load_state(blob["host_state"])
         self.state.update(blob["train_state"])
         self.state["epoch_finished"] = False

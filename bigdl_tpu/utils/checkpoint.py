@@ -1,0 +1,116 @@
+"""Stable, versioned checkpoint format (ref: ``S:dllib/utils/serializer/``
+— the reference persists modules as protobuf ``bigdl.proto`` with a
+registered serializer per layer; SURVEY.md §2.3 "Serialization").
+
+TPU-first substitution: the load-bearing state of a jax model is a
+**pytree of arrays**, so the stable on-disk surface is
+
+``<path>/``
+  ``manifest.json``        format name + version + tree structure + user
+                           metadata (pure JSON — readable forever)
+  ``arrays.safetensors``   every array leaf under a flat key (safetensors:
+                           the HF-standard zero-copy tensor container,
+                           bf16 supported via ml_dtypes)
+
+Nothing in the format executes code on load (unlike pickle): the tree
+structure is JSON and the arrays are raw buffers, so checkpoints are
+portable across bigdl_tpu versions and across processes that never import
+the producing classes. ``Module.save_module`` keeps a ``structure.pkl``
+*sidecar* for same-version convenience reconstruction, but weights are
+always loadable without it via :func:`load_checkpoint`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+FORMAT_NAME = "bigdl_tpu.checkpoint"
+FORMAT_VERSION = 1
+
+_ARRAYS_FILE = "arrays.safetensors"
+_MANIFEST_FILE = "manifest.json"
+
+
+def _flatten(tree: Any, prefix: str, arrays: Dict[str, np.ndarray]) -> Any:
+    """Tree -> JSON-able structure; array leaves move into ``arrays``."""
+    if tree is None or isinstance(tree, (bool, int, float, str)):
+        return {"t": "py", "v": tree}
+    if isinstance(tree, dict):
+        return {"t": "dict",
+                "items": {str(k): _flatten(v, f"{prefix}{k}.", arrays)
+                          for k, v in tree.items()}}
+    if isinstance(tree, (list, tuple)):
+        return {"t": "list" if isinstance(tree, list) else "tuple",
+                "items": [_flatten(v, f"{prefix}{i}.", arrays)
+                          for i, v in enumerate(tree)]}
+    arr = np.asarray(tree)
+    key = prefix.rstrip(".") or "_root"
+    if key in arrays:
+        raise ValueError(f"duplicate checkpoint key {key!r}")
+    arrays[key] = np.ascontiguousarray(arr)
+    return {"t": "arr", "key": key}
+
+
+def _unflatten(node: Any, arrays: Dict[str, np.ndarray]) -> Any:
+    t = node["t"]
+    if t == "py":
+        return node["v"]
+    if t == "dict":
+        return {k: _unflatten(v, arrays) for k, v in node["items"].items()}
+    if t in ("list", "tuple"):
+        seq = [_unflatten(v, arrays) for v in node["items"]]
+        return seq if t == "list" else tuple(seq)
+    if t == "arr":
+        return arrays[node["key"]]
+    raise ValueError(f"unknown node type {t!r} in checkpoint manifest")
+
+
+def save_checkpoint(path: str, tree: Any,
+                    metadata: Optional[Dict[str, Any]] = None) -> str:
+    """Persist a pytree (dicts/lists/tuples/scalars/arrays) to ``path``.
+
+    jax arrays are pulled to host; bf16 round-trips via ml_dtypes.
+    """
+    from safetensors.numpy import save_file
+
+    os.makedirs(path, exist_ok=True)
+    arrays: Dict[str, np.ndarray] = {}
+    structure = _flatten(tree, "", arrays)
+    manifest = {
+        "format": FORMAT_NAME,
+        "version": FORMAT_VERSION,
+        "tree": structure,
+        "metadata": metadata or {},
+    }
+    save_file(arrays, os.path.join(path, _ARRAYS_FILE))
+    with open(os.path.join(path, _MANIFEST_FILE), "w") as f:
+        json.dump(manifest, f, indent=1)
+    return path
+
+
+def load_checkpoint(path: str, to_jax: bool = True
+                    ) -> Tuple[Any, Dict[str, Any]]:
+    """Load ``(tree, metadata)`` saved by :func:`save_checkpoint`."""
+    from safetensors.numpy import load_file
+
+    with open(os.path.join(path, _MANIFEST_FILE)) as f:
+        manifest = json.load(f)
+    if manifest.get("format") != FORMAT_NAME:
+        raise ValueError(f"{path} is not a {FORMAT_NAME} checkpoint")
+    if manifest.get("version", 0) > FORMAT_VERSION:
+        raise ValueError(
+            f"checkpoint version {manifest['version']} is newer than this "
+            f"build supports ({FORMAT_VERSION})")
+    arrays = load_file(os.path.join(path, _ARRAYS_FILE))
+    tree = _unflatten(manifest["tree"], arrays)
+    if to_jax:
+        import jax
+        import jax.numpy as jnp
+        tree = jax.tree_util.tree_map(
+            lambda l: jnp.asarray(l) if isinstance(l, np.ndarray) else l,
+            tree)
+    return tree, manifest.get("metadata", {})
