@@ -216,6 +216,42 @@ def bench_resnet50_train(batch_size: int = 32, warmup: int = 5,
                                "dtype": "bfloat16"})
 
 
+def bench_bert_finetune(batch_size: int = 16, seq_len: int = 128,
+                        warmup: int = 5, iters: int = 50,
+                        smoke: bool = False) -> dict:
+    """BASELINE config 4: BERT-base fine-tune step throughput on OUR nn
+    stack (not a host torch loop), bf16 params."""
+    import jax
+    import jax.numpy as jnp
+
+    from bigdl_tpu.models.bert import BertConfig, build_classifier
+    from bigdl_tpu.nn.module import set_seed
+    from bigdl_tpu.optim.optim_method import AdamWeightDecay
+
+    set_seed(0)
+    cfg = BertConfig.tiny() if smoke else BertConfig.base()
+    model = build_classifier(cfg, num_labels=2)
+    model.load_parameters_dict(jax.tree_util.tree_map(
+        lambda a: a.astype(jnp.bfloat16)
+        if a.dtype == jnp.float32 else a, model.parameters_dict()))
+    rs = np.random.RandomState(0)
+    sl = min(seq_len, cfg.max_position_embeddings)
+
+    def make_batch():
+        x = jnp.asarray(rs.randint(0, cfg.vocab_size, (batch_size, sl)),
+                        jnp.int32)
+        t = jnp.asarray((rs.randint(0, 2, batch_size) + 1), jnp.int32)
+        return x, t
+
+    name = "bert_base_finetune_throughput"
+    return _bench_train(model, make_batch,
+                        ("smoke_" + name) if smoke else name,
+                        batch_size, warmup, iters, 2e-5,
+                        AdamWeightDecay(learning_rate=2e-5),
+                        extra={"seq_len": sl, "dtype": "bfloat16"},
+                        unit="samples/sec/chip")
+
+
 def _synthetic_q4_llama_params(cfg, seed: int = 0):
     """Random already-quantized params, built directly on device — avoids
     materializing 28 GB of fp32 host weights for the 7B benchmark (the
@@ -404,6 +440,10 @@ def _default_run(quick: bool) -> dict:
         out["extra"]["int4_kernel_micro"] = bench_int4_kernel_micro()
     except Exception as e:
         out["extra"]["int4_kernel_micro"] = {"error": repr(e)}
+    try:
+        out["extra"]["bert_finetune"] = bench_bert_finetune()
+    except Exception as e:
+        out["extra"]["bert_finetune"] = {"error": repr(e)}
     return out
 
 
@@ -431,6 +471,8 @@ if __name__ == "__main__":
             print(json.dumps(bench_llama_int4_decode()))
     elif "--kernels" in sys.argv:
         print(json.dumps(bench_int4_kernel_micro()))
+    elif "--bert" in sys.argv:
+        print(json.dumps(bench_bert_finetune(smoke=quick)))
     else:
         print(json.dumps(_default_run(quick)))
     if "--profile" in sys.argv:

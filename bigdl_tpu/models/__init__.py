@@ -1,7 +1,8 @@
 """Model zoo (ref: scala …/dllib/models/ — lenet, resnet, inception, vgg,
-autoencoder, rnn)."""
+autoencoder, rnn; bert per BASELINE config 4)."""
 
 from bigdl_tpu.models import (
-    autoencoder, inception, lenet, resnet, rnn, vgg)
+    autoencoder, bert, inception, lenet, resnet, rnn, vgg)
 
-__all__ = ["autoencoder", "inception", "lenet", "resnet", "rnn", "vgg"]
+__all__ = ["autoencoder", "bert", "inception", "lenet", "resnet", "rnn",
+           "vgg"]

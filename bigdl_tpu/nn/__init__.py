@@ -29,6 +29,8 @@ from bigdl_tpu.nn.layers.shape import (
     Contiguous, Flatten, InferReshape, Masking, Narrow, Padding, Permute,
     Replicate, Reshape, Select, SpatialZeroPadding, Squeeze, Transpose,
     Unsqueeze, UpSampling1D, UpSampling2D, View)
+from bigdl_tpu.nn.layers.attention import (
+    MultiHeadAttention, TransformerEncoderLayer)
 from bigdl_tpu.nn.layers.embedding import Embedding, LookupTable
 from bigdl_tpu.nn.layers.recurrent import (
     BiRecurrent, Cell, GRU, LSTM, Recurrent, RnnCell)

@@ -1,0 +1,113 @@
+"""Attention / Transformer layers (ref: S:dllib/nn/Attention.scala,
+TransformerLayer in keras-era BigDL — the reference ships Attention and
+Transformer pieces in its layer zoo, SURVEY.md §2.3; round 1 shipped the
+Llama stack outside nn, leaving nn users unable to build transformers).
+
+TPU-first: one einsum per projection batch (MXU), f32 softmax logits,
+dropout through the pure-apply rng plumbing.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.layers.activation import GELU
+from bigdl_tpu.nn.layers.dropout import Dropout
+from bigdl_tpu.nn.layers.linear import Linear
+from bigdl_tpu.nn.layers.normalization import LayerNorm
+from bigdl_tpu.nn.module import Module, TensorModule
+from bigdl_tpu.utils.table import Table
+
+
+def _split_input(x):
+    """x may be a tensor, or a Table/tuple of (hidden, attention_mask)."""
+    if isinstance(x, Table):
+        vals = list(x.values())
+        return vals[0], (vals[1] if len(vals) > 1 else None)
+    if isinstance(x, (tuple, list)):
+        return x[0], (x[1] if len(x) > 1 else None)
+    return x, None
+
+
+class MultiHeadAttention(Module):
+    """Self-attention with ``n_head`` heads (ref: nn/Attention.scala).
+
+    Input: hidden (B, T, H) or Table(hidden, mask) where mask is (B, T)
+    with 1 for real tokens; output (B, T, H).
+    """
+
+    def __init__(self, hidden_size: int, n_head: int,
+                 attn_dropout: float = 0.0, name: Optional[str] = None):
+        super().__init__(name)
+        if hidden_size % n_head:
+            raise ValueError(f"hidden {hidden_size} % heads {n_head} != 0")
+        self.hidden_size = hidden_size
+        self.n_head = n_head
+        self.head_dim = hidden_size // n_head
+        self._modules["q"] = Linear(hidden_size, hidden_size)
+        self._modules["k"] = Linear(hidden_size, hidden_size)
+        self._modules["v"] = Linear(hidden_size, hidden_size)
+        self._modules["out"] = Linear(hidden_size, hidden_size)
+        self._modules["drop"] = Dropout(attn_dropout)
+
+    def _apply(self, params, states, x, *, training, rng):
+        h, mask = _split_input(x)
+        b, t, _ = h.shape
+        run, finalize = self.child_runner(params, states,
+                                          training=training, rng=rng)
+
+        def heads(y):
+            return y.reshape(b, t, self.n_head, self.head_dim)
+
+        q, k, v = heads(run("q", h)), heads(run("k", h)), heads(run("v", h))
+        logits = jnp.einsum("bqnd,bknd->bnqk", q, k,
+                            preferred_element_type=jnp.float32)
+        logits = logits / jnp.sqrt(jnp.float32(self.head_dim))
+        if mask is not None:
+            logits = jnp.where(mask[:, None, None, :].astype(bool),
+                               logits, -1e30)
+        p = run("drop", jax.nn.softmax(logits, axis=-1))
+        # p in the input dtype: bf16 PV matmul at full MXU rate, f32 accum
+        ctx = jnp.einsum("bnqk,bknd->bqnd", p.astype(h.dtype), v,
+                         preferred_element_type=jnp.float32)
+        ctx = ctx.astype(h.dtype).reshape(b, t, self.hidden_size)
+        return run("out", ctx), finalize()
+
+
+class TransformerEncoderLayer(Module):
+    """Post-LN transformer encoder block (BERT-style: ref keras
+    TransformerLayer): MHA → add&norm → FFN(GELU) → add&norm.
+
+    Input: hidden (B, T, H) or Table(hidden, mask); output same shape as
+    hidden.
+    """
+
+    def __init__(self, hidden_size: int, n_head: int,
+                 intermediate_size: Optional[int] = None,
+                 dropout: float = 0.1, name: Optional[str] = None):
+        super().__init__(name)
+        inter = intermediate_size or 4 * hidden_size
+        self._modules["attention"] = MultiHeadAttention(
+            hidden_size, n_head, attn_dropout=dropout)
+        self._modules["attn_norm"] = LayerNorm(hidden_size, eps=1e-12)
+        self._modules["ffn1"] = Linear(hidden_size, inter)
+        # exact erf GELU: HF BERT semantics, so loaded HF checkpoints run
+        # through the same activation
+        self._modules["gelu"] = GELU(approximate=False)
+        self._modules["ffn2"] = Linear(inter, hidden_size)
+        self._modules["drop1"] = Dropout(dropout)
+        self._modules["drop2"] = Dropout(dropout)
+        self._modules["ffn_norm"] = LayerNorm(hidden_size, eps=1e-12)
+
+    def _apply(self, params, states, x, *, training, rng):
+        h, mask = _split_input(x)
+        run, finalize = self.child_runner(params, states,
+                                          training=training, rng=rng)
+        attn = run("attention", (h, mask) if mask is not None else h)
+        h = run("attn_norm", h + run("drop1", attn))
+        ffn = run("ffn2", run("gelu", run("ffn1", h)))
+        h = run("ffn_norm", h + run("drop2", ffn))
+        return h, finalize()

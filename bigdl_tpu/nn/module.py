@@ -181,6 +181,26 @@ class Module:
         )
         return y, new_sub
 
+    def child_runner(self, params, states, *, training, rng):
+        """``(run, finalize)`` for composite ``_apply`` bodies: ``run(name,
+        x)`` dispatches to child ``name`` collecting its state updates;
+        ``finalize()`` returns ``states`` merged with every update."""
+        new_states: Dict[str, Any] = {}
+
+        def run(name, x):
+            y, sub = self.sub_apply(name, params, states, x,
+                                    training=training, rng=rng)
+            if sub:
+                new_states[name] = sub
+            return y
+
+        def finalize():
+            merged = dict(states)
+            merged.update(new_states)
+            return merged
+
+        return run, finalize
+
     # -- stateful facade (BigDL parity) --------------------------------------
     def forward(self, x):
         x = _to_jax(x)
