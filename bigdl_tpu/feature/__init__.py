@@ -1,11 +1,12 @@
 from bigdl_tpu.feature.dataset import (
-    DataSet, DistributedDataSet, LocalDataSet, MiniBatch, Sample,
-    SampleToMiniBatch)
+    DataSet, DistributedDataSet, LocalDataSet, MiniBatch, PrefetchDataSet,
+    Sample, SampleToMiniBatch)
 from bigdl_tpu.feature.transformers import (
     ChainedTransformer, Normalizer, OneHot, Transformer)
+from bigdl_tpu.feature import cifar, imagenet
 
 __all__ = [
-    "DataSet", "DistributedDataSet", "LocalDataSet", "MiniBatch", "Sample",
-    "SampleToMiniBatch", "Transformer", "ChainedTransformer", "Normalizer",
-    "OneHot",
+    "DataSet", "DistributedDataSet", "LocalDataSet", "MiniBatch",
+    "PrefetchDataSet", "Sample", "SampleToMiniBatch", "Transformer",
+    "ChainedTransformer", "Normalizer", "OneHot", "cifar", "imagenet",
 ]

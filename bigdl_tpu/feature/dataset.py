@@ -97,6 +97,9 @@ class AbstractDataSet:
     def transform(self, transformer) -> "AbstractDataSet":
         return _TransformedDataSet(self, transformer)
 
+    def prefetch(self, depth: int = 8) -> "AbstractDataSet":
+        return PrefetchDataSet(self, depth)
+
     # sugar matching the reference's `dataset -> transformer` composition
     def __rshift__(self, transformer):
         return self.transform(transformer)
@@ -165,6 +168,62 @@ class _TransformedDataSet(AbstractDataSet):
 
     def data(self, train: bool = True):
         return self.transformer(self.parent.data(train))
+
+
+class PrefetchDataSet(AbstractDataSet):
+    """Background-thread prefetch: host-side decode/augment overlaps the
+    device step, so the Optimizer's per-iteration data timer shows only
+    queue-pop latency (the role of the reference's multi-threaded
+    transformer iterators over Spark partitions)."""
+
+    def __init__(self, parent: AbstractDataSet, depth: int = 8):
+        self.parent = parent
+        self.depth = depth
+
+    def size(self):
+        return self.parent.size()
+
+    def data(self, train: bool = True):
+        import queue
+        import threading
+
+        q: "queue.Queue" = queue.Queue(maxsize=self.depth)
+        _END = object()
+        stop = threading.Event()
+
+        def put(item) -> bool:
+            # bounded put that gives up when the consumer is gone, so an
+            # abandoned iterator (early break / trigger fire) cannot leave
+            # the producer blocked forever on a full queue
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def producer():
+            try:
+                for s in self.parent.data(train):
+                    if not put(s):
+                        return
+                put(_END)
+            except BaseException as e:  # surface errors on the consumer
+                put(e)
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is _END:
+                    return
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            stop.set()
 
 
 class SampleToMiniBatch:

@@ -3,5 +3,10 @@ FeatureTable + scala online recall/ranking services)."""
 
 from bigdl_tpu.friesian.feature import FeatureTable
 from bigdl_tpu.friesian.recall import BruteForceRecall
+from bigdl_tpu.friesian.serving import (
+    FeatureService, RankingService, RecallService, RecommenderService,
+    ServiceClient)
 
-__all__ = ["FeatureTable", "BruteForceRecall"]
+__all__ = ["FeatureTable", "BruteForceRecall", "FeatureService",
+           "RankingService", "RecallService", "RecommenderService",
+           "ServiceClient"]

@@ -1,0 +1,261 @@
+"""Friesian online serving services (ref: scala friesian serving —
+recall / feature / ranking / recommender gRPC services, SURVEY.md §2.8;
+round 1 had only the recall index).
+
+Transport: the same data-only length-prefixed wire format as the FL layer
+(``bigdl_tpu.ppml.protocol`` — JSON structure + raw numpy buffers; the
+gRPC/protobuf role without code-execution-on-decode). Each service runs as
+a threaded TCP server and also exposes its logic in-process, so the
+recommender can compose services either over sockets (the reference's
+deployment shape) or directly (tests / single-host).
+
+Pipeline (ref recommender flow):
+  user id → FeatureService (user features + history)
+          → RecallService (candidate item ids)
+          → FeatureService (item features)
+          → RankingService (InferenceModel scores)
+          → top-k item ids
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from bigdl_tpu.ppml.protocol import recv_msg, send_msg
+
+
+# ---------------------------------------------------------------------------
+# service base: threaded TCP endpoint over the safe wire format
+# ---------------------------------------------------------------------------
+
+class _TcpService:
+    """Request/response server: one message in, one message out."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self.host, self.port = self._sock.getsockname()[:2]
+        self._stop = threading.Event()
+        self._accept_thread: Optional[threading.Thread] = None
+
+    def start(self):
+        self._sock.listen()
+        self._accept_thread = threading.Thread(target=self._accept_loop,
+                                               daemon=True)
+        self._accept_thread.start()
+        return self
+
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn: socket.socket):
+        try:
+            while not self._stop.is_set():
+                try:
+                    msg = recv_msg(conn)
+                except (ValueError, TypeError, KeyError) as e:
+                    send_msg(conn, {"status": "error",
+                                    "error": f"malformed message: {e}"})
+                    return
+                try:
+                    send_msg(conn, {"status": "ok",
+                                    **self.handle(msg)})
+                except Exception as e:
+                    send_msg(conn, {"status": "error", "error": repr(e)})
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    def handle(self, msg: dict) -> dict:
+        raise NotImplementedError
+
+    def stop(self):
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    @property
+    def target(self) -> str:
+        return f"{self.host}:{self.port}"
+
+
+class ServiceClient:
+    """Blocking client for any :class:`_TcpService`."""
+
+    def __init__(self, target: str):
+        host, port = target.rsplit(":", 1)
+        self._sock = socket.create_connection((host, int(port)))
+        self._lock = threading.Lock()
+
+    def call(self, msg: dict) -> dict:
+        with self._lock:
+            send_msg(self._sock, msg)
+            resp = recv_msg(self._sock)
+        if resp.get("status") != "ok":
+            raise RuntimeError(f"service error: {resp.get('error')}")
+        return resp
+
+    def close(self):
+        self._sock.close()
+
+
+# ---------------------------------------------------------------------------
+# feature service (ref: friesian feature service over redis kv)
+# ---------------------------------------------------------------------------
+
+class FeatureService(_TcpService):
+    """In-memory kv feature store keyed by entity id (the redis role)."""
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self._user: Dict[int, np.ndarray] = {}
+        self._item: Dict[int, np.ndarray] = {}
+
+    def load_user_features(self, ids: Sequence[int],
+                           feats: np.ndarray):
+        for i, f in zip(ids, np.asarray(feats)):
+            self._user[int(i)] = np.asarray(f, np.float32)
+        return self
+
+    def load_item_features(self, ids: Sequence[int], feats: np.ndarray):
+        for i, f in zip(ids, np.asarray(feats)):
+            self._item[int(i)] = np.asarray(f, np.float32)
+        return self
+
+    def get_features(self, kind: str, ids: Sequence[int]) -> np.ndarray:
+        if kind not in ("user", "item"):
+            raise ValueError(f"unknown feature kind {kind!r} "
+                             f"(expected 'user' or 'item')")
+        store = self._user if kind == "user" else self._item
+        return np.stack([store[int(i)] for i in ids])
+
+    def handle(self, msg: dict) -> dict:
+        ids = np.asarray(msg["ids"]).ravel().tolist()
+        return {"features": self.get_features(msg["kind"], ids)}
+
+
+# ---------------------------------------------------------------------------
+# recall service (faiss role — over the BruteForceRecall index)
+# ---------------------------------------------------------------------------
+
+class RecallService(_TcpService):
+    def __init__(self, dim: int, metric: str = "ip", **kw):
+        super().__init__(**kw)
+        from bigdl_tpu.friesian.recall import BruteForceRecall
+        self.index = BruteForceRecall(dim, metric=metric)
+
+    def add_items(self, embeddings: np.ndarray):
+        self.index.add(np.asarray(embeddings, np.float32))
+        return self
+
+    def recall(self, query: np.ndarray, k: int) -> np.ndarray:
+        _, idx = self.index.search(np.asarray(query, np.float32)[None], k)
+        return idx[0]
+
+    def handle(self, msg: dict) -> dict:
+        return {"ids": self.recall(msg["query"], int(msg["k"]))}
+
+
+# ---------------------------------------------------------------------------
+# ranking service (InferenceModel scoring role)
+# ---------------------------------------------------------------------------
+
+class RankingService(_TcpService):
+    """Scores (user, item) feature pairs with a compiled InferenceModel."""
+
+    def __init__(self, inference_model=None,
+                 score_fn: Optional[Callable] = None, **kw):
+        super().__init__(**kw)
+        if (inference_model is None) == (score_fn is None):
+            raise ValueError("pass exactly one of inference_model/score_fn")
+        self.model = inference_model
+        self.score_fn = score_fn
+
+    def rank(self, user_feat: np.ndarray,
+             item_feats: np.ndarray) -> np.ndarray:
+        n = item_feats.shape[0]
+        x = np.concatenate(
+            [np.broadcast_to(user_feat, (n,) + user_feat.shape),
+             item_feats], axis=-1).astype(np.float32)
+        if self.score_fn is not None:
+            scores = self.score_fn(x)
+        else:
+            scores = self.model.do_predict(x)
+        return np.asarray(scores).reshape(n)
+
+    def handle(self, msg: dict) -> dict:
+        return {"scores": self.rank(np.asarray(msg["user"]),
+                                    np.asarray(msg["items"]))}
+
+
+# ---------------------------------------------------------------------------
+# recommender (orchestrates the pipeline)
+# ---------------------------------------------------------------------------
+
+class RecommenderService(_TcpService):
+    """recall → features → rank → top-k (the reference's recommender
+    service composing the three backends over gRPC)."""
+
+    def __init__(self, feature: "FeatureService | str",
+                 recall: "RecallService | str",
+                 ranking: "RankingService | str",
+                 item_ids: Optional[Sequence[int]] = None, **kw):
+        super().__init__(**kw)
+        self._feature = (ServiceClient(feature)
+                         if isinstance(feature, str) else feature)
+        self._recall = (ServiceClient(recall)
+                        if isinstance(recall, str) else recall)
+        self._ranking = (ServiceClient(ranking)
+                         if isinstance(ranking, str) else ranking)
+        # recall returns positional indices; map to item ids when given
+        self._item_ids = (None if item_ids is None
+                          else np.asarray(item_ids, np.int64))
+
+    # -- backend dispatch (in-proc object or remote client) ------------------
+    def _get_feats(self, kind, ids):
+        if isinstance(self._feature, ServiceClient):
+            return np.asarray(self._feature.call(
+                {"kind": kind, "ids": np.asarray(ids)})["features"])
+        return self._feature.get_features(kind, ids)
+
+    def _do_recall(self, query, k):
+        if isinstance(self._recall, ServiceClient):
+            return np.asarray(self._recall.call(
+                {"query": np.asarray(query), "k": k})["ids"])
+        return self._recall.recall(query, k)
+
+    def _do_rank(self, user, items):
+        if isinstance(self._ranking, ServiceClient):
+            return np.asarray(self._ranking.call(
+                {"user": user, "items": items})["scores"])
+        return self._ranking.rank(user, items)
+
+    def recommend(self, user_id: int, k: int = 10,
+                  candidate_num: int = 50) -> List[int]:
+        user_feat = self._get_feats("user", [user_id])[0]
+        cand_idx = self._do_recall(user_feat, candidate_num)
+        cand_ids = (cand_idx if self._item_ids is None
+                    else self._item_ids[cand_idx])
+        item_feats = self._get_feats("item", cand_ids)
+        scores = self._do_rank(user_feat, item_feats)
+        order = np.argsort(-scores)[:k]
+        return [int(i) for i in np.asarray(cand_ids)[order]]
+
+    def handle(self, msg: dict) -> dict:
+        return {"ids": np.asarray(self.recommend(
+            int(msg["user_id"]), int(msg.get("k", 10)),
+            int(msg.get("candidate_num", 50))), np.int64)}

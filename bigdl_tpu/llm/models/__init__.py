@@ -1,7 +1,10 @@
 """jax LLM implementations (ref: the per-arch forward rewrites under
 P:llm/transformers/models/ — here full TPU-native models)."""
 
+from bigdl_tpu.llm.models.gptneox import (
+    GptNeoXConfig, GptNeoXForCausalLM)
 from bigdl_tpu.llm.models.llama import (
     LlamaConfig, LlamaForCausalLM)
 
-__all__ = ["LlamaConfig", "LlamaForCausalLM"]
+__all__ = ["GptNeoXConfig", "GptNeoXForCausalLM",
+           "LlamaConfig", "LlamaForCausalLM"]

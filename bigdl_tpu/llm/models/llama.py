@@ -45,6 +45,9 @@ class LlamaConfig:
     # cache windows larger than this use blockwise online-softmax attention
     # (the (Tq, S) score matrix never materializes beyond one block column)
     attn_block_size: int = 1024
+    # Mistral-style sliding-window attention: position p attends only to
+    # [p - sliding_window + 1, p]. None = full causal (Llama).
+    sliding_window: Optional[int] = None
 
     @property
     def head_dim(self) -> int:
@@ -59,6 +62,14 @@ class LlamaConfig:
         return cls(vocab_size=128256, intermediate_size=14336,
                    num_key_value_heads=8, rope_theta=500000.0,
                    max_position_embeddings=8192)
+
+    @classmethod
+    def mistral_7b(cls) -> "LlamaConfig":
+        """Mistral-7B-v0.1: Llama block structure + GQA(8) + 4k sliding
+        window (ref: P:llm/ggml/model — second model family)."""
+        return cls(intermediate_size=14336, num_key_value_heads=8,
+                   max_position_embeddings=8192, sliding_window=4096,
+                   rms_norm_eps=1e-5, rope_theta=10000.0)
 
     @classmethod
     def tiny(cls, vocab: int = 256) -> "LlamaConfig":
@@ -81,7 +92,8 @@ class LlamaConfig:
             max_position_embeddings=g("max_position_embeddings", 4096),
             rms_norm_eps=g("rms_norm_eps", 1e-5),
             rope_theta=g("rope_theta", 10000.0),
-            tie_word_embeddings=g("tie_word_embeddings", False))
+            tie_word_embeddings=g("tie_word_embeddings", False),
+            sliding_window=g("sliding_window", None))
 
 
 # ---------------------------------------------------------------------------
@@ -279,12 +291,18 @@ def _attention(q, k_all, v_all, q_positions, kv_len_mask, cfg):
     scale = 1.0 / np.sqrt(d)
     qpos = q_positions                                     # (B, Tq)
 
+    def _causal(slot_idx):
+        """(B, Tq, S') causal (+ sliding window) mask for slot indices."""
+        m = slot_idx[None, None, :] <= qpos[..., None]
+        if cfg.sliding_window is not None:
+            m &= slot_idx[None, None, :] > (qpos[..., None]
+                                            - cfg.sliding_window)
+        return m
+
     if s <= cfg.attn_block_size:
         logits = jnp.einsum("bthgd,bshd->bhgts", qg, k_all,
                             preferred_element_type=jnp.float32) * scale
-        slot = jnp.arange(s)
-        mask = ((slot[None, None, :] <= qpos[..., None])
-                & kv_len_mask[:, None, :])                 # (B, Tq, S)
+        mask = _causal(jnp.arange(s)) & kv_len_mask[:, None, :]  # (B,Tq,S)
         logits = jnp.where(mask[:, None, None], logits, -1e30)
         p = jax.nn.softmax(logits, axis=-1)
         out = jnp.einsum("bhgts,bshd->bthgd", p, v_all.astype(jnp.float32),
@@ -312,8 +330,7 @@ def _attention(q, k_all, v_all, q_positions, kv_len_mask, cfg):
         from bigdl_tpu.parallel.ring_attention import online_block_update
         acc, rmax, rsum = carry
         k_blk, v_blk, m_blk, slot_blk = inputs
-        mask = ((slot_blk[None, None, :] <= qpos[..., None])
-                & m_blk[:, None, :])                       # (B, Tq, blk)
+        mask = _causal(slot_blk) & m_blk[:, None, :]       # (B, Tq, blk)
         acc, nmax, rsum = online_block_update(
             qg, k_blk, v_blk, mask, acc, rmax, rsum, scale=scale)
         return (acc, nmax, rsum), None
@@ -449,6 +466,8 @@ class LlamaForCausalLM:
         # packed/offset, which the ring mask does not model)
         use_ring = (cache is None and positions is None and t > 1
                     and self._prefill_ring is not None
+                    and self.config.sliding_window is None  # ring mask is
+                    # plain causal; window models use the blockwise path
                     and t % self._ring[0].shape[self._ring[1]] == 0)
         if cache is None:
             cache = init_cache(self.config, b, self.max_cache_len,

@@ -201,10 +201,23 @@ class AutoModelForCausalLM:
         elif (os.path.isdir(pretrained_model_name_or_path)
               and glob.glob(os.path.join(pretrained_model_name_or_path,
                                          "*.safetensors"))):
-            # direct safetensors path: stream per-layer, quantize on load
-            cfg = _read_hf_config(pretrained_model_name_or_path)
-            params = load_hf_llama_safetensors(
-                pretrained_model_name_or_path, cfg, qtype=qtype)
+            # direct safetensors path: stream per-layer, quantize on load;
+            # family dispatched on config.json model_type
+            path = pretrained_model_name_or_path
+            with open(os.path.join(path, "config.json")) as f:
+                raw = json.load(f)
+            hf_shim = type("HFConfig", (), raw)()
+            if raw.get("model_type") == "gpt_neox":
+                from bigdl_tpu.llm.models.gptneox import (
+                    GptNeoXConfig, GptNeoXForCausalLM,
+                    load_hf_gptneox_safetensors)
+                ncfg = GptNeoXConfig.from_hf(hf_shim)
+                nparams = load_hf_gptneox_safetensors(path, ncfg,
+                                                      qtype=qtype)
+                return GptNeoXForCausalLM(ncfg, nparams,
+                                          max_cache_len=max_cache_len)
+            cfg = LlamaConfig.from_hf(hf_shim)
+            params = load_hf_llama_safetensors(path, cfg, qtype=qtype)
             return LlamaForCausalLM(cfg, params,
                                     max_cache_len=max_cache_len)
         else:
