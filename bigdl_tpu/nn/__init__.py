@@ -31,6 +31,15 @@ from bigdl_tpu.nn.layers.shape import (
     Unsqueeze, UpSampling1D, UpSampling2D, View)
 from bigdl_tpu.nn.layers.attention import (
     MultiHeadAttention, TransformerEncoderLayer)
+from bigdl_tpu.nn.layers.misc import (
+    CosineDistance, DotProduct, Euclidean, Highway, Index,
+    LocallyConnected2D, Max, Maxout, Mean, Min, MM, MV, PairwiseDistance,
+    Scale, SReLU, Sum, TimeDistributed)
+from bigdl_tpu.nn.layers.sparse import (
+    LookupTableSparse, SparseJoinTable, SparseLinear)
+from bigdl_tpu.nn.layers.volumetric import (
+    Cropping2D, Cropping3D, UpSampling3D, VolumetricAveragePooling,
+    VolumetricConvolution, VolumetricFullConvolution)
 from bigdl_tpu.nn.layers.embedding import Embedding, LookupTable
 from bigdl_tpu.nn.layers.recurrent import (
     BiRecurrent, Cell, GRU, LSTM, Recurrent, RnnCell)

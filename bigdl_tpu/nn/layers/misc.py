@@ -1,0 +1,310 @@
+"""Assorted reference layers (ref: one Scala file per class under
+S:dllib/nn/ — Max.scala, Min.scala, Mean.scala, Sum.scala, MM.scala,
+MV.scala, DotProduct.scala, CosineDistance.scala, PairwiseDistance.scala,
+Euclidean.scala, Scale.scala, TimeDistributed.scala, Highway (keras),
+Maxout.scala, SReLU.scala, Index.scala — closing the round-1 layer-zoo
+gap).
+
+Reduce/index layers follow the reference's 1-based ``dimension``
+convention (dimension counts from 1 over the full tensor incl. batch).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.initialization import Xavier, Zeros, init_param
+from bigdl_tpu.nn.module import Module, RNG, TensorModule
+from bigdl_tpu.utils.table import Table
+
+
+def _pair(x):
+    if isinstance(x, Table):
+        return list(x.values())
+    return list(x)
+
+
+def _dim0(dimension: int) -> int:
+    """reference 1-based dim → 0-based axis."""
+    if dimension < 1:
+        raise ValueError(f"dimension is 1-based, got {dimension}")
+    return dimension - 1
+
+
+class Max(TensorModule):
+    """max over a dimension (ref: Max.scala)."""
+
+    def __init__(self, dim: int = 1, num_input_dims: int = -1,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.dim = dim
+
+    def _apply(self, params, states, x, *, training, rng):
+        return jnp.max(x, axis=_dim0(self.dim))
+
+
+class Min(TensorModule):
+    def __init__(self, dim: int = 1, name: Optional[str] = None):
+        super().__init__(name)
+        self.dim = dim
+
+    def _apply(self, params, states, x, *, training, rng):
+        return jnp.min(x, axis=_dim0(self.dim))
+
+
+class Mean(TensorModule):
+    def __init__(self, dimension: int = 1, squeeze: bool = True,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.dimension = dimension
+        self.squeeze = squeeze
+
+    def _apply(self, params, states, x, *, training, rng):
+        return jnp.mean(x, axis=_dim0(self.dimension),
+                        keepdims=not self.squeeze)
+
+
+class Sum(TensorModule):
+    def __init__(self, dimension: int = 1, squeeze: bool = True,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.dimension = dimension
+        self.squeeze = squeeze
+
+    def _apply(self, params, states, x, *, training, rng):
+        return jnp.sum(x, axis=_dim0(self.dimension),
+                       keepdims=not self.squeeze)
+
+
+class Index(TensorModule):
+    """Table(tensor, indices) → tensor indexed along ``dimension``
+    (ref: Index.scala; 1-based indices per reference convention)."""
+
+    def __init__(self, dimension: int = 1, name: Optional[str] = None):
+        super().__init__(name)
+        self.dimension = dimension
+
+    def _apply(self, params, states, x, *, training, rng):
+        t, idx = _pair(x)
+        return jnp.take(t, idx.astype(jnp.int32) - 1,
+                        axis=_dim0(self.dimension))
+
+
+class MM(TensorModule):
+    """Table(a, b) → a @ b with optional transposes (ref: MM.scala)."""
+
+    def __init__(self, trans_a: bool = False, trans_b: bool = False,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.trans_a, self.trans_b = trans_a, trans_b
+
+    def _apply(self, params, states, x, *, training, rng):
+        a, b = _pair(x)
+        if self.trans_a:
+            a = jnp.swapaxes(a, -1, -2)
+        if self.trans_b:
+            b = jnp.swapaxes(b, -1, -2)
+        return a @ b
+
+
+class MV(TensorModule):
+    """Table(matrix, vector) → matrix @ vector (ref: MV.scala)."""
+
+    def __init__(self, trans: bool = False, name: Optional[str] = None):
+        super().__init__(name)
+        self.trans = trans
+
+    def _apply(self, params, states, x, *, training, rng):
+        m, v = _pair(x)
+        if self.trans:
+            m = jnp.swapaxes(m, -1, -2)
+        return jnp.einsum("...ij,...j->...i", m, v)
+
+
+class DotProduct(TensorModule):
+    """Table(a, b) → rowwise dot (ref: DotProduct.scala)."""
+
+    def _apply(self, params, states, x, *, training, rng):
+        a, b = _pair(x)
+        return jnp.sum(a * b, axis=-1)
+
+
+class CosineDistance(TensorModule):
+    """Table(a, b) → rowwise cosine similarity (ref:
+    CosineDistance.scala)."""
+
+    def _apply(self, params, states, x, *, training, rng):
+        a, b = _pair(x)
+        num = jnp.sum(a * b, axis=-1)
+        den = (jnp.linalg.norm(a, axis=-1)
+               * jnp.linalg.norm(b, axis=-1) + 1e-12)
+        return num / den
+
+
+class PairwiseDistance(TensorModule):
+    """Table(a, b) → p-norm of (a - b) per row (ref:
+    PairwiseDistance.scala)."""
+
+    def __init__(self, norm: int = 2, name: Optional[str] = None):
+        super().__init__(name)
+        self.norm = norm
+
+    def _apply(self, params, states, x, *, training, rng):
+        a, b = _pair(x)
+        return jnp.linalg.norm(a - b, ord=self.norm, axis=-1)
+
+
+class Euclidean(TensorModule):
+    """Distance to each of ``output_size`` learned centers (ref:
+    Euclidean.scala)."""
+
+    def __init__(self, input_size: int, output_size: int,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.add_param("weight", init_param(
+            Xavier(), RNG.next_key(), (output_size, input_size),
+            fan_in=input_size, fan_out=output_size))
+
+    def _apply(self, params, states, x, *, training, rng):
+        w = params["weight"].astype(x.dtype)           # (O, I)
+        diff = x[..., None, :] - w                     # (..., O, I)
+        return jnp.sqrt(jnp.sum(diff * diff, axis=-1) + 1e-12)
+
+
+class Scale(TensorModule):
+    """Elementwise learned scale + shift over given shape (ref:
+    Scale.scala = CMul + CAdd)."""
+
+    def __init__(self, size: Sequence[int], name: Optional[str] = None):
+        super().__init__(name)
+        self.size = tuple(size)
+        self.add_param("weight", jnp.ones(self.size))
+        self.add_param("bias", jnp.zeros(self.size))
+
+    def _apply(self, params, states, x, *, training, rng):
+        return (x * params["weight"].astype(x.dtype)
+                + params["bias"].astype(x.dtype))
+
+
+class TimeDistributed(Module):
+    """Apply an inner module to every timestep of (B, T, ...) by folding
+    time into batch (ref: TimeDistributed.scala — same trick)."""
+
+    def __init__(self, layer: Module, name: Optional[str] = None):
+        super().__init__(name)
+        self._modules["layer"] = layer
+
+    def _apply(self, params, states, x, *, training, rng):
+        b, t = x.shape[0], x.shape[1]
+        folded = x.reshape((b * t,) + x.shape[2:])
+        run, finalize = self.child_runner(params, states,
+                                          training=training, rng=rng)
+        y = run("layer", folded)
+        return y.reshape((b, t) + y.shape[1:]), finalize()
+
+
+class Highway(Module):
+    """Highway layer: t*h(x) + (1-t)*x (ref: keras-era Highway)."""
+
+    def __init__(self, size: int, activation=None,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        from bigdl_tpu.nn.layers.linear import Linear
+        self._modules["h"] = Linear(size, size)
+        self._modules["t"] = Linear(size, size)
+        self.activation = activation or jnp.tanh
+
+    def _apply(self, params, states, x, *, training, rng):
+        run, finalize = self.child_runner(params, states,
+                                          training=training, rng=rng)
+        h = self.activation(run("h", x))
+        t = jax.nn.sigmoid(run("t", x))
+        return t * h + (1 - t) * x, finalize()
+
+
+class Maxout(TensorModule):
+    """Linear to (out, pool) then max over pool (ref: Maxout.scala)."""
+
+    def __init__(self, input_size: int, output_size: int,
+                 maxout_number: int, name: Optional[str] = None):
+        super().__init__(name)
+        self.output_size = output_size
+        self.maxout_number = maxout_number
+        self.add_param("weight", init_param(
+            Xavier(), RNG.next_key(),
+            (output_size * maxout_number, input_size),
+            fan_in=input_size, fan_out=output_size))
+        self.add_param("bias",
+                       jnp.zeros((output_size * maxout_number,)))
+
+    def _apply(self, params, states, x, *, training, rng):
+        y = x @ params["weight"].astype(x.dtype).T \
+            + params["bias"].astype(x.dtype)
+        y = y.reshape(x.shape[:-1] + (self.output_size,
+                                      self.maxout_number))
+        return jnp.max(y, axis=-1)
+
+
+class SReLU(TensorModule):
+    """S-shaped ReLU with learned thresholds/slopes (ref: SReLU.scala)."""
+
+    def __init__(self, shape: Sequence[int], name: Optional[str] = None):
+        super().__init__(name)
+        shape = tuple(shape)
+        self.add_param("t_right", jnp.ones(shape))
+        self.add_param("a_right", jnp.ones(shape))
+        self.add_param("t_left", jnp.zeros(shape))
+        self.add_param("a_left", jnp.zeros(shape))
+
+    def _apply(self, params, states, x, *, training, rng):
+        tr = params["t_right"].astype(x.dtype)
+        ar = params["a_right"].astype(x.dtype)
+        tl = params["t_left"].astype(x.dtype)
+        al = params["a_left"].astype(x.dtype)
+        return jnp.where(
+            x >= tr, tr + ar * (x - tr),
+            jnp.where(x <= tl, tl + al * (x - tl), x))
+
+
+class LocallyConnected2D(TensorModule):
+    """Unshared 2-D convolution (ref: LocallyConnected2D.scala) — NCHW,
+    valid padding: every output position owns its own kernel."""
+
+    def __init__(self, n_input_plane: int, input_h: int, input_w: int,
+                 n_output_plane: int, kernel_h: int, kernel_w: int,
+                 stride_h: int = 1, stride_w: int = 1,
+                 with_bias: bool = True, name: Optional[str] = None):
+        super().__init__(name)
+        self.ci, self.co = n_input_plane, n_output_plane
+        self.kh, self.kw = kernel_h, kernel_w
+        self.sh, self.sw = stride_h, stride_w
+        self.oh = (input_h - kernel_h) // stride_h + 1
+        self.ow = (input_w - kernel_w) // stride_w + 1
+        fan_in = n_input_plane * kernel_h * kernel_w
+        self.add_param("weight", init_param(
+            Xavier(), RNG.next_key(),
+            (self.oh * self.ow, n_output_plane,
+             n_input_plane * kernel_h * kernel_w),
+            fan_in=fan_in, fan_out=n_output_plane))
+        self.with_bias = with_bias
+        if with_bias:
+            self.add_param("bias", jnp.zeros(
+                (n_output_plane, self.oh, self.ow)))
+
+    def _apply(self, params, states, x, *, training, rng):
+        # extract patches: (B, OH*OW, CI*KH*KW)
+        patches = jax.lax.conv_general_dilated_patches(
+            x, (self.kh, self.kw), (self.sh, self.sw), "VALID",
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        b = x.shape[0]
+        patches = patches.reshape(b, -1, self.oh * self.ow)
+        patches = patches.transpose(0, 2, 1)           # (B, P, CIKHKW)
+        w = params["weight"].astype(x.dtype)           # (P, CO, CIKHKW)
+        y = jnp.einsum("bpk,pok->bop", patches, w)     # (B, CO, P)
+        y = y.reshape(b, self.co, self.oh, self.ow)
+        if self.with_bias:
+            y = y + params["bias"].astype(y.dtype)[None]
+        return y

@@ -1,0 +1,102 @@
+"""Sparse layers (ref: S:dllib/nn/SparseLinear.scala,
+LookupTableSparse.scala, SparseJoinTable.scala — the recsys embedding
+path; SURVEY.md §2.1/§2.3).
+
+TPU-first: sparse inputs lower to gather + ``segment_sum`` (the MXU/VPU
+native embedding-bag form), not CSR loops.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.initialization import RandomNormal, Xavier, init_param
+from bigdl_tpu.nn.module import RNG, TensorModule
+from bigdl_tpu.tensor.sparse import SparseTensor
+
+
+class SparseLinear(TensorModule):
+    """y = sparse_x @ W^T + b over a :class:`SparseTensor` input (B, F)
+    (ref: SparseLinear.scala)."""
+
+    def __init__(self, input_size: int, output_size: int,
+                 with_bias: bool = True, name: Optional[str] = None):
+        super().__init__(name)
+        self.input_size, self.output_size = input_size, output_size
+        self.with_bias = with_bias
+        self.add_param("weight", init_param(
+            Xavier(), RNG.next_key(), (output_size, input_size),
+            fan_in=input_size, fan_out=output_size))
+        if with_bias:
+            self.add_param("bias", jnp.zeros((output_size,)))
+
+    def _apply(self, params, states, x, *, training, rng):
+        if not isinstance(x, SparseTensor):
+            raise TypeError("SparseLinear expects a SparseTensor input")
+        y = x.matmul_dense(params["weight"].T)
+        if self.with_bias:
+            y = y + params["bias"].astype(y.dtype)
+        return y
+
+
+class LookupTableSparse(TensorModule):
+    """Embedding bag: ids (B, L) with 0-padding → pooled embeddings
+    (B, dim); combiner sum/mean/sqrtn (ref: LookupTableSparse.scala,
+    which pools a SparseTensor of ids; fixed-width padded ids are the
+    static-shape TPU formulation of the same contract)."""
+
+    def __init__(self, n_index: int, n_output: int,
+                 combiner: str = "sum", name: Optional[str] = None):
+        super().__init__(name)
+        if combiner not in ("sum", "mean", "sqrtn"):
+            raise ValueError(f"unknown combiner {combiner!r}")
+        self.n_index = n_index
+        self.combiner = combiner
+        self.add_param("weight", init_param(
+            RandomNormal(0, 0.1), RNG.next_key(), (n_index, n_output),
+            fan_in=n_index, fan_out=n_output))
+
+    def _apply(self, params, states, x, *, training, rng):
+        ids = jnp.asarray(x, jnp.int32)           # (B, L), 0 = padding
+        w = params["weight"]
+        valid = (ids > 0)
+        emb = w[jnp.clip(ids - 1, 0, self.n_index - 1)]   # 1-based ids
+        emb = emb * valid[..., None].astype(emb.dtype)
+        total = jnp.sum(emb, axis=1)
+        if self.combiner == "sum":
+            return total
+        count = jnp.maximum(jnp.sum(valid, axis=1), 1).astype(total.dtype)
+        if self.combiner == "mean":
+            return total / count[:, None]
+        return total / jnp.sqrt(count)[:, None]           # sqrtn
+
+
+class SparseJoinTable(TensorModule):
+    """Concatenate SparseTensors along a dimension (ref:
+    SparseJoinTable.scala). Returns a SparseTensor."""
+
+    def __init__(self, dimension: int = 2, name: Optional[str] = None):
+        super().__init__(name)
+        self.dimension = dimension          # 1-based, reference style
+
+    def _apply(self, params, states, x, *, training, rng):
+        from bigdl_tpu.utils.table import Table
+        tensors = list(x.values()) if isinstance(x, Table) else list(x)
+        axis = self.dimension - 1
+        ndim = tensors[0].ndim
+        shape = list(tensors[0].shape)
+        offset = 0
+        idx_parts, val_parts = [], []
+        for t in tensors:
+            if not isinstance(t, SparseTensor):
+                raise TypeError("SparseJoinTable expects SparseTensors")
+            shift = jnp.zeros((ndim,), jnp.int32).at[axis].set(offset)
+            idx_parts.append(t.indices + shift)
+            val_parts.append(t.values)
+            offset += t.shape[axis]
+        shape[axis] = offset
+        return SparseTensor(jnp.concatenate(idx_parts),
+                            jnp.concatenate(val_parts), shape)

@@ -1,0 +1,13 @@
+"""TF-graph op set + TFNet (ref: S:dllib/nn/ops/ + nn/tf/ ~12k LoC of
+TF-style op modules, and orca's TFNet JNI — the capability of running
+imported frozen TF graphs; SURVEY.md §2.3, round-1 gap "no TF-op set").
+
+TPU-first substitution: instead of mirroring ~100 mutable op modules, the
+frozen ``GraphDef`` is interpreted ONCE into a pure jax function (each TF
+op node → a jnp/lax call), then jit-compiled — so an imported TF graph
+runs as native XLA on TPU rather than through libtensorflow JNI.
+"""
+
+from bigdl_tpu.nn.ops.tfnet import TFNet, SUPPORTED_OPS
+
+__all__ = ["TFNet", "SUPPORTED_OPS"]

@@ -1,0 +1,294 @@
+"""TFNet: frozen TF GraphDef → jit-compiled jax function.
+
+(ref: orca scala ``TFNet`` — runs frozen TF graphs in-JVM via
+libtensorflow JNI; and ``S:dllib/nn/ops``/``nn/tf`` — the op-module set
+that re-executes imported TF graphs on BigDL tensors. SURVEY.md §2.3.)
+
+Here the graph is *compiled away*: nodes are interpreted once, in
+topological order, into jnp/lax calls producing a pure function that XLA
+fuses and schedules for TPU. TensorFlow itself is used only to parse the
+protobuf and decode node attrs — never to execute.
+
+Supported op set: the inference ops the reference's TFNet workloads use
+(MLP/CNN classifiers): see :data:`SUPPORTED_OPS`. Unsupported ops raise
+at load time, naming the op — the reference behaves the same way
+(unsupported TF ops fail graph import).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _attr(node, name, default=None):
+    if name not in node.attr:
+        return default
+    a = node.attr[name]
+    kind = a.WhichOneof("value")
+    if kind == "i":
+        return int(a.i)
+    if kind == "f":
+        return float(a.f)
+    if kind == "b":
+        return bool(a.b)
+    if kind == "s":
+        return a.s.decode()
+    if kind == "shape":
+        return [d.size for d in a.shape.dim]
+    if kind == "list":
+        if a.list.i:
+            return [int(v) for v in a.list.i]
+        if a.list.f:
+            return [float(v) for v in a.list.f]
+        if a.list.s:
+            return [v.decode() for v in a.list.s]
+        return []
+    if kind == "type":
+        return int(a.type)
+    if kind == "tensor":
+        return a.tensor
+    return default
+
+
+def _tensor_to_np(tensor_proto):
+    from tensorflow.python.framework import tensor_util
+    return tensor_util.MakeNdarray(tensor_proto)
+
+
+def _conv_padding(node):
+    p = _attr(node, "padding", "VALID")
+    if p == "EXPLICIT":
+        ep = _attr(node, "explicit_paddings", [])
+        return [(ep[2], ep[3]), (ep[4], ep[5])]
+    return p
+
+
+def _nhwc(node) -> bool:
+    fmt = _attr(node, "data_format", "NHWC")
+    if fmt not in ("NHWC", "NCHW"):
+        raise ValueError(f"unsupported data_format {fmt}")
+    return fmt == "NHWC"
+
+
+# each handler: (inputs: list of arrays, node) -> array (or tuple)
+def _conv2d(ins, node):
+    x, w = ins            # TF kernel layout HWIO
+    strides = _attr(node, "strides", [1, 1, 1, 1])
+    dil = _attr(node, "dilations", [1, 1, 1, 1])
+    if _nhwc(node):
+        dn, s, d = ("NHWC", "HWIO", "NHWC"), strides[1:3], dil[1:3]
+    else:
+        dn, s, d = ("NCHW", "HWIO", "NCHW"), strides[2:4], dil[2:4]
+    return jax.lax.conv_general_dilated(
+        x, w, window_strides=s, padding=_conv_padding(node),
+        rhs_dilation=d, dimension_numbers=dn)
+
+
+def _depthwise_conv2d(ins, node):
+    x, w = ins            # (H, W, C, M)
+    strides = _attr(node, "strides", [1, 1, 1, 1])
+    h, wd, c, m = w.shape
+    w = w.reshape(h, wd, 1, c * m)
+    if _nhwc(node):
+        dn, s = ("NHWC", "HWIO", "NHWC"), strides[1:3]
+    else:
+        dn, s = ("NCHW", "HWIO", "NCHW"), strides[2:4]
+    return jax.lax.conv_general_dilated(
+        x, w, window_strides=s, padding=_conv_padding(node),
+        feature_group_count=c, dimension_numbers=dn)
+
+
+def _pool(reducer, init, node, x, avg=False):
+    ks = _attr(node, "ksize", [1, 1, 1, 1])
+    st = _attr(node, "strides", [1, 1, 1, 1])
+    pad = _attr(node, "padding", "VALID")
+    if _nhwc(node):
+        dims, strides = (1, ks[1], ks[2], 1), (1, st[1], st[2], 1)
+    else:
+        dims, strides = (1, 1, ks[1], ks[2]), (1, 1, st[1], st[2])
+    if pad == "SAME":
+        pads = jax.lax.padtype_to_pads(x.shape, dims, strides, "SAME")
+    else:
+        pads = [(0, 0)] * 4
+    out = jax.lax.reduce_window(x, init, reducer, dims, strides, pads)
+    if avg:
+        ones = jnp.ones_like(x)
+        counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, dims,
+                                       strides, pads)
+        out = out / counts
+    return out
+
+
+def _fused_batch_norm(ins, node):
+    x, scale, offset, mean, var = ins
+    eps = _attr(node, "epsilon", 1e-3)
+    if _nhwc(node):
+        sh = (1, 1, 1, -1)
+    else:
+        sh = (1, -1, 1, 1)
+    inv = jax.lax.rsqrt(var + eps).reshape(sh)
+    return (x - mean.reshape(sh)) * inv * scale.reshape(sh) \
+        + offset.reshape(sh)
+
+
+def _matmul(ins, node):
+    a, b = ins
+    if _attr(node, "transpose_a", False):
+        a = a.T
+    if _attr(node, "transpose_b", False):
+        b = b.T
+    return a @ b
+
+
+_HANDLERS: Dict[str, Callable] = {
+    "Identity": lambda ins, n: ins[0],
+    "MatMul": _matmul,
+    "BiasAdd": lambda ins, n: (
+        ins[0] + (ins[1] if _nhwc(n) or ins[0].ndim <= 2
+                  else ins[1].reshape((1, -1) + (1,) *
+                                      (ins[0].ndim - 2)))),
+    "Add": lambda ins, n: ins[0] + ins[1],
+    "AddV2": lambda ins, n: ins[0] + ins[1],
+    "Sub": lambda ins, n: ins[0] - ins[1],
+    "Mul": lambda ins, n: ins[0] * ins[1],
+    "RealDiv": lambda ins, n: ins[0] / ins[1],
+    "Maximum": lambda ins, n: jnp.maximum(ins[0], ins[1]),
+    "Minimum": lambda ins, n: jnp.minimum(ins[0], ins[1]),
+    "Relu": lambda ins, n: jax.nn.relu(ins[0]),
+    "Relu6": lambda ins, n: jnp.clip(ins[0], 0, 6),
+    "Elu": lambda ins, n: jax.nn.elu(ins[0]),
+    "Sigmoid": lambda ins, n: jax.nn.sigmoid(ins[0]),
+    "Tanh": lambda ins, n: jnp.tanh(ins[0]),
+    "Softmax": lambda ins, n: jax.nn.softmax(ins[0], axis=-1),
+    "LogSoftmax": lambda ins, n: jax.nn.log_softmax(ins[0], axis=-1),
+    "Rsqrt": lambda ins, n: jax.lax.rsqrt(ins[0]),
+    "Sqrt": lambda ins, n: jnp.sqrt(ins[0]),
+    "Square": lambda ins, n: ins[0] * ins[0],
+    "Exp": lambda ins, n: jnp.exp(ins[0]),
+    "Neg": lambda ins, n: -ins[0],
+    "Reshape": lambda ins, n: jnp.reshape(
+        ins[0], [int(v) for v in np.asarray(ins[1])]),
+    "Squeeze": lambda ins, n: jnp.squeeze(
+        ins[0], axis=tuple(_attr(n, "squeeze_dims", []) or
+                           _attr(n, "axis", [])) or None),
+    "ExpandDims": lambda ins, n: jnp.expand_dims(
+        ins[0], int(np.asarray(ins[1]))),
+    "Transpose": lambda ins, n: jnp.transpose(
+        ins[0], [int(v) for v in np.asarray(ins[1])]),
+    "Mean": lambda ins, n: jnp.mean(
+        ins[0], axis=tuple(int(v) for v in np.ravel(np.asarray(ins[1]))),
+        keepdims=_attr(n, "keep_dims", False)),
+    "Max": lambda ins, n: jnp.max(
+        ins[0], axis=tuple(int(v) for v in np.ravel(np.asarray(ins[1]))),
+        keepdims=_attr(n, "keep_dims", False)),
+    "Sum": lambda ins, n: jnp.sum(
+        ins[0], axis=tuple(int(v) for v in np.ravel(np.asarray(ins[1]))),
+        keepdims=_attr(n, "keep_dims", False)),
+    "ConcatV2": lambda ins, n: jnp.concatenate(
+        ins[:-1], axis=int(np.asarray(ins[-1]))),
+    "Pad": lambda ins, n: jnp.pad(
+        ins[0], [(int(a), int(b)) for a, b in np.asarray(ins[1])]),
+    "Conv2D": _conv2d,
+    "DepthwiseConv2dNative": _depthwise_conv2d,
+    "MaxPool": lambda ins, n: _pool(jax.lax.max, -jnp.inf, n, ins[0]),
+    "AvgPool": lambda ins, n: _pool(jax.lax.add, 0.0, n, ins[0],
+                                    avg=True),
+    "FusedBatchNorm": _fused_batch_norm,
+    "FusedBatchNormV3": _fused_batch_norm,
+    "Cast": lambda ins, n: ins[0],        # dtype policy left to jax
+    "StopGradient": lambda ins, n: jax.lax.stop_gradient(ins[0]),
+    "NoOp": lambda ins, n: None,
+}
+
+SUPPORTED_OPS = sorted(set(_HANDLERS) | {"Const", "Placeholder"})
+
+
+class TFNet:
+    """Execute a frozen TF graph as a jit-compiled jax function.
+
+    ``TFNet(path_or_graphdef, inputs=[...], outputs=[...])``; call with
+    positional numpy arrays matching ``inputs`` order.
+    """
+
+    def __init__(self, graph, inputs: Optional[Sequence[str]] = None,
+                 outputs: Optional[Sequence[str]] = None):
+        gd = self._load(graph)
+        self._nodes = {n.name: n for n in gd.node}
+        placeholders = [n.name for n in gd.node if n.op == "Placeholder"]
+        self.inputs = list(inputs) if inputs else placeholders
+        if outputs:
+            self.outputs = [o.split(":")[0] for o in outputs]
+        else:
+            consumed = {self._base(i) for n in gd.node for i in n.input}
+            self.outputs = [n.name for n in gd.node
+                            if n.name not in consumed
+                            and n.op not in ("Const", "NoOp")]
+        unsupported = sorted({n.op for n in gd.node
+                              if n.op not in _HANDLERS
+                              and n.op not in ("Const", "Placeholder")})
+        if unsupported:
+            raise NotImplementedError(
+                f"TFNet: unsupported ops {unsupported}; supported: "
+                f"{SUPPORTED_OPS}")
+        self._consts = {n.name: _tensor_to_np(_attr(n, "value"))
+                        for n in gd.node if n.op == "Const"}
+        self._fn = jax.jit(self._build())
+
+    @staticmethod
+    def _load(graph):
+        if not isinstance(graph, (str, bytes)):
+            return graph                      # already a GraphDef
+        from tensorflow.core.framework import graph_pb2
+        gd = graph_pb2.GraphDef()
+        if isinstance(graph, str):
+            with open(graph, "rb") as f:
+                graph = f.read()
+        gd.ParseFromString(graph)
+        return gd
+
+    @staticmethod
+    def _base(name: str) -> str:
+        return name.lstrip("^").split(":")[0]
+
+    def _build(self):
+        nodes = self._nodes
+        consts = self._consts
+        inputs = self.inputs
+        outputs = self.outputs
+        base = self._base
+
+        def run(*args):
+            if len(args) != len(inputs):
+                raise ValueError(
+                    f"expected {len(inputs)} inputs {inputs}, "
+                    f"got {len(args)}")
+            env: Dict[str, Any] = dict(zip(inputs, args))
+            # consts stay as HOST numpy: shape/axis operands (Reshape,
+            # Mean, Transpose, ...) must be concrete under jit tracing;
+            # compute ops promote numpy operands to device constants
+            env.update(consts)
+
+            def evaluate(name: str):
+                name = base(name)
+                if name in env:
+                    return env[name]
+                node = nodes[name]
+                ins = [evaluate(i) for i in node.input
+                       if not i.startswith("^")]
+                env[name] = _HANDLERS[node.op](ins, node)
+                return env[name]
+
+            outs = [evaluate(o) for o in outputs]
+            return outs[0] if len(outs) == 1 else tuple(outs)
+
+        return run
+
+    def __call__(self, *args):
+        return self._fn(*[jnp.asarray(a) for a in args])
+
+    def predict(self, *args) -> np.ndarray:
+        return np.asarray(self(*args))

@@ -35,6 +35,11 @@ class FLServer:
         self._psi_salt = "bigdl_tpu_psi"
         self._psi_sets: Dict[str, set] = {}
         self._psi_result: Optional[set] = None
+        # barrier-reduce + kv state (FGBoost/VFL)
+        self._agg_pending: Dict[str, Dict[str, list]] = {}
+        self._agg_results: Dict[str, list] = {}
+        self._agg_delivered: Dict[str, int] = {}
+        self._kv: Dict[str, object] = {}
 
     # -- lifecycle -----------------------------------------------------------
     def build(self):  # ref API name
@@ -142,6 +147,69 @@ class FLServer:
                 return {"status": "timeout"}
             return {"status": "ok",
                     "intersection": sorted(self._psi_result)}
+
+    # -- generic keyed barrier-reduce (FGBoost/VFL substrate) ----------------
+    # Every client submits a payload under ``key``; once ``client_num``
+    # payloads arrive, the server reduces them (sum/mean/min/max,
+    # elementwise over array lists) and every submitter's blocked call
+    # returns the reduced result. This is the role FGBoostServiceImpl's
+    # gRPC aggregator plays in the reference: the server only ever sees
+    # aggregated statistics, never raw rows.
+    _REDUCERS = {
+        "sum": lambda ps: [np.sum([p[i] for p in ps], axis=0)
+                           for i in range(len(ps[0]))],
+        "mean": lambda ps: [np.mean([p[i] for p in ps], axis=0)
+                            for i in range(len(ps[0]))],
+        "min": lambda ps: [np.min([p[i] for p in ps], axis=0)
+                           for i in range(len(ps[0]))],
+        "max": lambda ps: [np.max([p[i] for p in ps], axis=0)
+                           for i in range(len(ps[0]))],
+        "concat": lambda ps: [np.concatenate([p[i] for p in ps])
+                              for i in range(len(ps[0]))],
+    }
+
+    def _on_agg(self, msg) -> dict:
+        key = str(msg["key"])
+        op = msg.get("op", "sum")
+        if op not in self._REDUCERS:
+            return {"status": "error", "error": f"unknown op {op!r}"}
+        n = int(msg.get("n_parties", self.client_num))
+        with self._cond:
+            pend = self._agg_pending.setdefault(key, {})
+            pend[msg["client_id"]] = msg["payload"]
+            if len(pend) >= n:
+                self._agg_results[key] = self._REDUCERS[op](
+                    [pend[c] for c in sorted(pend)])
+                del self._agg_pending[key]
+                self._cond.notify_all()
+            ok = self._cond.wait_for(
+                lambda: key in self._agg_results or self._stop.is_set(),
+                timeout=msg.get("timeout", 120.0))
+            if not ok or key not in self._agg_results:
+                return {"status": "timeout"}
+            result = self._agg_results[key]
+            self._agg_delivered[key] = self._agg_delivered.get(key, 0) + 1
+            if self._agg_delivered[key] >= n:   # all parties served: GC
+                del self._agg_results[key]
+                del self._agg_delivered[key]
+            return {"status": "ok", "payload": result}
+
+    def _on_put(self, msg) -> dict:
+        """Blocking kv broadcast: one party puts, any party gets."""
+        with self._cond:
+            self._kv[str(msg["key"])] = msg["payload"]
+            self._cond.notify_all()
+            return {"status": "ok"}
+
+    def _on_get(self, msg) -> dict:
+        key = str(msg["key"])
+        with self._cond:
+            ok = self._cond.wait_for(
+                lambda: key in self._kv or self._stop.is_set(),
+                timeout=msg.get("timeout", 120.0))
+            if not ok or key not in self._kv:
+                return {"status": "timeout"}
+            return {"status": "ok", "payload": self._kv[key]}
 
     @staticmethod
     def hash_id(value: str, salt: str) -> str:

@@ -1,3 +1,4 @@
-from bigdl_tpu.tensor.tensor import Tensor, SparseTensor
+from bigdl_tpu.tensor.sparse import SparseTensor
+from bigdl_tpu.tensor.tensor import Tensor
 
 __all__ = ["Tensor", "SparseTensor"]

@@ -1,0 +1,81 @@
+"""SparseTensor — COO sparse tensor (ref: S:dllib/tensor/
+SparseTensor.scala — backs the reference's sparse recsys layers;
+round 1 had nothing sparse).
+
+TPU-first design: a frozen ``(indices, values, shape)`` triple. XLA has
+no native sparse formats, so compute paths lower to dense gathers /
+``segment_sum`` — which on TPU is exactly how the MXU wants embedding
+workloads expressed (the reference's CPU CSR loops have no MXU analog).
+Interops with ``jax.experimental.sparse.BCOO`` for ecosystem code.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class SparseTensor:
+    """COO: ``indices (nnz, ndim) int32``, ``values (nnz,)``, ``shape``."""
+
+    def __init__(self, indices, values, shape: Sequence[int]):
+        self.indices = jnp.asarray(indices, jnp.int32)
+        self.values = jnp.asarray(values)
+        self.shape = tuple(int(s) for s in shape)
+        if self.indices.ndim != 2 or \
+                self.indices.shape[1] != len(self.shape):
+            raise ValueError(
+                f"indices {self.indices.shape} do not match shape "
+                f"{self.shape}")
+        if self.indices.shape[0] != self.values.shape[0]:
+            raise ValueError("indices/values nnz mismatch")
+
+    # -- constructors --------------------------------------------------------
+    @classmethod
+    def from_dense(cls, dense) -> "SparseTensor":
+        d = np.asarray(dense)
+        idx = np.argwhere(d != 0)
+        return cls(idx, d[tuple(idx.T)], d.shape)
+
+    @classmethod
+    def from_bcoo(cls, bcoo) -> "SparseTensor":
+        return cls(bcoo.indices, bcoo.data, bcoo.shape)
+
+    # -- views ---------------------------------------------------------------
+    @property
+    def nnz(self) -> int:
+        return int(self.values.shape[0])
+
+    @property
+    def ndim(self) -> int:
+        return len(self.shape)
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    def to_dense(self) -> jnp.ndarray:
+        out = jnp.zeros(self.shape, self.values.dtype)
+        return out.at[tuple(self.indices.T)].add(self.values)
+
+    def to_bcoo(self):
+        from jax.experimental import sparse as jsparse
+        return jsparse.BCOO((self.values, self.indices), shape=self.shape)
+
+    # -- math (the ops the sparse layers need) ------------------------------
+    def matmul_dense(self, w: jnp.ndarray) -> jnp.ndarray:
+        """(self: (B, F) sparse) @ (w: (F, O) dense) via segment-sum —
+        the SparseLinear forward."""
+        if self.ndim != 2:
+            raise ValueError("matmul_dense needs a 2-D sparse tensor")
+        rows, cols = self.indices[:, 0], self.indices[:, 1]
+        contrib = w[cols] * self.values[:, None].astype(w.dtype)
+        import jax
+        return jax.ops.segment_sum(contrib, rows,
+                                   num_segments=self.shape[0])
+
+    def __repr__(self):
+        return (f"SparseTensor(shape={self.shape}, nnz={self.nnz}, "
+                f"dtype={self.dtype})")

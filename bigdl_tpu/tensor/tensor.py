@@ -345,29 +345,5 @@ class Tensor:
         return float(self.data)
 
 
-class SparseTensor:
-    """COO sparse tensor (ref: .../tensor/SparseTensor.scala).
-
-    Stores (indices, values, shape); ``to_dense`` scatters into a dense
-    jnp array. Used by LookupTableSparse-style layers; on TPU sparse
-    gathers compile to efficient dynamic-slice/gather HLO.
-    """
-
-    def __init__(self, indices, values, shape):
-        self.indices = jnp.asarray(indices, dtype=jnp.int32)  # (ndim, nnz), 0-based
-        self.values = jnp.asarray(values)
-        self.shape = tuple(int(s) for s in shape)
-
-    def to_dense(self) -> Tensor:
-        dense = jnp.zeros(self.shape, dtype=self.values.dtype)
-        dense = dense.at[tuple(self.indices)].add(self.values)
-        return Tensor(dense)
-
-    def n_element(self) -> int:
-        return int(self.values.shape[0])
-
-    @staticmethod
-    def from_dense(t: Tensor) -> "SparseTensor":
-        arr = np.asarray(_unwrap(t))
-        idx = np.nonzero(arr)
-        return SparseTensor(np.stack(idx), arr[idx], arr.shape)
+# SparseTensor moved to bigdl_tpu.tensor.sparse (full COO type with
+# segment-sum compute paths backing the sparse nn layers).

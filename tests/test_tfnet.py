@@ -1,0 +1,102 @@
+"""TFNet: frozen TF graphs executed as jit-compiled jax (ref: orca
+TFNet + S:dllib/nn/ops — golden parity vs TensorFlow's own execution,
+the reference's independent-implementation test pattern)."""
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+from bigdl_tpu.nn.ops import TFNet  # noqa: E402
+
+
+def _freeze(model, spec):
+    from tensorflow.python.framework.convert_to_constants import (
+        convert_variables_to_constants_v2)
+    fn = tf.function(lambda x: model(x))
+    concrete = fn.get_concrete_function(tf.TensorSpec(spec, tf.float32))
+    frozen = convert_variables_to_constants_v2(concrete)
+    return frozen.graph.as_graph_def(), concrete
+
+
+class TestTFNet:
+    def test_mlp_matches_tf(self):
+        tf.random.set_seed(0)
+        model = tf.keras.Sequential([
+            tf.keras.layers.Dense(16, activation="relu"),
+            tf.keras.layers.Dense(8, activation="tanh"),
+            tf.keras.layers.Dense(4),
+            tf.keras.layers.Softmax(),
+        ])
+        model.build((None, 12))
+        gd, concrete = _freeze(model, [None, 12])
+        x = np.random.RandomState(0).rand(5, 12).astype(np.float32)
+        ref = model(x).numpy()
+        net = TFNet(gd)
+        out = net.predict(x)
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+    def test_cnn_matches_tf(self):
+        tf.random.set_seed(1)
+        model = tf.keras.Sequential([
+            tf.keras.layers.Conv2D(4, 3, padding="same",
+                                   activation="relu"),
+            tf.keras.layers.MaxPooling2D(2),
+            tf.keras.layers.Conv2D(8, 3, padding="valid"),
+            tf.keras.layers.GlobalAveragePooling2D(),
+            tf.keras.layers.Dense(3),
+        ])
+        model.build((None, 12, 12, 2))
+        gd, _ = _freeze(model, [None, 12, 12, 2])
+        x = np.random.RandomState(1).rand(2, 12, 12, 2)\
+            .astype(np.float32)
+        ref = model(x).numpy()
+        out = TFNet(gd).predict(x)
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+    def test_batchnorm_inference_matches_tf(self):
+        tf.random.set_seed(2)
+        model = tf.keras.Sequential([
+            tf.keras.layers.Conv2D(4, 3),
+            tf.keras.layers.BatchNormalization(),
+            tf.keras.layers.ReLU(),
+        ])
+        model.build((None, 8, 8, 2))
+        # shift running stats away from init so the BN math is exercised
+        bn = model.layers[1]
+        bn.moving_mean.assign(tf.random.normal([4]))
+        bn.moving_variance.assign(tf.random.uniform([4], 0.5, 2.0))
+        gd, _ = _freeze(model, [None, 8, 8, 2])
+        x = np.random.RandomState(2).rand(2, 8, 8, 2).astype(np.float32)
+        ref = model(x, training=False).numpy()
+        out = TFNet(gd).predict(x)
+        np.testing.assert_allclose(out, ref, rtol=1e-3, atol=1e-4)
+
+    def test_unsupported_op_raises_at_load(self):
+        gd = tf.compat.v1.GraphDef()
+        n = gd.node.add()
+        n.name = "x"
+        n.op = "Placeholder"
+        n2 = gd.node.add()
+        n2.name = "fancy"
+        n2.op = "SomeExoticOp"
+        n2.input.append("x")
+        with pytest.raises(NotImplementedError, match="SomeExoticOp"):
+            TFNet(gd)
+
+    def test_explicit_outputs_and_multi_output(self):
+        tf.random.set_seed(3)
+        model = tf.keras.Sequential([
+            tf.keras.layers.Dense(6, activation="relu"),
+            tf.keras.layers.Dense(2),
+        ])
+        model.build((None, 4))
+        gd, _ = _freeze(model, [None, 4])
+        # pick an intermediate node as an extra output
+        relu_nodes = [n.name for n in gd.node if n.op == "Relu"]
+        final = [n.name for n in gd.node if n.op == "BiasAdd"][-1]
+        net = TFNet(gd, outputs=[relu_nodes[0], final])
+        x = np.random.RandomState(3).rand(3, 4).astype(np.float32)
+        hid, out = net(x)
+        assert np.asarray(hid).shape == (3, 6)
+        assert np.asarray(out).shape == (3, 2)
