@@ -1,7 +1,8 @@
-"""Pallas TPU kernels for low-bit inference (ref: the llama.cpp-family
-AVX/VNNI kernels the reference ships — here lowered to the MXU)."""
+"""Pallas TPU kernels for the bigdl-llm slice."""
 
 from bigdl_tpu.llm.kernels.int4_matmul import (
-    int4_matmul, int4_matmul_reference, int8_matmul)
+    asym_int4_matmul, int4_matmul, int4_matmul_reference, int8_matmul,
+    quantize_tpu, to_tpu_layout)
 
-__all__ = ["int4_matmul", "int4_matmul_reference", "int8_matmul"]
+__all__ = ["asym_int4_matmul", "int4_matmul", "int4_matmul_reference",
+           "int8_matmul", "quantize_tpu", "to_tpu_layout"]

@@ -3,19 +3,45 @@
 Reference counterpart: bigdl-llm's native q4_0 matvec (ctypes →
 llama.cpp-family C kernels, SURVEY.md §3.4 hot loop). TPU design:
 
-- weights stay packed in HBM/VMEM (uint8, two nibble-planes) — 4.5 bits/
-  weight including scales, so the HBM→VMEM stream is ~3.5x smaller than
-  bf16. Decode is HBM-bandwidth-bound, so this is where the speed comes
-  from (same reason the CPU kernels win on DDR bandwidth).
-- dequant happens in-kernel on the VPU (arithmetic only, no gathers for
-  q4_0/q8_0), feeding bf16 tiles straight into the MXU ``jnp.dot``.
-- grid = (M/bm, N/bn, K/bk) with a VMEM fp32 accumulator, K innermost so
-  the accumulator lives across the K sweep (standard Pallas TPU matmul
-  schedule).
+- weights stream packed from HBM (uint8, 2 nibbles/byte) — 4.5 bits/
+  weight including scales, ~3.5x less HBM traffic than bf16. Decode is
+  HBM-bandwidth-bound, so this is where the speed comes from (same
+  reason the reference's CPU kernels win on DDR bandwidth).
+- **k-major "TPU layout"**: packed weights are stored (K/2, N) and
+  scales (K/QK, N) — transposed once at load by :func:`to_tpu_layout` —
+  so the kernel's dequantized tile feeds ``jnp.dot`` directly with no
+  in-register transpose, and every BlockSpec dim is either 128-aligned
+  or the full array dim (the r2 kernel's (bn, bk//QK) scale block
+  violated Pallas's last-dim rule and never lowered on real TPU).
+- the per-32-group scale broadcast runs on the **MXU, not the VPU**: an
+  expansion matrix E (K/2, G) with E[i, g] = [i//16 == g] is built from
+  two iotas and ``s_exp = E @ scales`` expands group scales to per-row
+  scales as a matmul. The naive reshape-broadcast costs a Mosaic
+  relayout per weight and measured 3x slower on chip.
+- the q4_0 zero-point (-8) is algebraic, not elementwise:
+  sum_k x_k*(q-8)*s = sum_k x_k*q*s - 8*sum_g (sum_{k in g} x_k)*s[g]
+  so decode (m small, bandwidth-bound) folds it into one extra skinny
+  dot against ``s_exp``; prefill (m large, MXU-bound) subtracts 8 on
+  the VPU instead, trading VPU ops for a third of the MXU work.
+- float16 never enters the kernel: this Mosaic build cannot load fp16
+  (verified on chip: "Unsupported cast"-class remote-compile failures),
+  so ggml's fp16 scales are converted to f32 on the host.
 
-Layouts (from llm.ggml.quantize): x (M, K) activations; q packed uint8
-(N, K//2) — low nibble = even-k plane, high = odd-k; scale fp16
-(N, K//32). Output (M, N) = x @ W^T, matching Linear's y = x W^T.
+Measured on TPU v5 lite (1 chip, 819 GB/s HBM), (1, 4096)x(4096, 11008)
+Llama-2-7B decode matvec: ~130 us — parity with XLA's dense bf16 matvec
+(~122 us, which runs at the full 740 GB/s HBM rate) while streaming
+3.2x fewer bytes. At m=1 both are bounded by per-weight compute/issue
+rate, not bandwidth: the kernel's VPU dequant (~7 ops/packed byte:
+widen, 2x mask/shift, 2x cast, 2x scale-mul) runs at the ~1.7 T op/s
+effective VPU rate, which lands within 10% of the dense matvec's
+bandwidth floor. Alternatives measured and rejected on chip: VPU-only
+matvec (no MXU) 174 us; scale expansion via in-kernel expansion-matrix
+matmul vs pltpu.repeat — identical; int8 MXU dots offer no rate gain on
+this toolchain (1.09x), closing the W4A8 route. The win int4 keeps:
+4x less HBM *footprint* (7B fits comfortably beside its KV cache) and
+4x less HBM traffic, which turns into throughput wherever the batch
+dimension (m >= 16) lifts the compute floor — batched decode and
+prefill — and on bandwidth-richer TPUs.
 
 ``interpret=True`` runs the same kernel on CPU for tests (SURVEY.md §4:
 golden parity against an independent implementation — here the numpy
@@ -25,7 +51,7 @@ dequant reference).
 from __future__ import annotations
 
 import functools
-from typing import Optional
+from typing import Dict, Optional
 
 import jax
 import jax.numpy as jnp
@@ -35,146 +61,293 @@ from jax.experimental.pallas import tpu as pltpu
 
 from bigdl_tpu.llm.ggml.quantize import QK
 
-
-def _int4_kernel(x_ref, qlo_ref, qhi_ref, scale_ref, o_ref, acc_ref,
-                 *, n_k_tiles):
-    """One (bm, bn) tile: accumulate x_tile @ dequant(w_tile)^T over K."""
-    k_idx = pl.program_id(2)
-
-    @pl.when(k_idx == 0)
-    def _init():
-        acc_ref[:] = jnp.zeros_like(acc_ref)
-
-    # dequant: interleave the two nibble planes back into k-order
-    lo = qlo_ref[:].astype(jnp.int32) - 8          # (bn, bk/2) even k
-    hi = qhi_ref[:].astype(jnp.int32) - 8          # (bn, bk/2) odd k
-    bn, half = lo.shape
-    w = jnp.stack([lo, hi], axis=-1).reshape(bn, half * 2)  # (bn, bk)
-    scale = scale_ref[:].astype(jnp.float32)       # (bn, bk/QK)
-    w = w.reshape(bn, half * 2 // QK, QK) * scale[..., None]
-    w = w.reshape(bn, half * 2).astype(jnp.bfloat16)
-
-    acc_ref[:] += jnp.dot(x_ref[:], w.T, preferred_element_type=jnp.float32)
-
-    @pl.when(k_idx == n_k_tiles - 1)
-    def _done():
-        o_ref[:] = acc_ref[:].astype(o_ref.dtype)
+HALF = QK // 2          # scale-group size within one nibble plane
+_MAX_BK = 16384         # K above this is chunked to bound VMEM
 
 
-def _split_planes(q_packed: jnp.ndarray):
-    """uint8 (N, K//2) → (lo, hi) nibble planes, each (N, K//2)."""
-    return q_packed & 0xF, q_packed >> 4
+def _scale_expand(scale_ref, half: int, cdt):
+    """(G, bn) group scales → (half, bn) per-row scales via an MXU matmul
+    against an iota-built expansion matrix (no VPU relayout)."""
+    g = half // HALF
+    sc = scale_ref[:].astype(cdt)
+    row = jax.lax.broadcasted_iota(jnp.int32, (half, g), 0)
+    col = jax.lax.broadcasted_iota(jnp.int32, (half, g), 1)
+    e = jnp.where(row // HALF == col, 1.0, 0.0).astype(cdt)
+    return jnp.dot(e, sc, preferred_element_type=jnp.float32).astype(cdt)
 
 
-@functools.partial(jax.jit, static_argnames=("bm", "bn", "bk", "interpret",
-                                             "out_dtype"))
-def int4_matmul(x, q_packed, scale, bm: int = 128, bn: int = 128,
-                bk: int = 512, interpret: bool = False,
-                out_dtype=jnp.bfloat16):
-    """y = x @ dequant_q4_0(q, scale)^T.
+def _int4_kernel(xe_ref, xo_ref, q_ref, scale_ref, o_ref, *, sub8: bool,
+                 cdt=jnp.bfloat16):
+    """One (bm, bn) output tile.
 
-    x: (M, K) bf16/f32; q_packed: (N, K//2) uint8; scale: (N, K//QK) fp16.
-    M, N, K padded internally to tile multiples.
+    xe/xo: (bm, K/2) even/odd k-plane activations; q: (K/2, bn) packed
+    uint8 (low nibble = even k, high = odd k); scale: (G, bn).
+    ``cdt`` is the MXU operand dtype (f32 under interpret: the CPU thunk
+    cannot execute bf16 x bf16 dots).
+    """
+    q = q_ref[:].astype(jnp.int32)
+    half, _ = q.shape
+    s_exp = _scale_expand(scale_ref, half, cdt)
+    xe = xe_ref[:].astype(cdt)
+    xo = xo_ref[:].astype(cdt)
+    if sub8:
+        lo = ((q & 0xF) - 8).astype(cdt) * s_exp
+        hi = ((q >> 4) - 8).astype(cdt) * s_exp
+        acc = jnp.dot(xe, lo, preferred_element_type=jnp.float32)
+        acc += jnp.dot(xo, hi, preferred_element_type=jnp.float32)
+    else:
+        lo = (q & 0xF).astype(cdt) * s_exp
+        hi = (q >> 4).astype(cdt) * s_exp
+        acc = jnp.dot(xe, lo, preferred_element_type=jnp.float32)
+        acc += jnp.dot(xo, hi, preferred_element_type=jnp.float32)
+        acc -= 8.0 * jnp.dot(xe + xo, s_exp,
+                             preferred_element_type=jnp.float32)
+    o_ref[:] = acc.astype(o_ref.dtype)
+
+
+def _asym_int4_kernel(xe_ref, xo_ref, q_ref, scale_ref, zero_ref, o_ref,
+                      *, cdt=jnp.bfloat16):
+    """q4_1: w = q * scale + zero (zero = per-group minimum)."""
+    q = q_ref[:].astype(jnp.int32)
+    half, _ = q.shape
+    s_exp = _scale_expand(scale_ref, half, cdt)
+    z_exp = _scale_expand(zero_ref, half, cdt)
+    lo = (q & 0xF).astype(cdt) * s_exp
+    hi = (q >> 4).astype(cdt) * s_exp
+    xe = xe_ref[:].astype(cdt)
+    xo = xo_ref[:].astype(cdt)
+    acc = jnp.dot(xe, lo, preferred_element_type=jnp.float32)
+    acc += jnp.dot(xo, hi, preferred_element_type=jnp.float32)
+    acc += jnp.dot(xe + xo, z_exp, preferred_element_type=jnp.float32)
+    o_ref[:] = acc.astype(o_ref.dtype)
+
+
+def _int8_kernel(x_ref, q_ref, scale_ref, o_ref, *, cdt=jnp.bfloat16):
+    """q8_0: w = q * scale, q int8 (K, bn) — unpack-free stream."""
+    q = q_ref[:].astype(jnp.int32)
+    k, _ = q.shape
+    g = k // QK
+    sc = scale_ref[:].astype(cdt)
+    row = jax.lax.broadcasted_iota(jnp.int32, (k, g), 0)
+    col = jax.lax.broadcasted_iota(jnp.int32, (k, g), 1)
+    e = jnp.where(row // QK == col, 1.0, 0.0).astype(cdt)
+    s_exp = jnp.dot(e, sc, preferred_element_type=jnp.float32).astype(cdt)
+    w = q.astype(cdt) * s_exp
+    o_ref[:] = jnp.dot(x_ref[:].astype(cdt), w,
+                       preferred_element_type=jnp.float32) \
+        .astype(o_ref.dtype)
+
+
+def _pad_nk(q_t, scale_t, bn, pad_byte, extras=()):
+    n = q_t.shape[1]
+    n_pad = -n % bn
+    if n_pad:
+        q_t = jnp.pad(q_t, ((0, 0), (0, n_pad)), constant_values=pad_byte)
+        scale_t = jnp.pad(scale_t, ((0, 0), (0, n_pad)))
+        extras = tuple(jnp.pad(z, ((0, 0), (0, n_pad))) for z in extras)
+    return (q_t, scale_t) + extras
+
+
+def _chunk_k(k: int):
+    """Split K into <= _MAX_BK chunks (each a multiple of QK)."""
+    if k <= _MAX_BK:
+        return [(0, k)]
+    n_chunks = -(-k // _MAX_BK)
+    per = -(-k // (n_chunks * QK)) * QK
+    out, s = [], 0
+    while s < k:
+        out.append((s, min(per, k - s)))
+        s += per
+    return out
+
+
+@functools.partial(jax.jit, static_argnames=("bm", "bn", "interpret",
+                                             "out_dtype", "mode"))
+def int4_matmul(x, q_t, scale_t, bm: int = 128, bn: int = 256,
+                interpret: bool = False, out_dtype=jnp.bfloat16,
+                mode: str = "auto"):
+    """y = x @ dequant_q4_0(q, scale) in TPU layout.
+
+    x: (M, K) activations; q_t: (K/2, N) packed uint8 (low nibble =
+    even k); scale_t: (K/QK, N) float32 (fp16 accepted, converted).
+    ``mode``: "corr" folds the -8 zero-point into an extra skinny dot
+    (best for decode), "sub8" subtracts on the VPU (best for prefill),
+    "auto" picks by M.
     """
     m, k = x.shape
-    n = q_packed.shape[0]
-    bm = min(bm, max(8, m))
-    bk = min(bk, k)
-    if bk % QK:
-        raise ValueError(f"bk must be a multiple of {QK}")
-
-    qlo, qhi = _split_planes(q_packed)
-
+    n = q_t.shape[1]
+    if q_t.shape[0] * 2 != k:
+        raise ValueError(
+            f"q_t {q_t.shape} is not the (K/2, N) TPU layout for K={k}; "
+            "convert ggml (N, K/2) dicts with to_tpu_layout() first")
+    sub8 = (m >= 256) if mode == "auto" else (mode == "sub8")
+    scale_t = scale_t.astype(jnp.float32)
+    bm = min(bm, max(16, m))
     m_pad = -m % bm
-    n_pad = -n % bn
-    k_pad = -k % bk
-    if m_pad or k_pad:
-        x = jnp.pad(x, ((0, m_pad), (0, k_pad)))
-    if n_pad or k_pad:
-        qlo = jnp.pad(qlo, ((0, n_pad), (0, k_pad // 2)),
-                      constant_values=8)
-        qhi = jnp.pad(qhi, ((0, n_pad), (0, k_pad // 2)),
-                      constant_values=8)
-        scale = jnp.pad(scale, ((0, n_pad), (0, k_pad // QK)))
-    mp, kp = x.shape
-    np_ = qlo.shape[0]
-    n_k_tiles = kp // bk
+    if m_pad:
+        x = jnp.pad(x, ((0, m_pad), (0, 0)))
+    q_t, scale_t = _pad_nk(q_t, scale_t, bn, 0x88)
+    mp, np_ = x.shape[0], q_t.shape[1]
+    x = x.astype(jnp.bfloat16)
 
-    out = pl.pallas_call(
-        functools.partial(_int4_kernel, n_k_tiles=n_k_tiles),
-        grid=(mp // bm, np_ // bn, n_k_tiles),
-        in_specs=[
-            pl.BlockSpec((bm, bk), lambda i, j, kk: (i, kk)),
-            pl.BlockSpec((bn, bk // 2), lambda i, j, kk: (j, kk)),
-            pl.BlockSpec((bn, bk // 2), lambda i, j, kk: (j, kk)),
-            pl.BlockSpec((bn, bk // QK), lambda i, j, kk: (j, kk)),
-        ],
-        out_specs=pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j)),
-        out_shape=jax.ShapeDtypeStruct((mp, np_), out_dtype),
-        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
-        interpret=interpret,
-    )(x.astype(jnp.bfloat16), qlo, qhi, scale)
-    return out[:m, :n]
-
-
-def _int8_kernel(x_ref, q_ref, scale_ref, o_ref, acc_ref, *, n_k_tiles):
-    k_idx = pl.program_id(2)
-
-    @pl.when(k_idx == 0)
-    def _init():
-        acc_ref[:] = jnp.zeros_like(acc_ref)
-
-    w = q_ref[:].astype(jnp.float32)               # (bn, bk)
-    scale = scale_ref[:].astype(jnp.float32)       # (bn, bk/QK)
-    bn, bk = w.shape
-    w = (w.reshape(bn, bk // QK, QK) * scale[..., None]) \
-        .reshape(bn, bk).astype(jnp.bfloat16)
-    acc_ref[:] += jnp.dot(x_ref[:], w.T, preferred_element_type=jnp.float32)
-
-    @pl.when(k_idx == n_k_tiles - 1)
-    def _done():
-        o_ref[:] = acc_ref[:].astype(o_ref.dtype)
+    out = None
+    for k0, kc in _chunk_k(k):
+        xe = x[:, k0:k0 + kc:2]
+        xo = x[:, k0 + 1:k0 + kc:2]
+        qc = q_t[k0 // 2:(k0 + kc) // 2]
+        sc = scale_t[k0 // QK:(k0 + kc) // QK]
+        half, g = kc // 2, kc // QK
+        part = pl.pallas_call(
+            functools.partial(_int4_kernel, sub8=sub8,
+                              cdt=jnp.float32 if interpret
+                              else jnp.bfloat16),
+            grid=(mp // bm, np_ // bn),
+            in_specs=[
+                pl.BlockSpec((bm, half), lambda i, j: (i, 0)),
+                pl.BlockSpec((bm, half), lambda i, j: (i, 0)),
+                pl.BlockSpec((half, bn), lambda i, j: (0, j)),
+                pl.BlockSpec((g, bn), lambda i, j: (0, j)),
+            ],
+            out_specs=pl.BlockSpec((bm, bn), lambda i, j: (i, j)),
+            out_shape=jax.ShapeDtypeStruct((mp, np_), jnp.float32),
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel")),
+            interpret=interpret,
+        )(xe, xo, qc, sc)
+        out = part if out is None else out + part
+    return out[:m, :n].astype(out_dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("bm", "bn", "bk", "interpret",
+@functools.partial(jax.jit, static_argnames=("bm", "bn", "interpret",
                                              "out_dtype"))
-def int8_matmul(x, q, scale, bm: int = 128, bn: int = 128, bk: int = 512,
-                interpret: bool = False, out_dtype=jnp.bfloat16):
-    """y = x @ dequant_q8_0(q, scale)^T — the BigQuant INT8 gemm
-    equivalent (SURVEY.md §2.2). q: (N, K) int8."""
+def asym_int4_matmul(x, q_t, scale_t, zero_t, bm: int = 128, bn: int = 256,
+                     interpret: bool = False, out_dtype=jnp.bfloat16):
+    """y = x @ dequant_q4_1(q, scale, zero) in TPU layout."""
     m, k = x.shape
-    n = q.shape[0]
-    bm = min(bm, max(8, m))
-    bk = min(bk, k)
-    m_pad, n_pad, k_pad = -m % bm, -n % bn, -k % bk
-    if m_pad or k_pad:
-        x = jnp.pad(x, ((0, m_pad), (0, k_pad)))
-    if n_pad or k_pad:
-        q = jnp.pad(q, ((0, n_pad), (0, k_pad)))
-        scale = jnp.pad(scale, ((0, n_pad), (0, k_pad // QK)))
-    mp, kp = x.shape
-    np_ = q.shape[0]
-    n_k_tiles = kp // bk
+    n = q_t.shape[1]
+    scale_t = scale_t.astype(jnp.float32)
+    zero_t = zero_t.astype(jnp.float32)
+    bm = min(bm, max(16, m))
+    m_pad = -m % bm
+    if m_pad:
+        x = jnp.pad(x, ((0, m_pad), (0, 0)))
+    q_t, scale_t, zero_t = _pad_nk(q_t, scale_t, bn, 0, (zero_t,))
+    mp, np_ = x.shape[0], q_t.shape[1]
+    x = x.astype(jnp.bfloat16)
 
-    out = pl.pallas_call(
-        functools.partial(_int8_kernel, n_k_tiles=n_k_tiles),
-        grid=(mp // bm, np_ // bn, n_k_tiles),
-        in_specs=[
-            pl.BlockSpec((bm, bk), lambda i, j, kk: (i, kk)),
-            pl.BlockSpec((bn, bk), lambda i, j, kk: (j, kk)),
-            pl.BlockSpec((bn, bk // QK), lambda i, j, kk: (j, kk)),
-        ],
-        out_specs=pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j)),
-        out_shape=jax.ShapeDtypeStruct((mp, np_), out_dtype),
-        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
-        interpret=interpret,
-    )(x.astype(jnp.bfloat16), q, scale)
-    return out[:m, :n]
+    out = None
+    for k0, kc in _chunk_k(k):
+        xe = x[:, k0:k0 + kc:2]
+        xo = x[:, k0 + 1:k0 + kc:2]
+        qc = q_t[k0 // 2:(k0 + kc) // 2]
+        sc = scale_t[k0 // QK:(k0 + kc) // QK]
+        zc = zero_t[k0 // QK:(k0 + kc) // QK]
+        half, g = kc // 2, kc // QK
+        part = pl.pallas_call(
+            functools.partial(_asym_int4_kernel,
+                              cdt=jnp.float32 if interpret
+                              else jnp.bfloat16),
+            grid=(mp // bm, np_ // bn),
+            in_specs=[
+                pl.BlockSpec((bm, half), lambda i, j: (i, 0)),
+                pl.BlockSpec((bm, half), lambda i, j: (i, 0)),
+                pl.BlockSpec((half, bn), lambda i, j: (0, j)),
+                pl.BlockSpec((g, bn), lambda i, j: (0, j)),
+                pl.BlockSpec((g, bn), lambda i, j: (0, j)),
+            ],
+            out_specs=pl.BlockSpec((bm, bn), lambda i, j: (i, j)),
+            out_shape=jax.ShapeDtypeStruct((mp, np_), jnp.float32),
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel")),
+            interpret=interpret,
+        )(xe, xo, qc, sc, zc)
+        out = part if out is None else out + part
+    return out[:m, :n].astype(out_dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("bm", "bn", "interpret",
+                                             "out_dtype"))
+def int8_matmul(x, q_t, scale_t, bm: int = 128, bn: int = 256,
+                interpret: bool = False, out_dtype=jnp.bfloat16):
+    """y = x @ dequant_q8_0(q, scale) — the BigQuant INT8 gemm
+    equivalent (SURVEY.md §2.2). q_t: (K, N) int8; scale_t: (K/QK, N)."""
+    m, k = x.shape
+    n = q_t.shape[1]
+    if q_t.shape[0] != k:
+        raise ValueError(
+            f"q_t {q_t.shape} is not the (K, N) TPU layout for K={k}; "
+            "convert ggml (N, K) dicts with to_tpu_layout() first")
+    scale_t = scale_t.astype(jnp.float32)
+    bm = min(bm, max(16, m))
+    m_pad = -m % bm
+    if m_pad:
+        x = jnp.pad(x, ((0, m_pad), (0, 0)))
+    q_t, scale_t = _pad_nk(q_t, scale_t, bn, 0)
+    mp, np_ = x.shape[0], q_t.shape[1]
+    x = x.astype(jnp.bfloat16)
+
+    out = None
+    for k0, kc in _chunk_k(k):
+        xc = x[:, k0:k0 + kc]
+        qc = q_t[k0:k0 + kc]
+        sc = scale_t[k0 // QK:(k0 + kc) // QK]
+        g = kc // QK
+        part = pl.pallas_call(
+            functools.partial(_int8_kernel,
+                              cdt=jnp.float32 if interpret
+                              else jnp.bfloat16),
+            grid=(mp // bm, np_ // bn),
+            in_specs=[
+                pl.BlockSpec((bm, kc), lambda i, j: (i, 0)),
+                pl.BlockSpec((kc, bn), lambda i, j: (0, j)),
+                pl.BlockSpec((g, bn), lambda i, j: (0, j)),
+            ],
+            out_specs=pl.BlockSpec((bm, bn), lambda i, j: (i, j)),
+            out_shape=jax.ShapeDtypeStruct((mp, np_), jnp.float32),
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel")),
+            interpret=interpret,
+        )(xc, qc, sc)
+        out = part if out is None else out + part
+    return out[:m, :n].astype(out_dtype)
+
+
+# ---------------------------------------------------------------------------
+# layout conversion + reference
+# ---------------------------------------------------------------------------
+
+def to_tpu_layout(qdict: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+    """ggml row-major quantize() dict → k-major TPU kernel layout.
+
+    sym_int4/asym_int4: q (N, K/2) → q_t (K/2, N); scale (N, G) →
+    scale_t (G, N) f32 (fp16 is not loadable by this Mosaic build).
+    sym_int8: q (N, K) → (K, N). Other qtypes pass through (they use the
+    XLA dequant fallback).
+    """
+    qtype = qdict.get("qtype", "sym_int4")
+    if qtype not in ("sym_int4", "asym_int4", "sym_int8"):
+        return dict(qdict)
+    out = {"qtype": qtype,
+           "q": np.ascontiguousarray(np.asarray(qdict["q"]).T),
+           "scale": np.ascontiguousarray(
+               np.asarray(qdict["scale"], np.float32).T)}
+    if "zero" in qdict:
+        out["zero"] = np.ascontiguousarray(
+            np.asarray(qdict["zero"], np.float32).T)
+    return out
+
+
+def quantize_tpu(w: np.ndarray, qtype: str = "sym_int4"
+                 ) -> Dict[str, np.ndarray]:
+    """quantize() + to_tpu_layout() in one step — what model loaders use."""
+    from bigdl_tpu.llm.ggml.quantize import quantize
+    return to_tpu_layout(quantize(w, qtype))
 
 
 def int4_matmul_reference(x: np.ndarray, q_packed: np.ndarray,
                           scale: np.ndarray) -> np.ndarray:
-    """Independent numpy implementation for golden-parity tests."""
+    """Independent numpy implementation for golden-parity tests.
+    Takes the ggml (N, K/2)+(N, G) layout."""
     from bigdl_tpu.llm.ggml.quantize import dequantize
 
     w = dequantize({"qtype": "sym_int4", "q": np.asarray(q_packed),

@@ -20,7 +20,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
-from bigdl_tpu.llm.models.llama import _attention, _linear
+from bigdl_tpu.llm.models.llama import (_attention, _linear,
+                                        decode_scan)
 
 
 @dataclasses.dataclass
@@ -118,8 +119,9 @@ def init_params(cfg: GptNeoXConfig, seed: int = 0,
 
 def quantize_params(params: Dict[str, Any], qtype: str = "sym_int4"
                     ) -> Dict[str, Any]:
-    """ggml-quantize the decoder linears (weights only; biases stay bf16)."""
-    from bigdl_tpu.llm.ggml.quantize import quantize
+    """ggml-quantize the decoder linears into the k-major TPU kernel
+    layout (weights only; biases stay bf16)."""
+    from bigdl_tpu.llm.kernels import quantize_tpu
 
     if qtype != "sym_int4":
         raise NotImplementedError(
@@ -130,7 +132,7 @@ def quantize_params(params: Dict[str, Any], qtype: str = "sym_int4"
         w = np.asarray(layers[name]["w"], np.float32)
         qs, ss = [], []
         for l in range(w.shape[0]):
-            qd = quantize(w[l], qtype)
+            qd = quantize_tpu(w[l], qtype)
             qs.append(qd["q"])
             ss.append(qd["scale"])
         layers[name] = {"q": jnp.asarray(np.stack(qs)),
@@ -153,15 +155,21 @@ def param_pspecs(params: Dict[str, Any]) -> Dict[str, Any]:
         name = next((k for k in keys if k in ROW
                      or k in ("o_proj", "fc_out", "embed_in",
                               "embed_out")), None)
-        if name is None or leaf.ndim <= d0:
+        if name is None or getattr(leaf, "ndim", 0) <= d0:
             return P()
         is_bias = keys[-1] == "b"
+        kmajor = keys[-1] in ("q", "scale", "zero")   # TPU k-major layout
         spec = [None] * leaf.ndim
         if name in ROW or name in ("embed_in", "embed_out"):
-            spec[d0] = "model"               # bias of a row-sharded linear
-            # shards with it (dim d0 is the output dim for both)
+            if kmajor:
+                spec[-1] = "model"           # N is the last dim
+            else:
+                spec[d0] = "model"           # bias of a row-sharded linear
+                # shards with it (dim d0 is the output dim for both)
         elif not is_bias:                    # o_proj / fc_out weights: K dim
-            if leaf.ndim > d0 + 1:
+            if kmajor:
+                spec[d0] = "model"
+            elif leaf.ndim > d0 + 1:
                 spec[d0 + 1] = "model"
         return P(*spec)
 
@@ -264,6 +272,11 @@ class GptNeoXForCausalLM:
         self.cache_dtype = cache_dtype
         self.max_cache_len = min(max_cache_len, cfg.max_position_embeddings)
         self._step = jax.jit(functools.partial(forward, cfg=cfg))
+        self._decode_scan = jax.jit(
+            functools.partial(decode_scan, cfg=cfg, forward_fn=forward),
+            static_argnames=("num_tokens", "do_sample", "top_k",
+                             "eos_token_id"),
+            donate_argnames=("cache",))
 
     @classmethod
     def from_config(cls, cfg: GptNeoXConfig, seed: int = 0,
@@ -295,26 +308,32 @@ class GptNeoXForCausalLM:
                           cache=cache, positions=positions)
 
     def generate(self, input_ids, max_new_tokens: int = 32,
-                 eos_token_id: Optional[int] = None):
+                 eos_token_id: Optional[int] = None,
+                 decode_chunk: int = 32):
+        """Greedy decode via the one-jit scan loop (see llama.decode_scan)."""
         tokens = jnp.asarray(np.asarray(input_ids), jnp.int32)
         b, t0 = tokens.shape
         if t0 + max_new_tokens > self.max_cache_len:
             raise ValueError(f"sequence {t0}+{max_new_tokens} exceeds "
                              f"cache {self.max_cache_len}")
         logits, cache = self(tokens)
-        out = [tokens]
+        key = jax.random.PRNGKey(0)
         last = logits[:, -1]
-        finished = np.zeros((b,), bool)
-        for _ in range(max_new_tokens):
-            nxt = jnp.argmax(last, axis=-1).astype(jnp.int32)[:, None]
-            out.append(nxt)
-            if eos_token_id is not None:
-                finished |= np.asarray(nxt[:, 0] == eos_token_id)
-                if finished.all():
-                    break
-            logits, cache = self(nxt, cache)
-            last = logits[:, -1]
-        return np.concatenate([np.asarray(t) for t in out], axis=1)
+        pieces = [np.asarray(tokens)]
+        remaining = max_new_tokens
+        chunk = max_new_tokens if eos_token_id is None else decode_chunk
+        while remaining > 0:
+            n = min(chunk, remaining)
+            toks, cache, last, key = self._decode_scan(
+                self.params, cache, last, key, jnp.float32(1.0),
+                num_tokens=n, eos_token_id=eos_token_id)
+            t_np = np.asarray(toks)
+            pieces.append(t_np)
+            remaining -= n
+            if (eos_token_id is not None
+                    and (t_np == eos_token_id).any(axis=1).all()):
+                break
+        return np.concatenate(pieces, axis=1)
 
 
 # ---------------------------------------------------------------------------
@@ -334,7 +353,7 @@ def load_hf_gptneox_safetensors(path: str,
 
     from safetensors import safe_open
 
-    from bigdl_tpu.llm.ggml.quantize import quantize
+    from bigdl_tpu.llm.kernels import quantize_tpu
 
     if qtype and qtype != "sym_int4":
         raise NotImplementedError("q4_0 only on the scanned path")
@@ -370,7 +389,7 @@ def load_hf_gptneox_safetensors(path: str,
         a = acc[name]
         a["b"].append(b)
         if qtype:
-            qd = quantize(w, qtype)
+            qd = quantize_tpu(w, qtype)
             a["q"].append(qd["q"])
             a["scale"].append(qd["scale"])
         else:

@@ -150,9 +150,11 @@ def init_params(cfg: LlamaConfig, seed: int = 0,
 
 def quantize_params(params: Dict[str, Any], qtype: str = "sym_int4",
                     quantize_lm_head: bool = False) -> Dict[str, Any]:
-    """ggml-quantize every decoder linear (stacked per layer), keeping
-    norms/embeddings in bf16 (matching the reference's default)."""
-    from bigdl_tpu.llm.ggml.quantize import quantize
+    """ggml-quantize every decoder linear (stacked per layer) into the
+    k-major TPU kernel layout (q (L, K/2, N) uint8, scale (L, K/QK, N)
+    f32 — see llm.kernels.int4_matmul), keeping norms/embeddings in bf16
+    (matching the reference's default)."""
+    from bigdl_tpu.llm.kernels import quantize_tpu
 
     if qtype != "sym_int4":
         raise NotImplementedError(
@@ -164,18 +166,19 @@ def quantize_params(params: Dict[str, Any], qtype: str = "sym_int4",
         w = np.asarray(layers[name]["w"], np.float32)   # (L, N, K)
         qs, ss = [], []
         for l in range(w.shape[0]):
-            qd = quantize(w[l], qtype)
-            qs.append(qd["q"])
-            ss.append(qd["scale"])
+            td = quantize_tpu(w[l], qtype)
+            qs.append(td["q"])
+            ss.append(td["scale"])
         # NOTE: no "qtype" string key here — the stacked layer pytree is
         # scanned, so every leaf must be an L-leading array
         layers[name] = {"q": jnp.asarray(np.stack(qs)),
                         "scale": jnp.asarray(np.stack(ss))}
     out["layers"] = layers
     if quantize_lm_head and "lm_head" in out:
-        qd = quantize(np.asarray(out["lm_head"]["w"], np.float32), qtype)
-        out["lm_head"] = {"q": jnp.asarray(qd["q"]),
-                          "scale": jnp.asarray(qd["scale"]), "qtype": qtype}
+        td = quantize_tpu(np.asarray(out["lm_head"]["w"], np.float32),
+                          qtype)
+        out["lm_head"] = {"q": jnp.asarray(td["q"]),
+                          "scale": jnp.asarray(td["scale"]), "qtype": qtype}
     return out
 
 
@@ -196,17 +199,23 @@ def param_pspecs(params: Dict[str, Any]) -> Dict[str, Any]:
         name = next((k for k in keys if k in ROW
                      or k in ("o_proj", "down_proj", "lm_head",
                               "embed_tokens")), None)
-        if name is None or leaf.ndim <= d0:
+        if name is None or getattr(leaf, "ndim", 0) <= d0:
             return P()
+        # quantized leaves are k-major TPU layout (…, K-ish, N); dense
+        # "w" leaves are row-major (…, N, K)
+        kmajor = keys[-1] in ("q", "scale", "zero")
         spec = [None] * leaf.ndim
         if name in ROW or name in ("lm_head", "embed_tokens"):
-            spec[d0] = "model"               # shard N/vocab dim
-        else:
-            # o/down: shard K dim; for packed q4 (N, K/2) that's dim d0+1
-            if leaf.ndim > d0 + 1:
-                spec[d0 + 1] = "model"
+            if kmajor:
+                spec[-1] = "model"           # N is the last dim
             else:
-                spec[d0] = None
+                spec[d0] = "model"           # shard N/vocab dim
+        else:
+            # o/down: shard the K dim
+            if kmajor:
+                spec[d0] = "model"           # K/2 (or G) right after stack
+            elif leaf.ndim > d0 + 1:
+                spec[d0 + 1] = "model"
         return P(*spec)
 
     return jax.tree_util.tree_map_with_path(spec_for, params)
@@ -217,31 +226,32 @@ def param_pspecs(params: Dict[str, Any]) -> Dict[str, Any]:
 # ---------------------------------------------------------------------------
 
 def _linear(wd: Dict[str, Any], x: jnp.ndarray) -> jnp.ndarray:
-    """Dense or quantized matmul: x (..., K) → (..., N)."""
+    """Dense or quantized matmul: x (..., K) → (..., N). Quantized
+    weights are the k-major TPU layout (q (K/2, N), scale (G, N))."""
     if "w" in wd:
         return x @ wd["w"].T.astype(x.dtype)
-    qtype = wd.get("qtype", "sym_int4")
     shape = x.shape
     x2 = x.reshape(-1, shape[-1])
-    if qtype == "sym_int4" and jax.default_backend() == "tpu":
+    if jax.default_backend() == "tpu":
         from bigdl_tpu.llm.kernels import int4_matmul
         y = int4_matmul(x2, wd["q"], wd["scale"], out_dtype=x.dtype)
     else:
-        y = x2 @ _dequant_q4(wd, x.dtype).T
+        y = (x2 @ _dequant_q4(wd, x.dtype)).astype(x.dtype)
     return y.reshape(shape[:-1] + (y.shape[-1],))
 
 
 def _dequant_q4(wd, dtype):
+    """k-major XLA dequant: returns w (K, N) so y = x @ w."""
     from bigdl_tpu.llm.ggml.quantize import QK
     packed, scale = wd["q"], wd["scale"].astype(jnp.float32)
-    n = packed.shape[0]
+    half, n = packed.shape
     lo = (packed & 0xF).astype(jnp.int32)
     hi = (packed >> 4).astype(jnp.int32)
-    q = jnp.stack([lo, hi], axis=-1).reshape(n, -1)
-    nb = scale.shape[1]
-    w = ((q - 8).astype(jnp.float32).reshape(n, nb, QK)
-         * scale[..., None])
-    return w.reshape(n, -1).astype(dtype)
+    q = jnp.stack([lo, hi], axis=1).reshape(half * 2, n)
+    g = scale.shape[0]
+    w = ((q - 8).astype(jnp.float32).reshape(g, QK, n)
+         * scale[:, None, :])
+    return w.reshape(half * 2, n).astype(dtype)
 
 
 def rms_norm(x, w, eps: float):
@@ -408,6 +418,57 @@ def forward(params: Dict[str, Any], cfg: LlamaConfig,
 
 
 # ---------------------------------------------------------------------------
+# fused decode loop
+# ---------------------------------------------------------------------------
+
+def _pick_token(logits, key, do_sample: bool, temperature, top_k: int):
+    """logits (B, V) → (B,) int32 next tokens."""
+    if not do_sample:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = logits / jnp.maximum(temperature, 1e-6)
+    if top_k > 0:
+        kth = jnp.sort(scaled, axis=-1)[:, -top_k][:, None]
+        scaled = jnp.where(scaled < kth, -1e30, scaled)
+    return jax.random.categorical(key, scaled).astype(jnp.int32)
+
+
+def decode_scan(params, cache, last_logits, key, temperature,
+                *, cfg, forward_fn, num_tokens: int, do_sample: bool = False,
+                top_k: int = 0, eos_token_id: Optional[int] = None):
+    """``num_tokens`` autoregressive steps as ONE compiled program.
+
+    The reference decodes with a host-side python loop (stock HF
+    ``generate``, SURVEY.md §3.4) — one dispatch per token. On this
+    runtime a device roundtrip costs ~100 ms (BENCH_r02's 110 ms "sync
+    overhead" was exactly this), which would dominate a ~10 ms/token
+    model. Here the whole token loop is a ``lax.scan`` inside one jit
+    with a **donated** kv cache, so decode throughput tracks the HBM
+    weight-stream roofline instead of the dispatch rate.
+
+    Returns (tokens (B, num_tokens), cache, last_logits, key). After an
+    EOS hit a row keeps emitting ``eos_token_id`` (HF padding
+    semantics); compute continues but outputs are frozen.
+    """
+    b = last_logits.shape[0]
+
+    def step(carry, _):
+        cache, last, key, finished = carry
+        key, sub = jax.random.split(key)
+        nxt = _pick_token(last, sub, do_sample, temperature, top_k)
+        if eos_token_id is not None:
+            nxt = jnp.where(finished, eos_token_id, nxt)
+            finished = finished | (nxt == eos_token_id)
+        pos = jnp.full((b, 1), cache["pos"], jnp.int32)
+        logits, cache = forward_fn(params, cfg, nxt[:, None], cache, pos)
+        return (cache, logits[:, -1], key, finished), nxt
+
+    init = (cache, last_logits, key, jnp.zeros((b,), bool))
+    (cache, last, key, _), toks = jax.lax.scan(step, init, None,
+                                               length=num_tokens)
+    return toks.T, cache, last, key
+
+
+# ---------------------------------------------------------------------------
 # generation facade
 # ---------------------------------------------------------------------------
 
@@ -423,6 +484,12 @@ class LlamaForCausalLM:
         self.max_cache_len = min(max_cache_len, cfg.max_position_embeddings)
         self._prefill = jax.jit(functools.partial(forward, cfg=cfg))
         self._decode = jax.jit(functools.partial(forward, cfg=cfg))
+        # one-jit multi-token decode (donated cache, see decode_scan)
+        self._decode_scan = jax.jit(
+            functools.partial(decode_scan, cfg=cfg, forward_fn=forward),
+            static_argnames=("num_tokens", "do_sample", "top_k",
+                             "eos_token_id"),
+            donate_argnames=("cache",))
         self._ring = None          # (mesh, axis) once sequence_parallel()
         self._prefill_ring = None
 
@@ -482,8 +549,13 @@ class LlamaForCausalLM:
     def generate(self, input_ids, max_new_tokens: int = 32,
                  do_sample: bool = False, temperature: float = 1.0,
                  top_k: int = 0, eos_token_id: Optional[int] = None,
-                 seed: int = 0):
-        """Greedy/sampled autoregressive decode. input_ids: (B, T0)."""
+                 seed: int = 0, decode_chunk: int = 32):
+        """Greedy/sampled autoregressive decode. input_ids: (B, T0).
+
+        The token loop runs on-device via :func:`decode_scan` — one
+        compiled program for all ``max_new_tokens`` (or per
+        ``decode_chunk`` when ``eos_token_id`` is set, so the host can
+        stop early once every row finished)."""
         tokens = jnp.asarray(np.asarray(input_ids), jnp.int32)
         b, t0 = tokens.shape
         if t0 + max_new_tokens > self.max_cache_len:
@@ -494,25 +566,21 @@ class LlamaForCausalLM:
         # the fresh-prompt prefill through ring attention when enabled
         logits, cache = self(tokens)
         key = jax.random.PRNGKey(seed)
-        out = [tokens]
         last = logits[:, -1]
-        finished = np.zeros((b,), bool)
-        for _ in range(max_new_tokens):
-            if do_sample:
-                key, sub = jax.random.split(key)
-                scaled = last / max(temperature, 1e-6)
-                if top_k > 0:
-                    kth = jnp.sort(scaled, axis=-1)[:, -top_k][:, None]
-                    scaled = jnp.where(scaled < kth, -1e30, scaled)
-                nxt = jax.random.categorical(sub, scaled)
-            else:
-                nxt = jnp.argmax(last, axis=-1)
-            nxt = nxt.astype(jnp.int32)[:, None]
-            out.append(nxt)
-            if eos_token_id is not None:
-                finished |= np.asarray(nxt[:, 0] == eos_token_id)
-                if finished.all():
-                    break
-            logits, cache = self(nxt, cache)
-            last = logits[:, -1]
-        return np.concatenate([np.asarray(t) for t in out], axis=1)
+        temp = jnp.float32(temperature)
+        pieces = [np.asarray(tokens)]
+        remaining = max_new_tokens
+        chunk = max_new_tokens if eos_token_id is None else decode_chunk
+        while remaining > 0:
+            n = min(chunk, remaining)
+            toks, cache, last, key = self._decode_scan(
+                self.params, cache, last, key, temp, num_tokens=n,
+                do_sample=do_sample, top_k=top_k,
+                eos_token_id=eos_token_id)
+            t_np = np.asarray(toks)
+            pieces.append(t_np)
+            remaining -= n
+            if (eos_token_id is not None
+                    and (t_np == eos_token_id).any(axis=1).all()):
+                break
+        return np.concatenate(pieces, axis=1)

@@ -2,9 +2,13 @@
 
 Reference: P:llm/transformers/low_bit_linear.py (``LowBitLinear(nn.Linear)``
 holding ``FP4Params`` ggml-quantized weights, forwarding through native
-int4 matvec). Here the weight lives as packed uint8 + fp16 scales in the
-module's param tree and forward dispatches to the Pallas kernel on TPU
-(jnp dequant-matmul elsewhere — same math, XLA fuses it)."""
+int4 matvec). Here the weight lives as packed uint8 + scales in the
+module's state tree — stored in the **k-major TPU kernel layout**
+(q (K/2, N), scale (G, N) f32; see llm.kernels.int4_matmul) for
+sym_int4/asym_int4/sym_int8 so forward dispatches straight to the Pallas
+kernels on TPU (jnp dequant-matmul elsewhere — same math, XLA fuses it).
+nf4/fp4/sym_int5/bf16/fp8 keep the row-major ggml layout and always use
+the XLA dequant path."""
 
 from __future__ import annotations
 
@@ -56,10 +60,16 @@ class LowBitLinear(TensorModule):
             mod.add_param("bias", jnp.asarray(bias))
         return mod
 
+    _KERNEL_QTYPES = ("sym_int4", "asym_int4", "sym_int8")
+
     def load_quantized(self, qdict):
+        if qdict.get("qtype", self.qtype) != self.qtype:
+            raise ValueError((qdict.get("qtype"), self.qtype))
+        if self.qtype in self._KERNEL_QTYPES:
+            from bigdl_tpu.llm.kernels import to_tpu_layout
+            qdict = to_tpu_layout(qdict)
         for k, v in qdict.items():
             if k == "qtype":
-                assert v == self.qtype, (v, self.qtype)
                 continue
             # quantized planes are constants, not trainable: store as state
             self.add_state(k, v)
@@ -69,49 +79,69 @@ class LowBitLinear(TensorModule):
         x2 = x.reshape(-1, orig_shape[-1])
         qtype = self.qtype
 
-        if qtype == "sym_int4" and _use_pallas():
-            from bigdl_tpu.llm.kernels import int4_matmul
-            y = int4_matmul(x2, states["q"], states["scale"],
-                            out_dtype=x.dtype)
+        if qtype in self._KERNEL_QTYPES and _use_pallas():
+            from bigdl_tpu.llm.kernels import (
+                asym_int4_matmul, int4_matmul, int8_matmul)
+            if qtype == "sym_int4":
+                y = int4_matmul(x2, states["q"], states["scale"],
+                                out_dtype=x.dtype)
+            elif qtype == "asym_int4":
+                y = asym_int4_matmul(x2, states["q"], states["scale"],
+                                     states["zero"], out_dtype=x.dtype)
+            else:
+                y = int8_matmul(x2, states["q"], states["scale"],
+                                out_dtype=x.dtype)
         else:
-            w = self._dequant(states, x.dtype)
-            y = x2 @ w.T
+            y = (x2 @ self._dequant(states, x.dtype)).astype(x.dtype)
         if self.with_bias:
             y = y + params["bias"]
         return y.reshape(orig_shape[:-1] + (self.output_size,))
 
     def _dequant(self, states, dtype):
-        """jnp dequant (XLA path / non-int4 qtypes)."""
+        """jnp dequant (XLA path) — always returns w (K, N) so forward is
+        ``y = x @ w``. Kernel qtypes are stored k-major; the rest are
+        row-major ggml and transposed here."""
         qtype = self.qtype
         n = self.output_size
         if qtype in ("bf16", "fp8"):
-            return states["q"].astype(dtype)
+            return states["q"].astype(dtype).T
         scale = states["scale"].astype(jnp.float32)
+        if qtype == "sym_int8":                       # k-major (K, N)
+            q = states["q"].astype(jnp.float32)
+            k = q.shape[0]
+            w = (q.reshape(k // QK, QK, n) * scale[:, None, :])
+            return w.reshape(k, n).astype(dtype)
+        if qtype in ("sym_int4", "asym_int4"):        # k-major (K/2, N)
+            packed = states["q"]
+            half = packed.shape[0]
+            lo = (packed & 0xF).astype(jnp.int32)
+            hi = (packed >> 4).astype(jnp.int32)
+            q = jnp.stack([lo, hi], axis=1).reshape(half * 2, n)
+            g = scale.shape[0]
+            if qtype == "sym_int4":
+                w = (q - 8).astype(jnp.float32).reshape(g, QK, n) \
+                    * scale[:, None, :]
+            else:
+                zero = states["zero"].astype(jnp.float32)
+                w = q.astype(jnp.float32).reshape(g, QK, n) \
+                    * scale[:, None, :] + zero[:, None, :]
+            return w.reshape(half * 2, n).astype(dtype)
+        # row-major ggml qtypes
         nb = scale.shape[1]
-        if qtype == "sym_int8":
-            q = states["q"].reshape(n, nb, QK).astype(jnp.float32)
-            return (q * scale[..., None]).reshape(n, -1).astype(dtype)
         if qtype == "sym_int5":
             q = states["q"].reshape(n, nb, QK).astype(jnp.float32) - 16.0
-            return (q * scale[..., None]).reshape(n, -1).astype(dtype)
+            return (q * scale[..., None]).reshape(n, -1).astype(dtype).T
         packed = states["q"]
         lo = (packed & 0xF).astype(jnp.int32)
         hi = (packed >> 4).astype(jnp.int32)
         q = jnp.stack([lo, hi], axis=-1).reshape(n, -1)
-        if qtype == "sym_int4":
-            w = (q - 8).astype(jnp.float32).reshape(n, nb, QK) \
-                * scale[..., None]
-        elif qtype == "asym_int4":
-            zero = states["zero"].astype(jnp.float32)
-            w = q.astype(jnp.float32).reshape(n, nb, QK) * scale[..., None] \
-                + zero[..., None]
-        elif qtype in ("nf4", "fp4"):
+        if qtype in ("nf4", "fp4"):
             from bigdl_tpu.llm.ggml.quantize import FP4_CODE, NF4_CODE
             code = jnp.asarray(NF4_CODE if qtype == "nf4" else FP4_CODE)
             w = code[q].reshape(n, nb, QK) * scale[..., None]
         else:
             raise ValueError(f"unknown qtype {qtype!r}")
-        return w.reshape(n, -1).astype(dtype)
+        return w.reshape(n, -1).astype(dtype).T
 
     def __repr__(self):
         return (f"LowBitLinear({self.input_size} -> {self.output_size}, "

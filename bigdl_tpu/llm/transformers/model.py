@@ -63,7 +63,7 @@ def load_hf_llama_safetensors(path: str, cfg: Optional[LlamaConfig] = None,
     ggml-quantized the moment it is read (quantize-on-load)."""
     import jax.numpy as jnp
 
-    from bigdl_tpu.llm.ggml.quantize import quantize
+    from bigdl_tpu.llm.kernels import quantize_tpu
     from bigdl_tpu.llm.models.llama import _LAYER_LINEARS
 
     if qtype and qtype != "sym_int4":
@@ -102,8 +102,8 @@ def load_hf_llama_safetensors(path: str, cfg: Optional[LlamaConfig] = None,
         if qtype:
             qs, ss = [], []
             for l in range(L):
-                qd = quantize(np.asarray(get(fmt.format(l)), np.float32),
-                              qtype)
+                qd = quantize_tpu(
+                    np.asarray(get(fmt.format(l)), np.float32), qtype)
                 qs.append(qd["q"])
                 ss.append(qd["scale"])
             layers[name] = {"q": jnp.asarray(np.stack(qs)),

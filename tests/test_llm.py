@@ -10,7 +10,8 @@ import pytest
 import bigdl_tpu.nn as nn
 from bigdl_tpu.llm.ggml.quantize import QK, dequantize, quantize
 from bigdl_tpu.llm.kernels import (
-    int4_matmul, int4_matmul_reference, int8_matmul)
+    asym_int4_matmul, int4_matmul, int4_matmul_reference, int8_matmul,
+    to_tpu_layout)
 from bigdl_tpu.llm.models.llama import (
     LlamaConfig, LlamaForCausalLM, forward, init_cache, init_params,
     param_pspecs, quantize_params)
@@ -56,9 +57,39 @@ class TestKernels:
         w = rs.randn(n, k).astype(np.float32) * 0.1
         qd = quantize(w, "sym_int4")
         ref = int4_matmul_reference(x, qd["q"], qd["scale"])
+        td = to_tpu_layout(qd)
         out = np.asarray(int4_matmul(
-            jnp.asarray(x), jnp.asarray(qd["q"]), jnp.asarray(qd["scale"]),
-            bm=8, bn=16, bk=32, interpret=True), np.float32)
+            jnp.asarray(x), jnp.asarray(td["q"]), jnp.asarray(td["scale"]),
+            interpret=True, out_dtype=jnp.float32), np.float32)
+        scale = max(np.abs(ref).max(), 1e-6)
+        assert np.abs(out - ref).max() / scale < 0.02
+
+    @pytest.mark.parametrize("mode", ["corr", "sub8"])
+    def test_int4_modes_agree(self, mode):
+        """Both zero-point strategies must produce the same numbers."""
+        rs = np.random.RandomState(5)
+        x = rs.randn(3, 128).astype(np.float32)
+        w = rs.randn(32, 128).astype(np.float32) * 0.1
+        td = to_tpu_layout(quantize(w, "sym_int4"))
+        ref = int4_matmul_reference(x, quantize(w, "sym_int4")["q"],
+                                    quantize(w, "sym_int4")["scale"])
+        out = np.asarray(int4_matmul(
+            jnp.asarray(x), jnp.asarray(td["q"]), jnp.asarray(td["scale"]),
+            interpret=True, out_dtype=jnp.float32, mode=mode), np.float32)
+        scale = max(np.abs(ref).max(), 1e-6)
+        assert np.abs(out - ref).max() / scale < 0.02
+
+    def test_asym_int4_parity(self):
+        rs = np.random.RandomState(3)
+        x = rs.randn(4, 96).astype(np.float32)
+        w = rs.randn(24, 96).astype(np.float32) * 0.1 + 0.05
+        qd = quantize(w, "asym_int4")
+        ref = x @ dequantize(qd).T
+        td = to_tpu_layout(qd)
+        out = np.asarray(asym_int4_matmul(
+            jnp.asarray(x), jnp.asarray(td["q"]), jnp.asarray(td["scale"]),
+            jnp.asarray(td["zero"]), interpret=True,
+            out_dtype=jnp.float32), np.float32)
         scale = max(np.abs(ref).max(), 1e-6)
         assert np.abs(out - ref).max() / scale < 0.02
 
@@ -68,9 +99,10 @@ class TestKernels:
         w = rs.randn(40, 96).astype(np.float32) * 0.1
         qd = quantize(w, "sym_int8")
         ref = x @ dequantize(qd).T
+        td = to_tpu_layout(qd)
         out = np.asarray(int8_matmul(
-            jnp.asarray(x), jnp.asarray(qd["q"]), jnp.asarray(qd["scale"]),
-            bm=8, bn=16, bk=32, interpret=True), np.float32)
+            jnp.asarray(x), jnp.asarray(td["q"]), jnp.asarray(td["scale"]),
+            interpret=True, out_dtype=jnp.float32), np.float32)
         scale = max(np.abs(ref).max(), 1e-6)
         assert np.abs(out - ref).max() / scale < 0.02
 

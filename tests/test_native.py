@@ -82,16 +82,17 @@ class TestNativeQuant:
         import jax.numpy as jnp
 
         from bigdl_tpu.llm.ggml.quantize import quantize
-        from bigdl_tpu.llm.kernels import int4_matmul
+        from bigdl_tpu.llm.kernels import int4_matmul, to_tpu_layout
 
         rs = np.random.RandomState(4)
         x = rs.randn(4, 64).astype(np.float32)
         w = rs.randn(16, 64).astype(np.float32) * 0.3
         qd = quantize(w, "sym_int4")
+        td = to_tpu_layout(qd)
         out = np.asarray(int4_matmul(
-            jnp.asarray(x), jnp.asarray(np.asarray(qd["q"])),
-            jnp.asarray(np.asarray(qd["scale"])), bm=8, bn=16, bk=32,
-            interpret=True), np.float32)
+            jnp.asarray(x), jnp.asarray(np.asarray(td["q"])),
+            jnp.asarray(np.asarray(td["scale"])),
+            interpret=True, out_dtype=jnp.float32), np.float32)
         from bigdl_tpu.llm.ggml.quantize import dequantize
         ref = x @ dequantize(qd).T
         assert np.abs(out - ref).max() / np.abs(ref).max() < 0.02
