@@ -62,7 +62,9 @@ from jax.experimental.pallas import tpu as pltpu
 from bigdl_tpu.llm.ggml.quantize import QK
 
 HALF = QK // 2          # scale-group size within one nibble plane
-_MAX_BK = 16384         # K above this is chunked to bound VMEM
+_MAX_BK = 8192          # K above this is chunked to bound VMEM
+                        # (K=11008 at bm=128 overflowed the 16M scoped
+                        # vmem limit on chip with full-K blocks)
 
 
 def _scale_expand(scale_ref, half: int, cdt):

@@ -13,47 +13,52 @@ import bigdl_tpu.nn as nn
 
 
 def conv_bn(n_in: int, n_out: int, k: int, stride: int = 1,
-            pad: int = -1, relu: bool = True) -> nn.Sequential:
+            pad: int = -1, relu: bool = True,
+            format: str = "NCHW") -> nn.Sequential:
     seq = (nn.Sequential()
            .add(nn.SpatialConvolution(n_in, n_out, k, k, stride, stride,
-                                      pad, pad, with_bias=False))
-           .add(nn.SpatialBatchNormalization(n_out)))
+                                      pad, pad, with_bias=False,
+                                      format=format))
+           .add(nn.SpatialBatchNormalization(n_out, format=format)))
     if relu:
         seq.add(nn.ReLU())
     return seq
 
 
-def _shortcut(n_in: int, n_out: int, stride: int) -> nn.Module:
+def _shortcut(n_in: int, n_out: int, stride: int,
+              format: str = "NCHW") -> nn.Module:
     if n_in != n_out or stride != 1:
         # type-B projection shortcut (1x1 conv + BN), the reference default
         return (nn.Sequential()
                 .add(nn.SpatialConvolution(n_in, n_out, 1, 1, stride, stride,
-                                           0, 0, with_bias=False))
-                .add(nn.SpatialBatchNormalization(n_out)))
+                                           0, 0, with_bias=False,
+                                           format=format))
+                .add(nn.SpatialBatchNormalization(n_out, format=format)))
     return nn.Identity()
 
 
-def basic_block(n_in: int, n_out: int, stride: int = 1) -> nn.Sequential:
+def basic_block(n_in: int, n_out: int, stride: int = 1,
+                format: str = "NCHW") -> nn.Sequential:
     path = (nn.Sequential()
-            .add(conv_bn(n_in, n_out, 3, stride))
-            .add(conv_bn(n_out, n_out, 3, 1, relu=False)))
+            .add(conv_bn(n_in, n_out, 3, stride, format=format))
+            .add(conv_bn(n_out, n_out, 3, 1, relu=False, format=format)))
     return (nn.Sequential()
             .add(nn.ConcatTable().add(path).add(_shortcut(n_in, n_out,
-                                                          stride)))
+                                                          stride, format)))
             .add(nn.CAddTable())
             .add(nn.ReLU()))
 
 
 def bottleneck(n_in: int, n_mid: int, stride: int = 1,
-               expansion: int = 4) -> nn.Sequential:
+               expansion: int = 4, format: str = "NCHW") -> nn.Sequential:
     n_out = n_mid * expansion
     path = (nn.Sequential()
-            .add(conv_bn(n_in, n_mid, 1, 1, 0))
-            .add(conv_bn(n_mid, n_mid, 3, stride))
-            .add(conv_bn(n_mid, n_out, 1, 1, 0, relu=False)))
+            .add(conv_bn(n_in, n_mid, 1, 1, 0, format=format))
+            .add(conv_bn(n_mid, n_mid, 3, stride, format=format))
+            .add(conv_bn(n_mid, n_out, 1, 1, 0, relu=False, format=format)))
     return (nn.Sequential()
             .add(nn.ConcatTable().add(path).add(_shortcut(n_in, n_out,
-                                                          stride)))
+                                                          stride, format)))
             .add(nn.CAddTable())
             .add(nn.ReLU()))
 
@@ -85,33 +90,44 @@ _IMAGENET_CFG = {
 }
 
 
-def resnet_imagenet(depth: int = 50, class_num: int = 1000) -> nn.Sequential:
+def resnet_imagenet(depth: int = 50, class_num: int = 1000,
+                    format: str = "NCHW",
+                    remat: bool = False) -> nn.Sequential:
     """ImageNet ResNet (ref: ResNet.apply with dataSet=ImageNet). 224x224
-    NCHW input; depth 50 is the BASELINE north-star training model."""
+    input; depth 50 is the BASELINE north-star training model.
+
+    ``format="NHWC"`` builds the channels-last variant (channels on the
+    TPU's 128-lane minor dim — the layout the bench uses);
+    ``remat=True`` wraps each residual block in nn.Checkpoint so block
+    interiors are recomputed in backward instead of saved. On this model
+    it measured net-negative for throughput (the recompute costs more
+    than the saved bytes), so it stays opt-in — its value here is
+    fitting larger batches/models in HBM."""
     if depth not in _IMAGENET_CFG:
         raise ValueError(f"unsupported depth {depth}")
     block, stages = _IMAGENET_CFG[depth]
     expansion = 4 if block is bottleneck else 1
+    wrap = (lambda m: nn.Checkpoint(m)) if remat else (lambda m: m)
     model = (nn.Sequential()
-             .add(conv_bn(3, 64, 7, 2))
-             .add(nn.SpatialMaxPooling(3, 3, 2, 2, -1, -1)))
+             .add(conv_bn(3, 64, 7, 2, format=format))
+             .add(nn.SpatialMaxPooling(3, 3, 2, 2, -1, -1, format=format)))
     n_in = 64
     width = 64
     for stage_idx, n_blocks in enumerate(stages):
         stride = 1 if stage_idx == 0 else 2
         if block is bottleneck:
-            model.add(block(n_in, width, stride))
+            model.add(wrap(block(n_in, width, stride, format=format)))
             n_in = width * expansion
             for _ in range(n_blocks - 1):
-                model.add(block(n_in, width, 1))
+                model.add(wrap(block(n_in, width, 1, format=format)))
         else:
-            model.add(block(n_in, width, stride))
+            model.add(wrap(block(n_in, width, stride, format=format)))
             n_in = width
             for _ in range(n_blocks - 1):
-                model.add(block(n_in, width, 1))
+                model.add(wrap(block(n_in, width, 1, format=format)))
         width *= 2
     return (model
-            .add(nn.GlobalAveragePooling2D())
+            .add(nn.GlobalAveragePooling2D(format=format))
             .add(nn.Linear(n_in, class_num))
             .add(nn.LogSoftMax()))
 

@@ -6,7 +6,7 @@ from bigdl_tpu.nn.containers import (
     Bottle, CAddTable, CAveTable, CDivTable, CMaxTable, CMinTable, CMulTable,
     CSubTable, Concat, ConcatTable, Container, CosineDistance, DotProduct,
     Echo, FlattenTable, JoinTable, MM, MV, MapTable, ParallelTable,
-    SelectTable, Sequential, SplitTable)
+    SelectTable, Sequential, Checkpoint, SplitTable)
 from bigdl_tpu.nn.layers.linear import (
     Add, Bilinear, CAdd, CMul, Cosine, Linear, Mul)
 from bigdl_tpu.nn.layers.conv import (

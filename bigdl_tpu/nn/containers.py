@@ -63,6 +63,32 @@ class Sequential(Container):
                                         training=training, rng=rng)
 
 
+class Checkpoint(Container):
+    """Rematerialization wrapper (no reference equivalent — a TPU-era
+    memory/bandwidth tool): the wrapped module's intermediate activations
+    are not saved for backward; they are recomputed from the block input
+    during the backward pass via ``jax.checkpoint``. This trades FLOPs
+    for activation memory/bytes: the standard way to fit larger
+    models/batches. Whether it also wins throughput is model-dependent —
+    on the HBM-bound ResNet-50 bf16 step it measured net-negative, so
+    benchmarks keep it opt-in."""
+
+    def __init__(self, module: Optional[Module] = None,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        if module is not None:
+            self.add(module)
+
+    def _apply(self, params, states, x, *, training, rng):
+        import jax
+
+        def inner(p, xx):
+            return self._children_apply_seq(p, states, xx,
+                                            training=training, rng=rng)
+
+        return jax.checkpoint(inner)(params, x)
+
+
 class Concat(Container):
     """Apply each child to the same input, concat outputs along dim
     (1-based; ref: nn/Concat.scala)."""

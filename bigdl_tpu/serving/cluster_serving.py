@@ -12,8 +12,6 @@ Queue backends:
 
 from __future__ import annotations
 
-import base64
-import pickle
 import queue
 import threading
 import time
@@ -21,6 +19,9 @@ import uuid
 from typing import Dict, Optional
 
 import numpy as np
+
+from bigdl_tpu.ppml.protocol import dumps as wire_dumps
+from bigdl_tpu.ppml.protocol import loads as wire_loads
 
 from bigdl_tpu.serving.inference_model import InferenceModel
 
@@ -83,7 +84,7 @@ class InputQueue:
     def enqueue(self, uri: Optional[str] = None, **data) -> str:
         uri = uri or str(uuid.uuid4())
         arrays = {k: np.asarray(v) for k, v in data.items()}
-        payload = pickle.dumps({"uri": uri, "data": arrays})
+        payload = wire_dumps({"uri": uri, "data": arrays})
         self._b.push(self.name, payload)
         return uri
 
@@ -106,7 +107,7 @@ class OutputQueue:
             payload = self._b.pop(self.name, timeout=0.1)
             if payload is None:
                 continue
-            rec = pickle.loads(payload)
+            rec = wire_loads(payload)
             self._cache[rec["uri"]] = rec["result"]
         raise TimeoutError(f"no result for {uri}")
 
@@ -114,7 +115,7 @@ class OutputQueue:
         payload = self._b.pop(self.name, timeout=timeout)
         if payload is None:
             return None
-        rec = pickle.loads(payload)
+        rec = wire_loads(payload)
         return rec["uri"], rec["result"]
 
 
@@ -146,7 +147,7 @@ class ClusterServing:
                                   timeout=max(remaining, 0.005))
             if payload is None:
                 break
-            recs.append(pickle.loads(payload))
+            recs.append(wire_loads(payload))
             if time.time() > deadline:
                 break
         return recs
@@ -161,7 +162,7 @@ class ClusterServing:
         off = 0
         for r in recs:
             n = r["data"][key].shape[0]
-            payload = pickle.dumps({"uri": r["uri"],
+            payload = wire_dumps({"uri": r["uri"],
                                     "result": y[off:off + n]})
             self._b.push(self.stream + ":out", payload)
             off += n

@@ -362,3 +362,64 @@ class TestReviewRegressions:
         s = t.squeeze(1)  # size != 1 → no-op copy
         s.fill(0)
         assert float(t.data.sum()) == 6.0
+
+
+class TestCheckpointContainer:
+    def test_remat_matches_plain(self):
+        """nn.Checkpoint must be numerically transparent (same forward,
+        same gradients) — it only changes what is saved for backward."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        import bigdl_tpu.nn as nn
+        from bigdl_tpu.nn.module import set_seed
+
+        def build(wrap):
+            set_seed(0)
+            inner = (nn.Sequential()
+                     .add(nn.Linear(8, 16)).add(nn.ReLU())
+                     .add(nn.Linear(16, 4)))
+            return nn.Sequential().add(
+                nn.Checkpoint(inner) if wrap else inner)
+
+        plain, remat = build(False), build(True)
+        x = jnp.asarray(np.random.RandomState(0).randn(3, 8), jnp.float32)
+
+        def loss(model, params, x):
+            y, _ = model.apply(params, model.states_dict(), x,
+                               training=True, rng=jax.random.PRNGKey(0))
+            return jnp.sum(y * y)
+
+        p_plain = plain.parameters_dict()
+        p_remat = remat.parameters_dict()
+        l1, g1 = jax.value_and_grad(
+            lambda p: loss(plain, p, x))(p_plain)
+        l2, g2 = jax.value_and_grad(
+            lambda p: loss(remat, p, x))(p_remat)
+        assert abs(float(l1) - float(l2)) < 1e-5
+        f1 = jax.tree_util.tree_leaves(g1)
+        f2 = jax.tree_util.tree_leaves(g2)
+        for a, b in zip(f1, f2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_batchnorm_single_pass_stats(self):
+        """New m2-mean BN form must match the two-pass definition."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        import bigdl_tpu.nn as nn
+
+        bn = nn.SpatialBatchNormalization(8, format="NHWC")
+        x = np.random.RandomState(1).randn(4, 5, 5, 8).astype(np.float32)
+        params = bn.parameters_dict()
+        states = bn.states_dict()
+        y, new_states = bn.apply(params, states, jnp.asarray(x),
+                                 training=True, rng=None)
+        mean = x.mean(axis=(0, 1, 2))
+        var = x.var(axis=(0, 1, 2))
+        ref = (x - mean) / np.sqrt(var + bn.eps)
+        np.testing.assert_allclose(np.asarray(y), ref, rtol=1e-4,
+                                   atol=1e-4)
+        np.testing.assert_allclose(np.asarray(new_states["running_mean"]),
+                                   0.1 * mean, rtol=1e-4, atol=1e-5)
