@@ -10,6 +10,10 @@ Backends here:
   training loop driven shard-by-shard on host CPU, mirroring
   TorchRunner's creator-function API. torch has no TPU backend in this
   image, so this is capability parity; the perf path is from_bigdl.
+- ``Estimator.from_keras`` (backend="tf2") — the reference's Orca TF2
+  estimator (P:orca/learn/tf2): a creator-function-built tf.keras model
+  trained with an explicit tf.GradientTape loop driven shard-by-shard
+  (the role TF2Estimator's per-worker strategy loop plays upstream).
 """
 
 from __future__ import annotations
@@ -22,6 +26,8 @@ from bigdl_tpu.orca.data import XShards
 
 
 def _xy_from_data(data, label_cols=None, feature_cols=None):
+    if isinstance(data, dict) and "x" in data and "y" in data:
+        return data["x"], data["y"]
     if isinstance(data, XShards):
         merged = data.merged()
         if isinstance(merged, dict):
@@ -174,6 +180,90 @@ class TorchEstimator:
         return self.model
 
 
+class TF2Estimator:
+    """ref: P:orca/learn/tf2/estimator.py — creator-function API over a
+    host tf.keras model; the train loop is an explicit GradientTape step
+    per batch (the hosted analog of TF2Estimator's per-worker
+    MultiWorkerMirroredStrategy loop), driven shard-by-shard."""
+
+    def __init__(self, model_creator: Callable,
+                 config: Optional[dict] = None,
+                 compile_args_creator: Optional[Callable] = None):
+        import tensorflow as tf
+
+        self._tf = tf
+        self.config = config or {}
+        self.model = model_creator(self.config)
+        if compile_args_creator is not None:
+            self.model.compile(**compile_args_creator(self.config))
+        if self.model.optimizer is None:
+            raise ValueError("model_creator must compile the model or a "
+                             "compile_args_creator must be given")
+
+    def fit(self, data, epochs: int = 1, batch_size: int = 32):
+        tf = self._tf
+        model = self.model
+        loss_fn = model.loss
+        if isinstance(loss_fn, str):
+            loss_fn = tf.keras.losses.get(loss_fn)
+        opt = model.optimizer
+        stats = []
+
+        @tf.function
+        def train_step(xb, yb):
+            with tf.GradientTape() as tape:
+                out = model(xb, training=True)
+                loss = loss_fn(yb, out)
+            grads = tape.gradient(loss, model.trainable_variables)
+            opt.apply_gradients(zip(grads, model.trainable_variables))
+            return loss
+
+        for _ in range(epochs):
+            shards = data.collect() if isinstance(data, XShards) else [data]
+            for shard in shards:
+                if isinstance(shard, dict):
+                    x, y = shard["x"], shard["y"]
+                else:
+                    x, y = shard
+                x, y = np.asarray(x), np.asarray(y)
+                for i in range(0, len(x), batch_size):
+                    loss = train_step(x[i:i + batch_size],
+                                      y[i:i + batch_size])
+                stats.append(float(loss))
+        return stats
+
+    def predict(self, data, batch_size: int = 128) -> np.ndarray:
+        if isinstance(data, XShards):
+            merged = data.merged()
+            x = merged["x"] if isinstance(merged, dict) else merged
+        else:
+            x = data
+        return np.asarray(self.model.predict(np.asarray(x),
+                                             batch_size=batch_size,
+                                             verbose=0))
+
+    def evaluate(self, data, batch_size: int = 128) -> dict:
+        x, y = _xy_from_data(data)
+        pred = self.predict(x, batch_size)
+        if pred.ndim > 1 and pred.shape[-1] > 1:
+            acc = float((pred.argmax(-1)
+                         == np.asarray(y).squeeze()).mean())
+            return {"Accuracy": acc}
+        diff = pred.squeeze() - np.asarray(y).squeeze()
+        return {"MSE": float(np.mean(diff ** 2))}
+
+    def get_model(self):
+        return self.model
+
+    def save(self, path: str):
+        self.model.save_weights(path)
+        return self
+
+    def load(self, path: str):
+        self.model.load_weights(path)
+        return self
+
+
 class Estimator:
     """Facade (ref: each backend module exposes Estimator.from_*)."""
 
@@ -192,7 +282,21 @@ class Estimator:
                               loss_creator, config)
 
     @staticmethod
-    def from_keras(**kwargs):
-        raise NotImplementedError(
-            "TF/Keras foreign-framework hosting is out of scope on TPU "
-            "(no TF in image); use bigdl_tpu.keras models via from_bigdl")
+    def from_keras(*, model_creator=None, config=None,
+                   compile_args_creator=None, backend: str = "tf2",
+                   model=None, loss=None, optimizer=None, metrics=None,
+                   **_ignored):
+        """backend="tf2" hosts a foreign tf.keras model (creator-fn API,
+        ref P:orca/learn/tf2); backend="bigdl" trains one of OUR keras-API
+        models through DistriOptimizer."""
+        if backend == "bigdl" or model is not None:
+            return BigDLEstimator(model, loss, optimizer, metrics)
+        if backend != "tf2":
+            raise ValueError(
+                f"unknown from_keras backend {backend!r}: this build "
+                "hosts 'tf2' (single-process tf.GradientTape loop) and "
+                "'bigdl'; the reference's spark/ray/horovod substrates "
+                "are absent from this environment")
+        if model_creator is None:
+            raise ValueError("tf2 backend needs model_creator")
+        return TF2Estimator(model_creator, config, compile_args_creator)

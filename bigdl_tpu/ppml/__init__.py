@@ -3,9 +3,12 @@ gRPC FL server/client with HFL/VFL linear models, FGBoost federated GBDT,
 PSI, SGX enclaves).
 
 Scope here: the federated-learning core — FLServer/FLClient (length-
-prefixed pickle over TCP standing in for the reference's gRPC), FedAvg
-aggregation, PSI (salted-hash intersection; the reference uses ECDH-PSI —
-documented gap), and an FLEstimator that federates any of our nn models.
+prefixed JSON+blob wire over TCP standing in for the reference's gRPC;
+no code execution on decode), FedAvg aggregation, PSI (salted-hash
+intersection; the reference uses ECDH-PSI — documented gap), an
+FLEstimator that federates any of our nn models, FGBoost federated GBDT
+(histogram aggregation; FGBoostRegression/FGBoostClassification), and
+VFL linear/logistic regression (partial-logit aggregation).
 SGX/Gramine enclave packaging and KMS/attestation are hardware/deploy
 tooling with no TPU-environment analog — documented as out of scope.
 """
@@ -13,5 +16,9 @@ tooling with no TPU-environment analog — documented as out of scope.
 from bigdl_tpu.ppml.fl_server import FLServer
 from bigdl_tpu.ppml.fl_client import FLClient
 from bigdl_tpu.ppml.estimator import FLEstimator
+from bigdl_tpu.ppml.fgboost import FGBoostClassification, FGBoostRegression
+from bigdl_tpu.ppml.vfl import VFLLinearRegression, VFLLogisticRegression
 
-__all__ = ["FLServer", "FLClient", "FLEstimator"]
+__all__ = ["FLServer", "FLClient", "FLEstimator", "FGBoostRegression",
+           "FGBoostClassification", "VFLLinearRegression",
+           "VFLLogisticRegression"]

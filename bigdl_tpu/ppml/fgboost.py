@@ -1,0 +1,211 @@
+"""FGBoost — federated gradient-boosted decision trees.
+
+Reference: ``scala/ppml`` FGBoostServiceImpl / FGBoostRegression — the
+headline PPML capability beyond FedAvg (SURVEY.md §2.8 PPML row): several
+parties hold horizontal shards of the same feature space and jointly grow
+one XGBoost-style ensemble, exchanging only **aggregated gradient/hessian
+histograms** — never raw rows.
+
+Mapping onto this rebuild's FLServer substrate (fl_server.py):
+- histogram aggregation = the generic keyed barrier-reduce (``agg`` /
+  op=sum), the role the reference's gRPC FGBoostService aggregator plays;
+- global feature ranges for binning = one ``agg`` min/max round;
+- every client computes the SAME split decisions from the identical
+  aggregated histograms, so all parties end each round holding the same
+  tree — there is no central model to download (matches the reference,
+  where the server is a pure aggregator for the histogram protocol).
+
+Trees are grown breadth-first to ``max_depth`` with second-order gains
+(g = dL/dpred, h = d2L/dpred2; squared loss for regression, logloss for
+binary classification), leaf value -G/(H+lambda) — the standard XGBoost
+update the reference implements natively.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from bigdl_tpu.ppml.fl_client import FLClient
+
+
+@dataclasses.dataclass
+class _Node:
+    feature: int = -1           # -1 = leaf
+    threshold: float = 0.0      # split on x[feature] <= threshold
+    value: float = 0.0          # leaf output
+    left: int = -1              # child indices into the tree's node list
+    right: int = -1
+
+
+class _Tree:
+    def __init__(self):
+        self.nodes: List[_Node] = []
+
+    def predict(self, X: np.ndarray) -> np.ndarray:
+        out = np.empty(len(X), np.float64)
+        for i, row in enumerate(X):
+            n = 0
+            while self.nodes[n].feature >= 0:
+                nd = self.nodes[n]
+                n = nd.left if row[nd.feature] <= nd.threshold else nd.right
+            out[i] = self.nodes[n].value
+        return out
+
+
+class FGBoostRegression:
+    """Federated GBDT regression (ref API: FGBoostRegression.fit/predict).
+
+    Every participating party constructs one of these over its own
+    ``FLClient`` and calls ``fit`` with its local shard; the calls
+    synchronize through the server's histogram aggregation and return
+    with identical ensembles.
+    """
+
+    _loss = "squared"
+
+    def __init__(self, client: FLClient, n_estimators: int = 10,
+                 max_depth: int = 4, learning_rate: float = 0.3,
+                 n_bins: int = 32, reg_lambda: float = 1.0,
+                 min_gain: float = 1e-6, model_id: str = "fgboost"):
+        self.client = client
+        self.n_estimators = n_estimators
+        self.max_depth = max_depth
+        self.learning_rate = learning_rate
+        self.n_bins = n_bins
+        self.reg_lambda = reg_lambda
+        self.min_gain = min_gain
+        self.model_id = model_id
+        self.trees: List[_Tree] = []
+        self.base_score = 0.0
+        self._bin_edges: Optional[np.ndarray] = None
+
+    # -- gradients -----------------------------------------------------------
+    def _grad_hess(self, y, pred):
+        return pred - y, np.ones_like(y)
+
+    def _init_base(self, y) -> float:
+        # global mean via one sum-reduce of [sum_y, count]
+        tot, cnt = self.client.agg(
+            f"{self.model_id}:base",
+            [np.array([y.sum()]), np.array([float(len(y))])], op="sum")
+        return float(tot[0] / max(cnt[0], 1.0))
+
+    # -- binning -------------------------------------------------------------
+    def _global_bins(self, X: np.ndarray) -> np.ndarray:
+        lo = self.client.agg(f"{self.model_id}:lo", [X.min(axis=0)],
+                             op="min")[0]
+        hi = self.client.agg(f"{self.model_id}:hi", [X.max(axis=0)],
+                             op="max")[0]
+        span = np.where(hi > lo, hi - lo, 1.0)
+        # edges[f, b] = lo + (b+1)/B * span — bin b is x <= edges[f, b]
+        steps = (np.arange(1, self.n_bins) / self.n_bins)
+        return lo[:, None] + span[:, None] * steps[None, :]
+
+    def _binize(self, X: np.ndarray) -> np.ndarray:
+        F = X.shape[1]
+        out = np.empty(X.shape, np.int32)
+        for f in range(F):
+            out[:, f] = np.searchsorted(self._bin_edges[f], X[:, f],
+                                        side="left")
+        return out
+
+    # -- tree growth ---------------------------------------------------------
+    def _grow_tree(self, t_idx: int, Xb, X, g, h) -> _Tree:
+        tree = _Tree()
+        F, B = X.shape[1], self.n_bins
+        # frontier: (node_index, row_mask, depth)
+        tree.nodes.append(_Node())
+        frontier = [(0, np.ones(len(X), bool), 0)]
+        while frontier:
+            nxt = []
+            for node_i, mask, depth in frontier:
+                key = f"{self.model_id}:t{t_idx}:n{node_i}"
+                hist_g = np.zeros((F, B))
+                hist_h = np.zeros((F, B))
+                rows = np.nonzero(mask)[0]
+                for f in range(F):
+                    np.add.at(hist_g[f], Xb[rows, f], g[rows])
+                    np.add.at(hist_h[f], Xb[rows, f], h[rows])
+                hist_g, hist_h = self.client.agg(key, [hist_g, hist_h],
+                                                 op="sum")
+                G, H = hist_g.sum(axis=1)[0], hist_h.sum(axis=1)[0]
+                lam = self.reg_lambda
+                node = tree.nodes[node_i]
+                if depth >= self.max_depth or H <= 1.0:
+                    node.value = float(-G / (H + lam)) * self.learning_rate
+                    continue
+                GL = np.cumsum(hist_g, axis=1)[:, :-1]
+                HL = np.cumsum(hist_h, axis=1)[:, :-1]
+                GR, HR = G - GL, H - HL
+                gain = (GL ** 2 / (HL + lam) + GR ** 2 / (HR + lam)
+                        - G ** 2 / (H + lam))
+                gain[HL < 1.0] = -np.inf
+                gain[HR < 1.0] = -np.inf
+                best = np.unravel_index(np.argmax(gain), gain.shape)
+                if not np.isfinite(gain[best]) \
+                        or gain[best] <= self.min_gain:
+                    node.value = float(-G / (H + lam)) * self.learning_rate
+                    continue
+                f_best, b_best = int(best[0]), int(best[1])
+                node.feature = f_best
+                node.threshold = float(self._bin_edges[f_best, b_best])
+                node.left = len(tree.nodes)
+                tree.nodes.append(_Node())
+                node.right = len(tree.nodes)
+                tree.nodes.append(_Node())
+                go_left = mask & (Xb[:, f_best] <= b_best)
+                nxt.append((node.left, go_left, depth + 1))
+                nxt.append((node.right, mask & ~go_left, depth + 1))
+            frontier = nxt
+        return tree
+
+    # -- public API ----------------------------------------------------------
+    def fit(self, X, y) -> "FGBoostRegression":
+        X = np.asarray(X, np.float64)
+        y = np.asarray(y, np.float64).ravel()
+        self.base_score = self._init_base(y)
+        self._bin_edges = self._global_bins(X)
+        Xb = self._binize(X)
+        pred = np.full(len(y), self.base_score)
+        for t in range(self.n_estimators):
+            g, h = self._grad_hess(y, pred)
+            tree = self._grow_tree(t, Xb, X, g, h)
+            self.trees.append(tree)
+            pred += tree.predict(X)
+        return self
+
+    def _raw_predict(self, X) -> np.ndarray:
+        X = np.asarray(X, np.float64)
+        out = np.full(len(X), self.base_score)
+        for tree in self.trees:
+            out += tree.predict(X)
+        return out
+
+    def predict(self, X) -> np.ndarray:
+        return self._raw_predict(X)
+
+
+class FGBoostClassification(FGBoostRegression):
+    """Binary federated GBDT classifier (logloss; ref FGBoostClassification)."""
+
+    _loss = "logloss"
+
+    def _grad_hess(self, y, pred):
+        p = 1.0 / (1.0 + np.exp(-pred))
+        return p - y, np.maximum(p * (1.0 - p), 1e-12)
+
+    def _init_base(self, y) -> float:
+        tot, cnt = self.client.agg(
+            f"{self.model_id}:base",
+            [np.array([y.sum()]), np.array([float(len(y))])], op="sum")
+        p = float(np.clip(tot[0] / max(cnt[0], 1.0), 1e-6, 1 - 1e-6))
+        return float(np.log(p / (1 - p)))
+
+    def predict_proba(self, X) -> np.ndarray:
+        return 1.0 / (1.0 + np.exp(-self._raw_predict(X)))
+
+    def predict(self, X) -> np.ndarray:
+        return (self.predict_proba(X) >= 0.5).astype(np.int64)

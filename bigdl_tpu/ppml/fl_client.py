@@ -58,5 +58,34 @@ class FLClient:
         return sorted(self._hash_to_id[h] for h in resp["intersection"]
                       if h in self._hash_to_id)
 
+    # -- keyed barrier-reduce + kv (FGBoost / VFL substrate) -----------------
+    def agg(self, key: str, payload: Sequence[np.ndarray], op: str = "sum",
+            n_parties: Optional[int] = None,
+            timeout: float = 120.0) -> List[np.ndarray]:
+        """Submit arrays under ``key``; block until every party has
+        submitted; return the elementwise ``op``-reduction."""
+        msg = {"type": "agg", "key": key, "op": op, "timeout": timeout,
+               "payload": [np.asarray(p) for p in payload]}
+        if n_parties is not None:
+            msg["n_parties"] = n_parties
+        resp = self._call(msg)
+        if resp["status"] != "ok":
+            raise TimeoutError(f"agg {key!r}: {resp}")
+        return resp["payload"]
+
+    def put(self, key: str, payload, expect: Optional[int] = None):
+        msg = {"type": "put", "key": key, "payload": payload}
+        if expect is not None:
+            msg["expect"] = expect
+        resp = self._call(msg)
+        if resp["status"] != "ok":
+            raise RuntimeError(f"put {key!r}: {resp}")
+
+    def get(self, key: str, timeout: float = 120.0):
+        resp = self._call({"type": "get", "key": key, "timeout": timeout})
+        if resp["status"] != "ok":
+            raise TimeoutError(f"get {key!r}: {resp}")
+        return resp["payload"]
+
     def close(self):
         self._sock.close()

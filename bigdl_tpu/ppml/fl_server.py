@@ -40,6 +40,7 @@ class FLServer:
         self._agg_results: Dict[str, list] = {}
         self._agg_delivered: Dict[str, int] = {}
         self._kv: Dict[str, object] = {}
+        self._kv_expect: Dict[str, int] = {}
 
     # -- lifecycle -----------------------------------------------------------
     def build(self):  # ref API name
@@ -195,9 +196,15 @@ class FLServer:
             return {"status": "ok", "payload": result}
 
     def _on_put(self, msg) -> dict:
-        """Blocking kv broadcast: one party puts, any party gets."""
+        """Blocking kv broadcast: one party puts, any party gets. With
+        ``expect`` = N the entry is garbage-collected after N gets (the
+        VFL dz broadcast sets it to client_num - 1)."""
         with self._cond:
-            self._kv[str(msg["key"])] = msg["payload"]
+            key = str(msg["key"])
+            self._kv[key] = msg["payload"]
+            expect = msg.get("expect")
+            if expect is not None:
+                self._kv_expect[key] = int(expect)
             self._cond.notify_all()
             return {"status": "ok"}
 
@@ -209,7 +216,13 @@ class FLServer:
                 timeout=msg.get("timeout", 120.0))
             if not ok or key not in self._kv:
                 return {"status": "timeout"}
-            return {"status": "ok", "payload": self._kv[key]}
+            payload = self._kv[key]
+            if key in self._kv_expect:
+                self._kv_expect[key] -= 1
+                if self._kv_expect[key] <= 0:
+                    del self._kv[key]
+                    del self._kv_expect[key]
+            return {"status": "ok", "payload": payload}
 
     @staticmethod
     def hash_id(value: str, salt: str) -> str:

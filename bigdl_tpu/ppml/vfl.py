@@ -1,0 +1,137 @@
+"""Vertical federated linear / logistic regression.
+
+Reference: ``scala/ppml`` VFL NN (VflLinearRegression /
+VflLogisticRegression — SURVEY.md §2.8 PPML row): parties hold disjoint
+FEATURE COLUMNS of the same (PSI-aligned) rows; exactly one party holds
+the labels. Raw features never leave a party; what crosses the wire is:
+
+- each step, every party's partial logits  z_p = X_p @ w_p + b_p,
+  summed by the FLServer's barrier-reduce (``agg`` op=sum) — the same
+  interaction the reference routes through its gRPC NN aggregator;
+- the label party computes dL/dz from the summed logits and publishes it
+  through the server kv (``put``/``get``); every party then forms its
+  local gradient  dW_p = X_p^T dz / B  and updates locally.
+
+Train loop semantics follow the reference: full-batch or mini-batch SGD,
+deterministic batching so all parties iterate the same row order.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from bigdl_tpu.ppml.fl_client import FLClient
+
+
+class VFLLinearRegression:
+    """One party's view of a vertically-federated linear model."""
+
+    _kind = "linear"
+
+    def __init__(self, client: FLClient, n_local_features: int,
+                 has_labels: bool = False, learning_rate: float = 0.05,
+                 model_id: str = "vfl", seed: int = 0):
+        self.client = client
+        self.has_labels = has_labels
+        self.lr = learning_rate
+        self.model_id = model_id
+        rs = np.random.RandomState(seed)
+        self.w = rs.randn(n_local_features) * 0.01
+        # peers expected to fetch each dz broadcast (for server-side GC)
+        self._n_peers: Optional[int] = None
+        # only the label party owns the global bias (so the summed logits
+        # carry exactly one bias term)
+        self.b = 0.0
+        self.history: list = []
+        self._pred_step = 0
+        self._fit_round = 0
+
+    # -- local pieces --------------------------------------------------------
+    def _partial_logits(self, X) -> np.ndarray:
+        z = X @ self.w
+        if self.has_labels:
+            z = z + self.b
+        return z
+
+    def _dz(self, z, y):
+        """Label-party loss gradient dL/dz (mean-reduced later)."""
+        return z - y
+
+    def _loss(self, z, y) -> float:
+        return float(np.mean((z - y) ** 2) / 2.0)
+
+    # -- protocol ------------------------------------------------------------
+    def fit(self, X, y: Optional[np.ndarray] = None, epochs: int = 10,
+            batch_size: int = 0) -> "VFLLinearRegression":
+        """Collective: every party calls fit with its column shard; only
+        the label party passes ``y``."""
+        X = np.asarray(X, np.float64)
+        if self.has_labels:
+            if y is None:
+                raise ValueError("label party must pass y")
+            y = np.asarray(y, np.float64).ravel()
+        n = len(X)
+        bs = batch_size or n
+        step = 0
+        # per-fit round tag: every party increments on each fit() call
+        # (collective contract), so a later fit never reads a previous
+        # fit's still-cached dz from the server kv
+        rnd = self._fit_round
+        self._fit_round += 1
+        for epoch in range(epochs):
+            for start in range(0, n, bs):
+                sl = slice(start, min(start + bs, n))
+                Xb = X[sl]
+                z = self.client.agg(
+                    f"{self.model_id}:r{rnd}:z:{step}",
+                    [self._partial_logits(Xb)], op="sum")[0]
+                if self.has_labels:
+                    dz = self._dz(z, y[sl]) / len(Xb)
+                    self.client.put(f"{self.model_id}:r{rnd}:dz:{step}",
+                                    [dz], expect=self._n_peers)
+                    self.history.append(self._loss(z, y[sl]))
+                else:
+                    dz = self.client.get(
+                        f"{self.model_id}:r{rnd}:dz:{step}")[0]
+                self.w -= self.lr * (Xb.T @ dz)
+                if self.has_labels:
+                    self.b -= self.lr * float(dz.sum())
+                step += 1
+        return self
+
+    def predict(self, X) -> np.ndarray:
+        """Collective: every party contributes its partial logits; all
+        parties receive the summed prediction."""
+        X = np.asarray(X, np.float64)
+        z = self.client.agg(f"{self.model_id}:pred:{self._pred_step}",
+                            [self._partial_logits(X)], op="sum")[0]
+        self._pred_step += 1
+        return self._link(z)
+
+    def _link(self, z):
+        return z
+
+
+class VFLLogisticRegression(VFLLinearRegression):
+    """Vertically-federated binary logistic regression."""
+
+    _kind = "logistic"
+
+    @staticmethod
+    def _sigmoid(z):
+        return 1.0 / (1.0 + np.exp(-np.clip(z, -30, 30)))
+
+    def _dz(self, z, y):
+        return self._sigmoid(z) - y
+
+    def _loss(self, z, y) -> float:
+        p = np.clip(self._sigmoid(z), 1e-12, 1 - 1e-12)
+        return float(-np.mean(y * np.log(p) + (1 - y) * np.log(1 - p)))
+
+    def _link(self, z):
+        return self._sigmoid(z)
+
+    def predict_class(self, X) -> np.ndarray:
+        return (self.predict(X) >= 0.5).astype(np.int64)

@@ -177,3 +177,37 @@ class TestAutoML:
         assert mse < 0.1, mse
         pred = pipe.predict(ts)
         assert pred.shape[1:] == (1, 1)
+
+
+class TestTF2Estimator:
+    def test_from_keras_tf2_trains_and_evaluates(self):
+        """Hosted tf.keras training via creator functions (ref:
+        P:orca/learn/tf2 Estimator) — loss must fall, accuracy rise."""
+        tf = pytest.importorskip("tensorflow")
+        from bigdl_tpu.orca.learn.estimator import Estimator
+
+        def model_creator(config):
+            tf.keras.utils.set_random_seed(0)
+            m = tf.keras.Sequential([
+                tf.keras.layers.Dense(32, activation="relu",
+                                      input_shape=(10,)),
+                tf.keras.layers.Dense(3, activation="softmax"),
+            ])
+            m.compile(optimizer=tf.keras.optimizers.Adam(config["lr"]),
+                      loss=tf.keras.losses.SparseCategoricalCrossentropy())
+            return m
+
+        rs = np.random.RandomState(0)
+        x = rs.randn(300, 10).astype(np.float32)
+        w = rs.randn(10, 3)
+        y = (x @ w).argmax(1).astype(np.int64)
+
+        from bigdl_tpu.orca.data import XShards
+        shards = XShards.partition({"x": x, "y": y}, num_shards=4)
+
+        est = Estimator.from_keras(model_creator=model_creator,
+                                   config={"lr": 5e-3}, backend="tf2")
+        stats = est.fit(shards, epochs=8, batch_size=32)
+        assert stats[-1] < stats[0]
+        metrics = est.evaluate({"x": x, "y": y})
+        assert metrics["Accuracy"] > 0.9, metrics

@@ -1,0 +1,147 @@
+"""FGBoost federated GBDT + VFL linear/logistic — 2-client convergence
+tests over the real TCP FLServer (the reference's FGBoost/VFL test
+pattern: multi-party training on one host; SURVEY.md §2.8 PPML row)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from bigdl_tpu.ppml import (
+    FGBoostClassification, FGBoostRegression, FLClient, FLServer,
+    VFLLinearRegression, VFLLogisticRegression)
+
+
+def _run_parties(fns):
+    """Run one callable per party on threads; re-raise any failure."""
+    errs = []
+    results = [None] * len(fns)
+
+    def runner(i, fn):
+        try:
+            results[i] = fn()
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    ts = [threading.Thread(target=runner, args=(i, f))
+          for i, f in enumerate(fns)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=120)
+    if errs:
+        raise errs[0]
+    return results
+
+
+@pytest.fixture()
+def server():
+    srv = FLServer(client_num=2, port=0).build().start()
+    yield srv
+    srv.stop()
+
+
+class TestFGBoost:
+    def test_regression_converges_and_parties_agree(self, server):
+        rs = np.random.RandomState(0)
+        X = rs.randn(400, 5)
+        y = (np.sin(X[:, 0]) + 0.5 * X[:, 1] ** 2
+             + X[:, 2] * X[:, 3] + 0.1 * rs.randn(400))
+        shards = [(X[:200], y[:200]), (X[200:], y[200:])]
+        target = str(f"127.0.0.1:{server.port}")
+
+        def party(i):
+            cli = FLClient(f"c{i}", target)
+            model = FGBoostRegression(cli, n_estimators=8, max_depth=3,
+                                      n_bins=16)
+            model.fit(*shards[i])
+            pred = model.predict(X)
+            cli.close()
+            return pred
+
+        p0, p1 = _run_parties([lambda: party(0), lambda: party(1)])
+        # both parties hold the identical ensemble
+        np.testing.assert_allclose(p0, p1, rtol=1e-10, atol=1e-10)
+        base_mse = np.mean((y - y.mean()) ** 2)
+        mse = np.mean((p0 - y) ** 2)
+        assert mse < 0.5 * base_mse, (mse, base_mse)
+
+    def test_classification_accuracy(self, server):
+        rs = np.random.RandomState(1)
+        X = rs.randn(400, 4)
+        logits = X[:, 0] - 0.8 * X[:, 1] + X[:, 2] * X[:, 3]
+        y = (logits > 0).astype(np.float64)
+        shards = [(X[:200], y[:200]), (X[200:], y[200:])]
+        target = f"127.0.0.1:{server.port}"
+
+        def party(i):
+            cli = FLClient(f"c{i}", target)
+            model = FGBoostClassification(cli, n_estimators=10,
+                                          max_depth=3, n_bins=16)
+            model.fit(*shards[i])
+            acc = float((model.predict(X) == y).mean())
+            cli.close()
+            return acc
+
+        accs = _run_parties([lambda: party(0), lambda: party(1)])
+        assert min(accs) > 0.85, accs
+
+
+class TestVFL:
+    def test_linear_regression_converges(self, server):
+        rs = np.random.RandomState(2)
+        n = 300
+        Xa, Xb = rs.randn(n, 3), rs.randn(n, 2)
+        w_true = np.array([1.0, -2.0, 0.5, 3.0, -1.0])
+        y = np.concatenate([Xa, Xb], 1) @ w_true + 0.7
+        target = f"127.0.0.1:{server.port}"
+
+        def party_a():
+            cli = FLClient("a", target)
+            m = VFLLinearRegression(cli, 3, has_labels=True,
+                                    learning_rate=0.1)
+            m.fit(Xa, y, epochs=120)
+            pred = m.predict(Xa)
+            cli.close()
+            return m, pred
+
+        def party_b():
+            cli = FLClient("b", target)
+            m = VFLLinearRegression(cli, 2, has_labels=False,
+                                    learning_rate=0.1)
+            m.fit(Xb, epochs=120)
+            pred = m.predict(Xb)
+            cli.close()
+            return m, pred
+
+        (ma, pa), (mb, pb) = _run_parties([party_a, party_b])
+        assert ma.history[-1] < 0.05 * ma.history[0]
+        np.testing.assert_allclose(pa, pb)          # same summed logits
+        np.testing.assert_allclose(pa, y, atol=0.5)
+        np.testing.assert_allclose(
+            np.concatenate([ma.w, mb.w]), w_true, atol=0.15)
+
+    def test_logistic_regression_accuracy(self, server):
+        rs = np.random.RandomState(3)
+        n = 400
+        Xa, Xb = rs.randn(n, 2), rs.randn(n, 3)
+        w_true = np.array([2.0, -1.0, 1.5, 0.5, -2.0])
+        y = ((np.concatenate([Xa, Xb], 1) @ w_true) > 0).astype(np.float64)
+        target = f"127.0.0.1:{server.port}"
+
+        def party(i):
+            X = Xa if i == 0 else Xb
+            cli = FLClient(f"p{i}", target)
+            m = VFLLogisticRegression(cli, X.shape[1], has_labels=(i == 0),
+                                      learning_rate=0.3)
+            m.fit(X, y if i == 0 else None, epochs=150)
+            proba = m.predict(X)
+            cli.close()
+            return m, proba
+
+        (ma, pa), (mb, pb) = _run_parties(
+            [lambda: party(0), lambda: party(1)])
+        np.testing.assert_allclose(pa, pb)
+        acc = float(((pa >= 0.5) == y).mean())
+        assert acc > 0.93, acc
+        assert ma.history[-1] < 0.5 * ma.history[0]
