@@ -3,6 +3,7 @@ from bigdl_tpu.chronos.forecaster.tcn import TCNForecaster
 from bigdl_tpu.chronos.forecaster.seq2seq import Seq2SeqForecaster
 from bigdl_tpu.chronos.forecaster.lstm import LSTMForecaster
 from bigdl_tpu.chronos.forecaster.nbeats import NBeatsForecaster
+from bigdl_tpu.chronos.forecaster.autoformer import AutoformerForecaster
 
 __all__ = ["BaseForecaster", "TCNForecaster", "Seq2SeqForecaster",
-           "LSTMForecaster", "NBeatsForecaster"]
+           "LSTMForecaster", "NBeatsForecaster", "AutoformerForecaster"]

@@ -1,0 +1,137 @@
+"""AutoformerForecaster (ref: P:chronos/forecaster/autoformer_forecaster.py
+over P:chronos/model/autoformer — the Autoformer architecture: series
+decomposition blocks + auto-correlation attention, Wu et al. 2021).
+
+Faithful-but-compact jax implementation:
+- **series decomposition**: moving-average trend + seasonal residual
+  (the reference's ``series_decomp`` with reflect-free edge padding);
+- **auto-correlation**: period-based dependency discovery via FFT
+  (R(tau) = ifft(fft(q) * conj(fft(k)))), top-k delay selection and
+  time-delay aggregation of rolled values — the O(L log L) replacement
+  for self-attention that defines Autoformer;
+- encoder refines the seasonal part; the decoder accumulates trend and
+  seasonal components for the horizon.
+
+All shapes static; the FFT runs on the time axis. Registered as one
+TensorModule so the BaseForecaster fit/predict/evaluate driver and the
+checkpoint format apply unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.chronos.forecaster.base import BaseForecaster
+from bigdl_tpu.nn.module import TensorModule
+
+
+def _series_decomp(x: jnp.ndarray, kernel: int):
+    """x (B, L, C) → (seasonal, trend); trend = centered moving average
+    with edge padding (ref series_decomp)."""
+    pad_l = (kernel - 1) // 2
+    pad_r = kernel - 1 - pad_l
+    xp = jnp.concatenate(
+        [jnp.repeat(x[:, :1], pad_l, axis=1), x,
+         jnp.repeat(x[:, -1:], pad_r, axis=1)], axis=1)
+    # cumsum-based moving average over the time axis
+    cs = jnp.cumsum(jnp.pad(xp, ((0, 0), (1, 0), (0, 0))), axis=1)
+    trend = (cs[:, kernel:] - cs[:, :-kernel]) / kernel
+    return x - trend, trend
+
+
+def _auto_correlation(q, k, v, top_k: int):
+    """q/k/v (B, L, D) → time-delay aggregated output (B, L, D)."""
+    b, L, d = q.shape
+    fq = jnp.fft.rfft(q, axis=1)
+    fk = jnp.fft.rfft(k, axis=1)
+    corr = jnp.fft.irfft(fq * jnp.conj(fk), n=L, axis=1)     # (B, L, D)
+    scores = corr.mean(axis=-1)                              # (B, L)
+    top_w, top_tau = jax.lax.top_k(scores, top_k)            # (B, K)
+    w = jax.nn.softmax(top_w, axis=-1)                       # (B, K)
+    idx = jnp.arange(L)
+
+    def roll_agg(v_b, tau_b, w_b):
+        def one(tau):
+            return v_b[(idx + tau) % L]                      # (L, D)
+        rolled = jax.vmap(one)(tau_b)                        # (K, L, D)
+        return jnp.einsum("k,kld->ld", w_b, rolled)
+
+    return jax.vmap(roll_agg)(v, top_tau, w)
+
+
+class _Autoformer(TensorModule):
+    def __init__(self, past_len: int, future_len: int, c_in: int,
+                 c_out: int, d_model: int = 32, top_k: int = 3,
+                 decomp_kernel: int = 7, name: Optional[str] = None):
+        super().__init__(name)
+        self.past_len, self.future_len = past_len, future_len
+        self.c_in, self.c_out = c_in, c_out
+        self.d_model, self.top_k = d_model, top_k
+        self.decomp_kernel = decomp_kernel
+        from bigdl_tpu.nn.module import RNG
+        import jax as _jax
+
+        def mk(shape, scale):
+            return (_jax.random.normal(RNG.next_key(), shape, jnp.float32)
+                    * scale)
+
+        s = 1.0 / np.sqrt(c_in)
+        self.add_param("embed_w", mk((d_model, c_in), s))
+        self.add_param("embed_b", jnp.zeros((d_model,), jnp.float32))
+        sd = 1.0 / np.sqrt(d_model)
+        for nm in ("q", "k", "v", "o"):
+            self.add_param(f"attn_{nm}", mk((d_model, d_model), sd))
+        self.add_param("ff1_w", mk((2 * d_model, d_model), sd))
+        self.add_param("ff1_b", jnp.zeros((2 * d_model,), jnp.float32))
+        self.add_param("ff2_w", mk((d_model, 2 * d_model),
+                                   1.0 / np.sqrt(2 * d_model)))
+        self.add_param("ff2_b", jnp.zeros((d_model,), jnp.float32))
+        self.add_param("head_seasonal_w",
+                       mk((future_len * c_out, past_len * d_model),
+                          1.0 / np.sqrt(past_len * d_model)))
+        self.add_param("head_trend_w",
+                       mk((future_len * c_out, past_len * c_in),
+                          1.0 / np.sqrt(past_len * c_in)))
+
+    def _apply(self, params, states, x, *, training, rng):
+        b = x.shape[0]
+        seasonal, trend = _series_decomp(x, self.decomp_kernel)
+        h = seasonal @ params["embed_w"].T + params["embed_b"]
+        q = h @ params["attn_q"].T
+        k = h @ params["attn_k"].T
+        v = h @ params["attn_v"].T
+        attn = _auto_correlation(q, k, v, self.top_k) @ params["attn_o"].T
+        h2, _ = _series_decomp(h + attn, self.decomp_kernel)
+        ff = jax.nn.relu(h2 @ params["ff1_w"].T + params["ff1_b"])
+        ff = ff @ params["ff2_w"].T + params["ff2_b"]
+        h3, _ = _series_decomp(h2 + ff, self.decomp_kernel)
+        seas_out = (h3.reshape(b, -1) @ params["head_seasonal_w"].T)
+        trend_out = (trend.reshape(b, -1) @ params["head_trend_w"].T)
+        out = seas_out + trend_out
+        return out.reshape(b, self.future_len, self.c_out)
+
+
+class AutoformerForecaster(BaseForecaster):
+    """ref args mirror AutoformerForecaster(past_seq_len, future_seq_len,
+    input_feature_num, output_feature_num, d_model, ...)."""
+
+    def __init__(self, past_seq_len: int, future_seq_len: int,
+                 input_feature_num: int, output_feature_num: int,
+                 d_model: int = 32, top_k: int = 3,
+                 decomp_kernel: int = 7, lr: float = 1e-3,
+                 loss: str = "mse", seed: int = 0):
+        self.d_model = d_model
+        self.top_k = top_k
+        self.decomp_kernel = decomp_kernel
+        super().__init__(past_seq_len, future_seq_len, input_feature_num,
+                         output_feature_num, lr, loss, seed)
+
+    def _build_model(self) -> nn.Module:
+        return _Autoformer(self.past_seq_len, self.future_seq_len,
+                           self.input_feature_num, self.output_feature_num,
+                           self.d_model, self.top_k, self.decomp_kernel)

@@ -1,0 +1,5 @@
+"""chronos.simulator (ref: P:chronos/simulator — DPGANSimulator)."""
+
+from bigdl_tpu.chronos.simulator.dpgan import DPGANSimulator
+
+__all__ = ["DPGANSimulator"]

@@ -1,0 +1,301 @@
+"""Mask R-CNN (ref: S:dllib/models/maskrcnn — MaskRCNN.scala composing
+the resnet backbone, FPN.scala, RegionProposal.scala, BoxHead.scala,
+MaskHead.scala; SURVEY.md §2.3 model-zoo row calls it the zoo's hardest
+model).
+
+TPU-first formulation: a functional params-dict model (like
+bigdl_tpu.llm.models) with **static shapes end-to-end** — fixed
+proposal/detection counts with validity masks instead of the reference's
+dynamic per-image tensors, so the whole inference path jits into one XLA
+program. The detection ops (roi_align, nms, box codecs, anchors) live in
+``bigdl_tpu.nn.layers.detection`` as reusable layers.
+
+Layout NHWC (channels on the TPU lane dim). Scope: full inference path
+(backbone → FPN → RPN proposals → box head → class-aware NMS → mask
+head); training losses/sampling are out of scope this round (the
+reference trains on COCO via its Spark mains — documented gap).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.nn.layers.detection import (
+    clip_boxes, decode_boxes, generate_anchors, nms, roi_align)
+
+
+@dataclasses.dataclass
+class MaskRCNNConfig:
+    num_classes: int = 81                 # COCO: 80 + background
+    image_size: int = 224                 # square input (static)
+    backbone_channels: Tuple[int, ...] = (64, 128, 256, 512)
+    fpn_channels: int = 64
+    anchor_ratios: Tuple[float, ...] = (0.5, 1.0, 2.0)
+    anchor_size_per_stride: float = 4.0   # anchor size = stride * this
+    pre_nms_top_n: int = 256
+    post_nms_top_n: int = 64
+    rpn_nms_thresh: float = 0.7
+    box_score_thresh: float = 0.05
+    box_nms_thresh: float = 0.5
+    detections_per_img: int = 16
+    box_pool: int = 7
+    mask_pool: int = 14
+    mask_size: int = 28
+
+    @classmethod
+    def tiny(cls) -> "MaskRCNNConfig":
+        return cls(num_classes=5, image_size=64,
+                   backbone_channels=(8, 16, 32, 64), fpn_channels=16,
+                   pre_nms_top_n=32, post_nms_top_n=8,
+                   detections_per_img=4)
+
+    @property
+    def strides(self):
+        return (4, 8, 16, 32)
+
+
+# ---------------------------------------------------------------------------
+# params
+# ---------------------------------------------------------------------------
+
+def _conv_p(key, k, c_in, c_out, scale=None):
+    scale = scale or float(np.sqrt(2.0 / (k * k * c_in)))
+    return {"w": jax.random.normal(key, (c_out, c_in, k, k),
+                                   jnp.float32) * scale,
+            "b": jnp.zeros((c_out,), jnp.float32)}
+
+
+def _fc_p(key, n_in, n_out):
+    return {"w": jax.random.normal(key, (n_out, n_in), jnp.float32)
+            * float(np.sqrt(1.0 / n_in)),
+            "b": jnp.zeros((n_out,), jnp.float32)}
+
+
+def init_params(cfg: MaskRCNNConfig, seed: int = 0) -> Dict[str, Any]:
+    key = jax.random.PRNGKey(seed)
+    ks = iter(jax.random.split(key, 64))
+    chans = cfg.backbone_channels
+    f = cfg.fpn_channels
+    a = len(cfg.anchor_ratios)
+    params: Dict[str, Any] = {
+        "stem": _conv_p(next(ks), 7, 3, chans[0]),
+        "stages": [],
+        "fpn_lateral": [], "fpn_out": [],
+        "rpn": {"conv": _conv_p(next(ks), 3, f, f),
+                "cls": _conv_p(next(ks), 1, f, a),
+                "reg": _conv_p(next(ks), 1, f, a * 4)},
+    }
+    c_in = chans[0]
+    for c in chans:
+        params["stages"].append({
+            "conv1": _conv_p(next(ks), 3, c_in, c),
+            "conv2": _conv_p(next(ks), 3, c, c)})
+        c_in = c
+    for c in chans:
+        params["fpn_lateral"].append(_conv_p(next(ks), 1, c, f))
+        params["fpn_out"].append(_conv_p(next(ks), 3, f, f))
+    p = cfg.box_pool
+    params["box_head"] = {
+        "fc1": _fc_p(next(ks), f * p * p, 4 * f),
+        "fc2": _fc_p(next(ks), 4 * f, 4 * f),
+        "cls": _fc_p(next(ks), 4 * f, cfg.num_classes),
+        "reg": _fc_p(next(ks), 4 * f, cfg.num_classes * 4)}
+    params["mask_head"] = {
+        "convs": [_conv_p(next(ks), 3, f, f) for _ in range(4)],
+        "deconv": _conv_p(next(ks), 2, f, f),
+        "logits": _conv_p(next(ks), 1, f, cfg.num_classes)}
+    return params
+
+
+# ---------------------------------------------------------------------------
+# building blocks (NHWC functional convs)
+# ---------------------------------------------------------------------------
+
+def _conv(p, x, stride=1, padding="SAME"):
+    y = jax.lax.conv_general_dilated(
+        x, p["w"].astype(x.dtype), (stride, stride), padding,
+        dimension_numbers=("NHWC", "OIHW", "NHWC"))
+    return y + p["b"].astype(x.dtype)
+
+
+def _backbone(params, x) -> List[jnp.ndarray]:
+    """stem(s2)+pool(s2) then 4 stages -> [C2(s4), C3(s8), C4(s16), C5(s32)]."""
+    x = jax.nn.relu(_conv(params["stem"], x, stride=2))
+    x = jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, (1, 3, 3, 1),
+                              (1, 2, 2, 1), "SAME")
+    feats = []
+    for i, sp in enumerate(params["stages"]):
+        stride = 1 if i == 0 else 2
+        x = jax.nn.relu(_conv(sp["conv1"], x, stride=stride))
+        x = jax.nn.relu(_conv(sp["conv2"], x))
+        feats.append(x)
+    return feats
+
+
+def _fpn(params, feats) -> List[jnp.ndarray]:
+    """Top-down pathway with lateral 1x1s (ref FPN.scala) -> [P2..P5]."""
+    lats = [_conv(lp, f) for lp, f in zip(params["fpn_lateral"], feats)]
+    outs = [None] * len(lats)
+    top = lats[-1]
+    outs[-1] = _conv(params["fpn_out"][-1], top)
+    for i in range(len(lats) - 2, -1, -1):
+        b, h, w, c = lats[i].shape
+        up = jax.image.resize(top, (b, h, w, c), method="nearest")
+        top = lats[i] + up
+        outs[i] = _conv(params["fpn_out"][i], top)
+    return outs
+
+
+def _fc(p, x):
+    return x @ p["w"].T.astype(x.dtype) + p["b"].astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# heads
+# ---------------------------------------------------------------------------
+
+def _rpn_proposals(params, cfg: MaskRCNNConfig, pyramid, anchors_np):
+    """Per-image fixed-size proposals from all FPN levels."""
+    b = pyramid[0].shape[0]
+    all_scores, all_deltas = [], []
+    for feat in pyramid:
+        t = jax.nn.relu(_conv(params["rpn"]["conv"], feat))
+        cls = _conv(params["rpn"]["cls"], t)                 # (B,H,W,A)
+        reg = _conv(params["rpn"]["reg"], t)                 # (B,H,W,A*4)
+        all_scores.append(cls.reshape(b, -1))
+        all_deltas.append(reg.reshape(b, -1, 4))
+    scores = jnp.concatenate(all_scores, axis=1)             # (B, Na)
+    deltas = jnp.concatenate(all_deltas, axis=1)             # (B, Na, 4)
+    anchors = jnp.asarray(anchors_np)
+
+    def per_image(sc, dl):
+        k = min(cfg.pre_nms_top_n, sc.shape[0])
+        top_sc, top_i = jax.lax.top_k(sc, k)
+        boxes = decode_boxes(anchors[top_i], dl[top_i])
+        boxes = clip_boxes(boxes, cfg.image_size, cfg.image_size)
+        keep, valid = nms(boxes, top_sc, cfg.rpn_nms_thresh,
+                          cfg.post_nms_top_n)
+        return boxes[keep], jnp.where(valid, top_sc[keep], -jnp.inf), valid
+
+    return jax.vmap(per_image)(scores, deltas)   # (B,P,4),(B,P),(B,P)
+
+
+def _assign_levels(boxes: jnp.ndarray, n_levels: int) -> jnp.ndarray:
+    """FPN level per box by sqrt(area) (ref Pooler level mapper)."""
+    area = jnp.maximum(boxes[:, 2] - boxes[:, 0], 1.0) \
+        * jnp.maximum(boxes[:, 3] - boxes[:, 1], 1.0)
+    lvl = jnp.floor(2.0 + jnp.log2(jnp.sqrt(area) / 56.0))
+    return jnp.clip(lvl, 0, n_levels - 1).astype(jnp.int32)
+
+
+def _pyramid_roi_align(pyramid, cfg, boxes, batch_idx, out_size):
+    """ROIAlign from the assigned FPN level (computed on every level,
+    selected per box — static-shape formulation of the ref Pooler)."""
+    lvl = _assign_levels(boxes, len(pyramid))
+    pooled = None
+    for i, feat in enumerate(pyramid):
+        p_i = roi_align(feat, boxes, batch_idx, out_size,
+                        spatial_scale=1.0 / cfg.strides[i])
+        sel = (lvl == i).astype(p_i.dtype)[:, None, None, None]
+        pooled = p_i * sel if pooled is None else pooled + p_i * sel
+    return pooled
+
+
+def forward(params: Dict[str, Any], cfg: MaskRCNNConfig,
+            images: jnp.ndarray) -> Dict[str, jnp.ndarray]:
+    """Inference: images (B, S, S, 3) → dict of fixed-shape detections:
+    boxes (B, D, 4), scores (B, D), labels (B, D) int32 (0 = background /
+    invalid slot), masks (B, D, M, M) sigmoid probabilities."""
+    b = images.shape[0]
+    feats = _backbone(params, images)
+    pyramid = _fpn(params, feats)
+
+    anchors_np = np.concatenate([
+        generate_anchors(cfg.image_size // s, cfg.image_size // s, s,
+                         [s * cfg.anchor_size_per_stride],
+                         cfg.anchor_ratios)
+        for s in cfg.strides])
+    props, prop_scores, prop_valid = _rpn_proposals(params, cfg, pyramid,
+                                                    anchors_np)
+
+    # ---- box head over all images' proposals at once ----------------------
+    P = props.shape[1]
+    flat_boxes = props.reshape(-1, 4)
+    flat_batch = jnp.repeat(jnp.arange(b, dtype=jnp.int32), P)
+    pooled = _pyramid_roi_align(pyramid, cfg, flat_boxes, flat_batch,
+                                cfg.box_pool)
+    x = pooled.reshape(pooled.shape[0], -1)
+    x = jax.nn.relu(_fc(params["box_head"]["fc1"], x))
+    x = jax.nn.relu(_fc(params["box_head"]["fc2"], x))
+    cls_logits = _fc(params["box_head"]["cls"], x)           # (BP, K)
+    reg = _fc(params["box_head"]["reg"], x).reshape(
+        -1, cfg.num_classes, 4)
+
+    probs = jax.nn.softmax(cls_logits, axis=-1)
+    # best non-background class per proposal
+    fg = probs[:, 1:]
+    best_c = jnp.argmax(fg, axis=1) + 1                      # (BP,)
+    best_p = jnp.max(fg, axis=1)
+    best_deltas = jnp.take_along_axis(
+        reg, best_c[:, None, None], axis=1)[:, 0]
+    det_boxes = clip_boxes(decode_boxes(flat_boxes, best_deltas),
+                           cfg.image_size, cfg.image_size)
+    det_boxes = det_boxes.reshape(b, P, 4)
+    det_scores = jnp.where(prop_valid, best_p.reshape(b, P), -jnp.inf)
+    det_labels = best_c.reshape(b, P)
+
+    def per_image(boxes, sc, labels):
+        # class-aware NMS: offset boxes by label so classes never suppress
+        # each other (the standard batched-NMS trick)
+        off = labels.astype(jnp.float32)[:, None] * (2.0 * cfg.image_size)
+        keep, valid = nms(boxes + off, sc, cfg.box_nms_thresh,
+                          cfg.detections_per_img)
+        valid &= sc[keep] > cfg.box_score_thresh
+        return (boxes[keep], jnp.where(valid, sc[keep], 0.0),
+                jnp.where(valid, labels[keep], 0), valid)
+
+    f_boxes, f_scores, f_labels, f_valid = jax.vmap(per_image)(
+        det_boxes, det_scores, det_labels)
+
+    # ---- mask head on the final detections --------------------------------
+    D = f_boxes.shape[1]
+    m_boxes = f_boxes.reshape(-1, 4)
+    m_batch = jnp.repeat(jnp.arange(b, dtype=jnp.int32), D)
+    mp = _pyramid_roi_align(pyramid, cfg, m_boxes, m_batch, cfg.mask_pool)
+    for cp in params["mask_head"]["convs"]:
+        mp = jax.nn.relu(_conv(cp, mp))
+    # 2x deconv (ref: ConvTranspose 2x2 s2)
+    mp = jax.lax.conv_transpose(
+        mp, jnp.transpose(params["mask_head"]["deconv"]["w"],
+                          (2, 3, 1, 0)).astype(mp.dtype),
+        (2, 2), "SAME", dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    mp = jax.nn.relu(mp + params["mask_head"]["deconv"]["b"]
+                     .astype(mp.dtype))
+    mask_logits = _conv(params["mask_head"]["logits"], mp)   # (BD,M,M,K)
+    lab = f_labels.reshape(-1)
+    mask = jnp.take_along_axis(
+        mask_logits, lab[:, None, None, None], axis=3)[..., 0]
+    masks = jax.nn.sigmoid(mask).reshape(b, D, cfg.mask_size,
+                                         cfg.mask_size)
+    return {"boxes": f_boxes, "scores": f_scores,
+            "labels": f_labels.astype(jnp.int32) * f_valid,
+            "masks": masks}
+
+
+class MaskRCNN:
+    """Facade (ref API: models.maskrcnn.MaskRCNN(resolution=...))."""
+
+    def __init__(self, cfg: MaskRCNNConfig = None, seed: int = 0):
+        self.config = cfg or MaskRCNNConfig()
+        self.params = init_params(self.config, seed)
+        import functools
+        self._fwd = jax.jit(functools.partial(forward, cfg=self.config))
+
+    def __call__(self, images) -> Dict[str, np.ndarray]:
+        out = self._fwd(self.params, images=jnp.asarray(images))
+        return {k: np.asarray(v) for k, v in out.items()}

@@ -1,0 +1,207 @@
+"""Detection ops: ROIAlign, NMS, anchors, box codecs.
+
+Reference: the Mask-RCNN support layers under ``S:dllib/nn`` (Pooler /
+RoiAlign.scala, Nms.scala, AnchorGenerate.scala, BoxHead/MaskHead pieces
+of ``S:dllib/models/maskrcnn`` — SURVEY.md §2.3 model-zoo row). The
+reference hand-writes these on CPU tensors; here they are jit-compatible
+jax ops with **static output shapes** (fixed ``max_out`` with validity
+masks instead of dynamic result counts — the XLA-friendly formulation of
+the same contracts).
+
+Conventions: boxes are absolute-coordinate ``(x1, y1, x2, y2)``;
+feature maps are NHWC (channels on the TPU lane dim).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.nn.module import TensorModule
+
+
+# ---------------------------------------------------------------------------
+# ROI Align
+# ---------------------------------------------------------------------------
+
+def roi_align(features: jnp.ndarray, boxes: jnp.ndarray,
+              box_batch: jnp.ndarray, output_size: int = 7,
+              spatial_scale: float = 1.0,
+              sampling_ratio: int = 2) -> jnp.ndarray:
+    """ROIAlign (ref: RoiAlign.scala — Mask-RCNN's bilinear pooler).
+
+    features: (B, H, W, C); boxes: (N, 4) x1,y1,x2,y2 in input coords;
+    box_batch: (N,) int batch index per box. Returns (N, P, P, C) with
+    P = output_size. Each output bin averages ``sampling_ratio^2``
+    bilinearly-interpolated samples — the exact RoiAlign contract.
+    """
+    b, h, w, c = features.shape
+    n = boxes.shape[0]
+    p, s = output_size, sampling_ratio
+    boxes = boxes.astype(jnp.float32) * spatial_scale
+    x1, y1, x2, y2 = [boxes[:, i] for i in range(4)]
+    bw = jnp.maximum(x2 - x1, 1.0)
+    bh = jnp.maximum(y2 - y1, 1.0)
+    # sample grid: p bins per dim, s samples per bin
+    grid = (jnp.arange(p * s, dtype=jnp.float32) + 0.5) / s  # in bin units
+    sy = y1[:, None] + grid[None, :] * (bh / p)[:, None]     # (N, p*s)
+    sx = x1[:, None] + grid[None, :] * (bw / p)[:, None]
+
+    def bilinear(feat_b, ys, xs):
+        """feat_b: (H, W, C); ys/xs: (p*s,); → (p*s, p*s, C)."""
+        ys = jnp.clip(ys - 0.5, 0.0, h - 1.0)
+        xs = jnp.clip(xs - 0.5, 0.0, w - 1.0)
+        y0 = jnp.floor(ys).astype(jnp.int32)
+        x0 = jnp.floor(xs).astype(jnp.int32)
+        y1_ = jnp.minimum(y0 + 1, h - 1)
+        x1_ = jnp.minimum(x0 + 1, w - 1)
+        wy = (ys - y0)[:, None, None]
+        wx = (xs - x0)[None, :, None]
+        f00 = feat_b[y0][:, x0]                              # (p*s, p*s, C)
+        f01 = feat_b[y0][:, x1_]
+        f10 = feat_b[y1_][:, x0]
+        f11 = feat_b[y1_][:, x1_]
+        return (f00 * (1 - wy) * (1 - wx) + f01 * (1 - wy) * wx
+                + f10 * wy * (1 - wx) + f11 * wy * wx)
+
+    def one_roi(i):
+        feat_b = features[box_batch[i]]
+        samp = bilinear(feat_b, sy[i], sx[i])                # (p*s, p*s, C)
+        return samp.reshape(p, s, p, s, c).mean(axis=(1, 3))
+
+    return jax.vmap(one_roi)(jnp.arange(n))
+
+
+class RoiAlign(TensorModule):
+    """Module wrapper (ref: nn RoiAlign layer). forward(table) with
+    activity [features, boxes, batch_idx]."""
+
+    def __init__(self, output_size: int = 7, spatial_scale: float = 1.0,
+                 sampling_ratio: int = 2, name: Optional[str] = None):
+        super().__init__(name)
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+        self.sampling_ratio = sampling_ratio
+
+    def _apply(self, params, states, x, *, training, rng):
+        feats, boxes, batch_idx = x[0], x[1], x[2]
+        return roi_align(feats, boxes, jnp.asarray(batch_idx, jnp.int32),
+                         self.output_size, self.spatial_scale,
+                         self.sampling_ratio)
+
+
+# ---------------------------------------------------------------------------
+# NMS
+# ---------------------------------------------------------------------------
+
+def box_iou(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """(N, 4) x (M, 4) → (N, M) IoU."""
+    area_a = jnp.maximum(a[:, 2] - a[:, 0], 0) \
+        * jnp.maximum(a[:, 3] - a[:, 1], 0)
+    area_b = jnp.maximum(b[:, 2] - b[:, 0], 0) \
+        * jnp.maximum(b[:, 3] - b[:, 1], 0)
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.maximum(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    return inter / jnp.maximum(area_a[:, None] + area_b[None, :] - inter,
+                               1e-9)
+
+
+def nms(boxes: jnp.ndarray, scores: jnp.ndarray,
+        iou_threshold: float = 0.5, max_out: int = 100
+        ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Greedy NMS (ref: Nms.scala) with a STATIC output size.
+
+    Returns (indices (max_out,) int32, valid (max_out,) bool): the
+    highest-scoring surviving boxes in selection order, padded with 0s
+    where fewer than max_out survive (mask tells which are real).
+    """
+    n = boxes.shape[0]
+    iou = box_iou(boxes, boxes)
+
+    def body(state, _):
+        avail_scores, = state
+        best = jnp.argmax(avail_scores)
+        best_score = avail_scores[best]
+        valid = best_score > -jnp.inf
+        # suppress overlaps with the selected box (and itself)
+        suppress = iou[best] > iou_threshold
+        suppress = suppress | (jnp.arange(n) == best)
+        new_scores = jnp.where(valid & suppress, -jnp.inf, avail_scores)
+        return (new_scores,), (best.astype(jnp.int32), valid)
+
+    (_,), (idx, valid) = jax.lax.scan(
+        body, (scores.astype(jnp.float32),), None, length=max_out)
+    return idx, valid
+
+
+# ---------------------------------------------------------------------------
+# Box codecs + anchors (ref: BboxUtil / AnchorGenerate.scala)
+# ---------------------------------------------------------------------------
+
+def encode_boxes(anchors: jnp.ndarray, boxes: jnp.ndarray,
+                 weights=(1.0, 1.0, 1.0, 1.0)) -> jnp.ndarray:
+    """(dx, dy, dw, dh) regression targets of ``boxes`` w.r.t. anchors."""
+    wa = anchors[:, 2] - anchors[:, 0]
+    ha = anchors[:, 3] - anchors[:, 1]
+    xa = anchors[:, 0] + wa * 0.5
+    ya = anchors[:, 1] + ha * 0.5
+    w = boxes[:, 2] - boxes[:, 0]
+    h = boxes[:, 3] - boxes[:, 1]
+    x = boxes[:, 0] + w * 0.5
+    y = boxes[:, 1] + h * 0.5
+    wx, wy, ww, wh = weights
+    return jnp.stack([wx * (x - xa) / wa, wy * (y - ya) / ha,
+                      ww * jnp.log(w / wa), wh * jnp.log(h / ha)], axis=1)
+
+
+def decode_boxes(anchors: jnp.ndarray, deltas: jnp.ndarray,
+                 weights=(1.0, 1.0, 1.0, 1.0),
+                 clip: float = 4.135) -> jnp.ndarray:
+    """Inverse of :func:`encode_boxes` (dw/dh clamped like the ref)."""
+    wa = anchors[:, 2] - anchors[:, 0]
+    ha = anchors[:, 3] - anchors[:, 1]
+    xa = anchors[:, 0] + wa * 0.5
+    ya = anchors[:, 1] + ha * 0.5
+    wx, wy, ww, wh = weights
+    dx, dy, dw, dh = [deltas[:, i] for i in range(4)]
+    dw = jnp.clip(dw / ww, -clip, clip)
+    dh = jnp.clip(dh / wh, -clip, clip)
+    x = dx / wx * wa + xa
+    y = dy / wy * ha + ya
+    w = jnp.exp(dw) * wa
+    h = jnp.exp(dh) * ha
+    return jnp.stack([x - w * 0.5, y - h * 0.5,
+                      x + w * 0.5, y + h * 0.5], axis=1)
+
+
+def generate_anchors(feat_h: int, feat_w: int, stride: int,
+                     sizes: Sequence[float],
+                     ratios: Sequence[float] = (0.5, 1.0, 2.0)
+                     ) -> np.ndarray:
+    """Dense anchor grid for one FPN level: (H*W*A, 4) numpy (static)."""
+    base = []
+    for size in sizes:
+        for r in ratios:
+            w = size * np.sqrt(1.0 / r)
+            h = size * np.sqrt(r)
+            base.append([-w / 2, -h / 2, w / 2, h / 2])
+    base = np.asarray(base, np.float32)                      # (A, 4)
+    cx = (np.arange(feat_w) + 0.5) * stride
+    cy = (np.arange(feat_h) + 0.5) * stride
+    cxg, cyg = np.meshgrid(cx, cy)                           # (H, W)
+    shifts = np.stack([cxg, cyg, cxg, cyg], axis=-1)         # (H, W, 4)
+    anchors = shifts[:, :, None, :] + base[None, None, :, :]
+    return anchors.reshape(-1, 4).astype(np.float32)
+
+
+def clip_boxes(boxes: jnp.ndarray, height: float,
+               width: float) -> jnp.ndarray:
+    return jnp.stack([jnp.clip(boxes[:, 0], 0, width),
+                      jnp.clip(boxes[:, 1], 0, height),
+                      jnp.clip(boxes[:, 2], 0, width),
+                      jnp.clip(boxes[:, 3], 0, height)], axis=1)

@@ -445,6 +445,90 @@ class Ftrl(OptimMethod):
                                   "linear": unf(tdef, new_z)}
 
 
+class LBFGS(OptimMethod):
+    """Limited-memory BFGS (ref: optim/LBFGS.scala — the reference wraps
+    the torch-lua lbfgs routine; DLlib exposes it for full-batch
+    optimization).
+
+    Jax-functional formulation: curvature pairs (s, y) live in fixed-size
+    ring buffers inside the optimizer state (flattened parameter vector,
+    history ``m``), the search direction comes from the standard two-loop
+    recursion, and the step is ``p -= lr * direction`` (fixed step size:
+    the reference's line-search-free ``learningRate`` mode). Empty or
+    non-curved history slots are masked with rho = 0, so the first step
+    degenerates to plain gradient descent exactly like the reference.
+    """
+
+    def __init__(self, learning_rate: float = 1.0, history_size: int = 5,
+                 learning_rate_schedule: Optional[LearningRateSchedule]
+                 = None):
+        super().__init__(learning_rate, learning_rate_schedule)
+        self.m = history_size
+
+    def init_state(self, params):
+        from jax.flatten_util import ravel_pytree
+
+        flat, _ = ravel_pytree(params)
+        d = flat.shape[0]
+        z = jnp.zeros
+        return {"s": z((self.m, d)), "y": z((self.m, d)),
+                "rho": z((self.m,)),
+                "prev_p": z((d,)), "prev_g": z((d,)),
+                "count": jnp.zeros((), jnp.int32)}
+
+    def step(self, params, grads, state, lr):
+        from jax.flatten_util import ravel_pytree
+
+        flat_g, _ = ravel_pytree(grads)
+        flat_p, unravel = ravel_pytree(params)
+        m = self.m
+
+        def push(state):
+            sv = flat_p - state["prev_p"]
+            yv = flat_g - state["prev_g"]
+            sy = jnp.dot(sv, yv)
+            rho = jnp.where(sy > 1e-10, 1.0 / jnp.maximum(sy, 1e-10), 0.0)
+            return {**state,
+                    "s": jnp.roll(state["s"], -1, 0).at[-1].set(sv),
+                    "y": jnp.roll(state["y"], -1, 0).at[-1].set(yv),
+                    "rho": jnp.roll(state["rho"], -1, 0).at[-1].set(rho)}
+
+        state = jax.lax.cond(state["count"] > 0, push, lambda s: s, state)
+
+        # two-loop recursion (newest = index m-1)
+        q = flat_g
+        alphas = []
+        for i in range(m - 1, -1, -1):
+            a = state["rho"][i] * jnp.dot(state["s"][i], q)
+            q = q - a * state["y"][i]
+            alphas.append((i, a))
+        yy = jnp.dot(state["y"][-1], state["y"][-1])
+        sy = jnp.dot(state["s"][-1], state["y"][-1])
+        # only positive curvature scales the initial Hessian (the ref
+        # skips ys <= 1e-10 pairs; a negative gamma would flip the
+        # search into an ascent direction on non-convex objectives)
+        gamma = jnp.where((yy > 1e-10) & (sy > 1e-10),
+                          sy / jnp.maximum(yy, 1e-10), 1.0)
+        r = gamma * q
+        for i, a in reversed(alphas):
+            beta = state["rho"][i] * jnp.dot(state["y"][i], r)
+            r = r + state["s"][i] * (a - beta)
+
+        # first iteration has no curvature: take the torch-lbfgs damped
+        # gradient step  t = min(1, 1/|g|_1) * lr  instead of a raw
+        # lr-scaled gradient (which diverges on stiff problems)
+        g_l1 = jnp.sum(jnp.abs(flat_g))
+        damped = flat_g * jnp.minimum(1.0, 1.0 / jnp.maximum(g_l1, 1e-12))
+        r = jnp.where(state["count"] > 0, r, damped)
+
+        new_flat = flat_p - lr * r
+        # store the iterate/gradient PAIR (x_k, g(x_k)) so the next call
+        # forms s = x_{k+1} - x_k against matching quantities
+        new_state = {**state, "prev_p": flat_p, "prev_g": flat_g,
+                     "count": state["count"] + 1}
+        return unravel(new_flat), new_state
+
+
 # Intra-node parallel Adam is meaningless under SPMD — the step is already
 # partitioned across chips (ref: optim/ParallelAdam.scala).
 ParallelAdam = Adam

@@ -1,0 +1,130 @@
+"""Mask-RCNN + detection ops (ref: S:dllib/models/maskrcnn and its nn
+support layers — RoiAlign, Nms, anchor/box utils; golden-parity against
+independent numpy implementations per SURVEY.md §4)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.nn.layers.detection import (
+    box_iou, decode_boxes, encode_boxes, generate_anchors, nms, roi_align)
+
+
+class TestRoiAlign:
+    def test_matches_numpy_bilinear(self):
+        """One 2x2-bin ROI on a linear ramp: averaging bilinear samples
+        of a linear function is exact, so the expected value is the
+        function at the bin-center mean."""
+        h = w = 8
+        feat = (np.arange(h)[:, None] * 10.0
+                + np.arange(w)[None, :]).astype(np.float32)
+        feats = feat[None, :, :, None]                    # (1, 8, 8, 1)
+        boxes = np.array([[1.0, 1.0, 5.0, 5.0]], np.float32)
+        out = np.asarray(roi_align(jnp.asarray(feats), jnp.asarray(boxes),
+                                   jnp.zeros(1, jnp.int32), output_size=2,
+                                   sampling_ratio=2))[0, :, :, 0]
+        # bins are 2x2 over [1, 5): centers at 2, 4. Continuous coord y
+        # maps to pixel index y - 0.5 (torchvision ROIAlign convention),
+        # so f(y, x) = 10*(y-0.5) + (x-0.5).
+        expect = np.array([[(2 - .5) * 10 + (2 - .5),
+                            (2 - .5) * 10 + (4 - .5)],
+                           [(4 - .5) * 10 + (2 - .5),
+                            (4 - .5) * 10 + (4 - .5)]], np.float32)
+        np.testing.assert_allclose(out, expect, rtol=1e-5)
+
+    def test_batch_index_selects_image(self):
+        feats = np.stack([np.zeros((4, 4, 1)), np.ones((4, 4, 1))]) \
+            .astype(np.float32)
+        boxes = np.array([[0, 0, 4, 4], [0, 0, 4, 4]], np.float32)
+        out = np.asarray(roi_align(jnp.asarray(feats), jnp.asarray(boxes),
+                                   jnp.asarray([0, 1], jnp.int32),
+                                   output_size=2))
+        assert np.allclose(out[0], 0.0) and np.allclose(out[1], 1.0)
+
+
+class TestNms:
+    def test_matches_numpy_greedy(self):
+        rs = np.random.RandomState(0)
+        xy = rs.rand(24, 2) * 40
+        wh = rs.rand(24, 2) * 20 + 4
+        boxes = np.concatenate([xy, xy + wh], 1).astype(np.float32)
+        scores = rs.rand(24).astype(np.float32)
+        idx, valid = nms(jnp.asarray(boxes), jnp.asarray(scores),
+                         iou_threshold=0.4, max_out=24)
+        got = [int(i) for i, v in zip(np.asarray(idx), np.asarray(valid))
+               if v]
+
+        # independent numpy greedy reference
+        iou = np.asarray(box_iou(jnp.asarray(boxes), jnp.asarray(boxes)))
+        avail = scores.copy()
+        want = []
+        while True:
+            b = int(np.argmax(avail))
+            if avail[b] == -np.inf:
+                break
+            want.append(b)
+            avail[iou[b] > 0.4] = -np.inf
+            avail[b] = -np.inf
+        assert got == want
+
+    def test_static_output_shape(self):
+        boxes = jnp.asarray([[0, 0, 10, 10], [0, 0, 10, 10.]],
+                            jnp.float32)
+        idx, valid = nms(boxes, jnp.asarray([0.9, 0.8]), 0.5, max_out=5)
+        assert idx.shape == (5,) and valid.shape == (5,)
+        assert int(np.asarray(valid).sum()) == 1  # duplicate suppressed
+
+
+class TestBoxCodec:
+    def test_roundtrip(self):
+        rs = np.random.RandomState(1)
+        anchors = np.abs(rs.rand(10, 2)) * 20
+        anchors = np.concatenate([anchors, anchors + rs.rand(10, 2) * 30
+                                  + 5], 1).astype(np.float32)
+        boxes = anchors + rs.randn(10, 4).astype(np.float32)
+        deltas = encode_boxes(jnp.asarray(anchors), jnp.asarray(boxes))
+        back = decode_boxes(jnp.asarray(anchors), deltas)
+        np.testing.assert_allclose(np.asarray(back), boxes, rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_anchor_grid(self):
+        a = generate_anchors(4, 4, 8, [32.0], (1.0,))
+        assert a.shape == (16, 4)
+        # centered on (stride/2 + i*stride)
+        np.testing.assert_allclose(a[0], [-12, -12, 20, 20])
+
+
+class TestMaskRCNNEndToEnd:
+    def test_tiny_inference_shapes_and_masks(self):
+        from bigdl_tpu.models.maskrcnn import MaskRCNN, MaskRCNNConfig
+
+        cfg = MaskRCNNConfig.tiny()
+        model = MaskRCNN(cfg, seed=0)
+        imgs = np.random.RandomState(0).rand(
+            2, cfg.image_size, cfg.image_size, 3).astype(np.float32)
+        out = model(imgs)
+        D = cfg.detections_per_img
+        assert out["boxes"].shape == (2, D, 4)
+        assert out["scores"].shape == (2, D)
+        assert out["labels"].shape == (2, D)
+        assert out["masks"].shape == (2, D, cfg.mask_size, cfg.mask_size)
+        assert (out["labels"] >= 0).all() \
+            and (out["labels"] < cfg.num_classes).all()
+        assert np.isfinite(out["masks"]).all()
+        assert (out["masks"] >= 0).all() and (out["masks"] <= 1).all()
+        # boxes inside the image
+        v = out["scores"] > 0
+        if v.any():
+            bx = out["boxes"][v]
+            assert (bx >= 0).all() and (bx <= cfg.image_size).all()
+
+
+class TestRoiAlignModule:
+    def test_module_wrapper_table_input(self):
+        from bigdl_tpu.nn.layers.detection import RoiAlign
+        feats = np.ones((1, 4, 4, 2), np.float32)
+        boxes = np.array([[0, 0, 4, 4]], np.float32)
+        out = RoiAlign(output_size=2).forward(
+            [jnp.asarray(feats), jnp.asarray(boxes),
+             np.zeros(1, np.int64)])
+        assert np.asarray(out).shape == (1, 2, 2, 2)
+        np.testing.assert_allclose(np.asarray(out), 1.0, rtol=1e-6)
