@@ -75,15 +75,28 @@ class InferenceOptimizer:
         return compiled
 
     @staticmethod
+    def _quantize_convs(model):
+        """INT8 weight-only conv+linear surgery (nn.quantized)."""
+        import copy
+        from bigdl_tpu.nn.quantized import quantize_model
+        return _CompiledModel(quantize_model(copy.deepcopy(model)))
+
+    @staticmethod
     def optimize(model, x: np.ndarray,
-                 latency_sample_num: int = 10) -> Dict[str, dict]:
+                 latency_sample_num: int = 10,
+                 validation_data=None,
+                 metric: Optional[Callable] = None) -> Dict[str, dict]:
         """Try the available pipelines, time them, return a report (ref:
-        InferenceOptimizer.optimize's trial table)."""
+        InferenceOptimizer.optimize's trial table: latency per pipeline,
+        plus an accuracy/metric column when ``validation_data=(x, y)``
+        and a ``metric(pred, y) -> float`` are given)."""
+        model = getattr(model, "module", model)
         report = {}
         for name, builder in {
             "original(jit)": lambda: InferenceOptimizer.trace(model),
             "bf16": lambda: InferenceOptimizer.quantize(model, "bf16"),
             "int8": lambda: InferenceOptimizer.quantize(model, "int8"),
+            "int8-conv": lambda: InferenceOptimizer._quantize_convs(model),
             "int4": lambda: InferenceOptimizer.quantize(model, "sym_int4"),
         }.items():
             try:
@@ -93,11 +106,30 @@ class InferenceOptimizer:
                 for _ in range(latency_sample_num):
                     m.forward(x)
                 dt = (time.perf_counter() - t0) / latency_sample_num
-                report[name] = {"latency_ms": dt * 1000, "model": m,
-                                "status": "successful"}
+                entry = {"latency_ms": dt * 1000, "model": m,
+                         "status": "successful"}
+                if validation_data is not None and metric is not None:
+                    try:
+                        vx, vy = validation_data
+                        entry["metric"] = float(metric(m.forward(vx), vy))
+                    except Exception as me:   # keep the timed pipeline
+                        entry["metric_error"] = str(me)
+                report[name] = entry
             except Exception as e:  # pipeline not applicable to model
                 report[name] = {"status": f"failed: {e}"}
         return report
+
+    @staticmethod
+    def summary(report: Dict[str, dict]) -> str:
+        """The reference prints a trial table; same here."""
+        lines = [f"{'pipeline':<16} {'latency(ms)':>12} {'metric':>10} "
+                 f"status"]
+        for name, e in report.items():
+            lat = (f"{e['latency_ms']:.3f}"
+                   if "latency_ms" in e else "-")
+            met = (f"{e['metric']:.4f}" if "metric" in e else "-")
+            lines.append(f"{name:<16} {lat:>12} {met:>10} {e['status']}")
+        return "\n".join(lines)
 
     @staticmethod
     def get_best_model(report: Dict[str, dict]):

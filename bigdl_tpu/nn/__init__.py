@@ -54,3 +54,5 @@ from bigdl_tpu.nn.criterion import (
     MultiMarginCriterion, ParallelCriterion, PoissonCriterion,
     SmoothL1Criterion, SoftMarginCriterion, SoftmaxWithCriterion,
     TimeDistributedCriterion)
+
+from bigdl_tpu.nn import quantized  # noqa: E402,F401  (ref: nn/quantized INT8 layers)

@@ -1,0 +1,178 @@
+"""nn.quantized — INT8 post-training-quantized inference layers.
+
+Reference: ``S:dllib/nn/quantized/`` (quantized.Linear,
+quantized.SpatialConvolution, Quantizer) over the BigQuant native INT8
+gemm/conv kernels (SURVEY.md §2.3). Semantics kept from the reference:
+**weight-only** symmetric INT8 with per-output-channel scales, computed
+once at conversion time (``Quantizer.quantize(model)``); activations stay
+float.
+
+TPU mapping: Linear dispatches to the Pallas INT8 matmul
+(llm.kernels.int8_matmul — the BigQuant gemm equivalent) on TPU;
+SpatialConvolution stores int8 weights (4x smaller checkpoints/HBM) and
+dequantizes per-tile into the bf16 ``lax.conv_general_dilated`` — XLA
+fuses the dequant into the conv's weight read, which is the profitable
+formulation while convs are MXU/bandwidth-bound on bf16 (a dedicated
+Pallas int8-conv is a further step, noted in the docstring not faked).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.nn.module import Module, TensorModule
+
+
+def _quantize_per_channel(w: np.ndarray):
+    """(O, ...) weights → int8 (O, ...) + f32 (O,) per-channel scales."""
+    flat = w.reshape(w.shape[0], -1)
+    amax = np.abs(flat).max(axis=1)
+    scale = (amax / 127.0).astype(np.float32)
+    safe = np.where(scale > 0, scale, 1.0)
+    q = np.round(flat / safe[:, None]).clip(-127, 127).astype(np.int8)
+    return q.reshape(w.shape), scale
+
+
+class Linear(TensorModule):
+    """quantized.Linear (ref: nn/quantized/Linear.scala)."""
+
+    def __init__(self, input_size: int, output_size: int,
+                 with_bias: bool = True, name: Optional[str] = None):
+        super().__init__(name)
+        self.input_size = input_size
+        self.output_size = output_size
+        self.with_bias = with_bias
+
+    @classmethod
+    def from_float(cls, linear) -> "Linear":
+        w = np.asarray(linear._params["weight"], np.float32)  # (O, I)
+        mod = cls(linear.input_size, linear.output_size,
+                  with_bias="bias" in linear._params,
+                  name=getattr(linear, "name", None))
+        q, scale = _quantize_per_channel(w)
+        # k-major TPU layout for the Pallas kernel: (I, O)
+        mod.add_state("q", jnp.asarray(np.ascontiguousarray(q.T)))
+        mod.add_state("scale", jnp.asarray(scale))
+        if mod.with_bias:
+            mod.add_param("bias", jnp.asarray(linear._params["bias"]))
+        return mod
+
+    def _apply(self, params, states, x, *, training, rng):
+        shape = x.shape
+        x2 = x.reshape(-1, shape[-1])
+        q, scale = states["q"], states["scale"]
+        from bigdl_tpu.llm.ggml.quantize import QK
+        k = q.shape[0]
+        # the Pallas kernel's scale layout is (K/QK, N): only exact for
+        # QK-aligned in_features; others use the XLA dequant path
+        if jax.default_backend() == "tpu" and k % QK == 0:
+            from bigdl_tpu.llm.kernels import int8_matmul
+            # per-channel scale == per-QK-group scale with every group of
+            # a column equal: broadcast to the kernel's (K/QK, N) layout
+            scale_t = jnp.broadcast_to(scale[None, :],
+                                       (k // QK, q.shape[1]))
+            y = int8_matmul(x2, q, scale_t, out_dtype=x.dtype)
+        else:
+            w = q.astype(jnp.float32) * scale[None, :]
+            y = (x2 @ w).astype(x.dtype)
+        if self.with_bias:
+            y = y + params["bias"].astype(y.dtype)
+        return y.reshape(shape[:-1] + (self.output_size,))
+
+    def __repr__(self):
+        return f"quantized.Linear({self.input_size} -> {self.output_size})"
+
+
+class SpatialConvolution(TensorModule):
+    """quantized.SpatialConvolution (ref: nn/quantized/SpatialConvolution
+    .scala): INT8 weights + per-output-channel scales, float activations.
+    """
+
+    def __init__(self, n_input: int, n_output: int, kw: int, kh: int,
+                 dw: int = 1, dh: int = 1, pad_w: int = 0, pad_h: int = 0,
+                 with_bias: bool = True, format: str = "NCHW",
+                 n_group: int = 1, dilation_w: int = 1,
+                 dilation_h: int = 1, name: Optional[str] = None):
+        super().__init__(name)
+        self.n_input, self.n_output = n_input, n_output
+        self.kw, self.kh, self.dw, self.dh = kw, kh, dw, dh
+        self.pad_w, self.pad_h = pad_w, pad_h          # -1 = SAME
+        self.with_bias = with_bias
+        self.format = format
+        self.n_group = n_group
+        self.dilation_w, self.dilation_h = dilation_w, dilation_h
+
+    @classmethod
+    def from_float(cls, conv) -> "SpatialConvolution":
+        """Quantize one of our nn.SpatialConvolution layers."""
+        w = np.asarray(conv._params["weight"], np.float32)  # (O, I, kh, kw)
+        mod = cls(conv.n_input_plane, conv.n_output_plane,
+                  conv.kernel_w, conv.kernel_h,
+                  conv.stride_w, conv.stride_h,
+                  conv.pad_w, conv.pad_h,             # -1 (SAME) kept
+                  with_bias="bias" in conv._params,
+                  format=getattr(conv, "format", "NCHW"),
+                  n_group=getattr(conv, "n_group", 1),
+                  dilation_w=getattr(conv, "dilation_w", 1),
+                  dilation_h=getattr(conv, "dilation_h", 1),
+                  name=getattr(conv, "name", None))
+        q, scale = _quantize_per_channel(w)
+        mod.add_state("q", jnp.asarray(q))
+        mod.add_state("scale", jnp.asarray(scale))
+        if mod.with_bias:
+            mod.add_param("bias", jnp.asarray(conv._params["bias"]))
+        return mod
+
+    def _apply(self, params, states, x, *, training, rng):
+        # weight-only dequant; XLA fuses the int8->bf16 multiply into the
+        # conv weight read (weights are the small operand)
+        w = states["q"].astype(x.dtype) \
+            * states["scale"].astype(x.dtype)[:, None, None, None]
+        dn = ("NCHW", "OIHW", "NCHW") if self.format == "NCHW" \
+            else ("NHWC", "OIHW", "NHWC")
+        padding = ("SAME" if self.pad_h == -1 or self.pad_w == -1
+                   else [(self.pad_h, self.pad_h),
+                         (self.pad_w, self.pad_w)])
+        y = jax.lax.conv_general_dilated(
+            x, w, (self.dh, self.dw), padding,
+            rhs_dilation=(self.dilation_h, self.dilation_w),
+            dimension_numbers=dn,
+            feature_group_count=self.n_group)
+        if self.with_bias:
+            b = params["bias"].astype(y.dtype)
+            y = y + (b[:, None, None] if self.format == "NCHW" else b)
+        return y
+
+    def __repr__(self):
+        return (f"quantized.SpatialConvolution({self.n_input} -> "
+                f"{self.n_output}, {self.kw}x{self.kh})")
+
+
+def quantize_model(model: Module) -> Module:
+    """Quantizer.quantize equivalent (ref: nn/quantized/Quantizer.scala):
+    swap every float Linear / SpatialConvolution for its INT8 twin,
+    in place, recursively."""
+    import bigdl_tpu.nn as nn
+
+    def convert(m: Module):
+        for key, child in list(m._modules.items()):
+            if type(child) is nn.Linear:
+                repl = Linear.from_float(child)
+            elif type(child) is nn.SpatialConvolution:
+                # exact type only: subclasses (Dilated/Shared...) may
+                # carry semantics from_float does not model — they keep
+                # their float weights rather than quantize wrongly
+                repl = SpatialConvolution.from_float(child)
+            else:
+                convert(child)
+                continue
+            m._modules[key] = repl
+            if hasattr(m, "_ordered"):
+                m._ordered[int(key)] = repl
+        return m
+
+    return convert(model)

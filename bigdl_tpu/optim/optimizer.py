@@ -83,6 +83,7 @@ class BaseOptimizer:
         self._clip_const: Optional[tuple] = None
         self._step_fn = None
         self._drop_percentage = 0.0  # parity knob; N/A under SPMD
+        self._max_retry: Optional[int] = None
 
     # -- builder API (ref: Optimizer setters) --------------------------------
     def set_optim_method(self, method: OptimMethod):
@@ -135,6 +136,16 @@ class BaseOptimizer:
         self._step_fn = None
         return self
 
+    def set_max_retry(self, n: int):
+        """Iteration-retry budget (ref: DistriOptimizer catches iteration
+        failures and rebuilds executor caches from the last in-memory
+        state, up to maxRetry). Here: on any exception during the train
+        loop, restore from the newest on-disk checkpoint (set_checkpoint)
+        — or the initial weights when none exists — and replay. Also
+        settable via config key ``bigdl.optimizer.max.retry``."""
+        self._max_retry = int(n)
+        return self
+
     def set_drop_module_property(self, *a, **k):  # parity no-op
         logger.warning("straggler dropPercentage has no analog in compiled "
                        "SPMD execution; ignoring")
@@ -175,6 +186,69 @@ class BaseOptimizer:
 
     # -- the driver loop ------------------------------------------------------
     def optimize(self) -> Module:
+        from bigdl_tpu.utils.conf import conf
+
+        retries = self._max_retry if self._max_retry is not None \
+            else (conf.get_int("bigdl.optimizer.max.retry", 0) or 0)
+        attempt = 0
+        # snapshot for checkpoint-less recovery: initial weights AND the
+        # iteration counters (a replay from fresh weights with advanced
+        # counters would silently under-train)
+        if retries:
+            import copy
+            init_params = jax.tree_util.tree_map(
+                np.asarray, self.model.parameters_dict())
+            init_states = jax.tree_util.tree_map(
+                np.asarray, self.model.states_dict())
+            init_train_state = copy.deepcopy(dict(self.state))
+            init_host_state = copy.deepcopy(
+                self.optim_method.get_state())
+            self._initial_snapshot = (init_params, init_states,
+                                      init_train_state, init_host_state)
+        while True:
+            try:
+                return self._optimize_once()
+            except KeyboardInterrupt:
+                raise
+            except Exception as e:  # noqa: BLE001 — the retry contract
+                attempt += 1
+                if attempt > retries:
+                    raise
+                logger.warning(
+                    "training iteration failed (%s: %s); retry %d/%d "
+                    "from the last checkpoint", type(e).__name__, e,
+                    attempt, retries)
+                self._restore_latest_checkpoint()
+
+    def _restore_latest_checkpoint(self):
+        """Reference recovery semantics: resume from the newest persisted
+        checkpoint if set_checkpoint was configured; else restart from
+        the live module's current weights (the initial state)."""
+        if self._checkpoint_path:
+            tags = []
+            for name in os.listdir(self._checkpoint_path):
+                if name.startswith("optim."):
+                    tag = name[len("optim."):]
+                    try:
+                        ep, ne = tag.split(".")
+                        tags.append((int(ep), int(ne), tag))
+                    except ValueError:
+                        continue
+            if tags:
+                tag = max(tags)[2]
+                self.resume_from_checkpoint(self._checkpoint_path, tag)
+                return
+        # no persisted checkpoint: true restart — initial weights AND
+        # initial counters/trigger state
+        p0, s0, ts0, hs0 = self._initial_snapshot
+        self.model.load_parameters_dict(p0)
+        self.model.load_states_dict(s0)
+        self.state.clear()
+        self.state.update(ts0)
+        self.optim_method.load_state(hs0)
+        self._step_fn = None
+
+    def _optimize_once(self) -> Module:
         params = self._replicate(self.model.parameters_dict())
         states = self._replicate(self.model.states_dict())
         if self._resume_opt_state is not None:

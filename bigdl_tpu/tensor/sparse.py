@@ -76,6 +76,95 @@ class SparseTensor:
         return jax.ops.segment_sum(contrib, rows,
                                    num_segments=self.shape[0])
 
+    # -- elementwise / structural ops (ref SparseTensor op surface:
+    # add, narrow, concat, transpose, apply/map, reductions) ---------------
+    def coalesce(self) -> "SparseTensor":
+        """Merge duplicate indices (sum their values), sort row-major."""
+        idx = np.asarray(self.indices)
+        vals = np.asarray(self.values)
+        flat = np.ravel_multi_index(tuple(idx.T), self.shape)
+        order = np.argsort(flat, kind="stable")
+        flat, vals = flat[order], vals[order]
+        uniq, start = np.unique(flat, return_index=True)
+        summed = np.add.reduceat(vals, start)
+        new_idx = np.stack(np.unravel_index(uniq, self.shape), axis=1)
+        return SparseTensor(new_idx, summed, self.shape)
+
+    def add(self, other) -> "SparseTensor":
+        """sparse + sparse (same shape) → coalesced sparse."""
+        if not isinstance(other, SparseTensor):
+            raise TypeError("add expects a SparseTensor; use to_dense() "
+                            "for dense arithmetic")
+        if other.shape != self.shape:
+            raise ValueError(f"shape mismatch {self.shape} {other.shape}")
+        idx = jnp.concatenate([self.indices, other.indices], 0)
+        vals = jnp.concatenate([self.values.astype(jnp.result_type(
+            self.values, other.values)),
+            other.values.astype(jnp.result_type(self.values,
+                                                other.values))], 0)
+        return SparseTensor(idx, vals, self.shape).coalesce()
+
+    def mul_scalar(self, a) -> "SparseTensor":
+        return SparseTensor(self.indices, self.values * a, self.shape)
+
+    def mul_dense(self, dense) -> "SparseTensor":
+        """Elementwise multiply by a dense array (sparsity preserved)."""
+        d = jnp.asarray(dense)
+        if d.shape != self.shape:
+            raise ValueError(f"shape mismatch {self.shape} {d.shape}")
+        picked = d[tuple(self.indices.T)]
+        return SparseTensor(self.indices,
+                            self.values * picked, self.shape)
+
+    def transpose(self) -> "SparseTensor":
+        if self.ndim != 2:
+            raise ValueError("transpose is 2-D only")
+        return SparseTensor(self.indices[:, ::-1], self.values,
+                            (self.shape[1], self.shape[0]))
+
+    def narrow(self, dim: int, start: int, length: int) -> "SparseTensor":
+        """Slice ``[start, start+length)`` along ``dim`` (0-based; the
+        reference's 1-based narrow is the Tensor-facade's concern)."""
+        keep = (self.indices[:, dim] >= start) \
+            & (self.indices[:, dim] < start + length)
+        keep = np.asarray(keep)
+        idx = np.asarray(self.indices)[keep]
+        idx[:, dim] -= start
+        shape = list(self.shape)
+        shape[dim] = length
+        return SparseTensor(idx, np.asarray(self.values)[keep], shape)
+
+    @staticmethod
+    def concat(tensors: Sequence["SparseTensor"],
+               dim: int = 0) -> "SparseTensor":
+        """Concatenate along ``dim`` (ref: SparseTensor.concat backing
+        SparseJoinTable)."""
+        base = tensors[0]
+        for t in tensors[1:]:
+            for d in range(base.ndim):
+                if d != dim and t.shape[d] != base.shape[d]:
+                    raise ValueError("non-concat dims must match")
+        parts_i, parts_v, off = [], [], 0
+        for t in tensors:
+            idx = np.asarray(t.indices).copy()
+            idx[:, dim] += off
+            parts_i.append(idx)
+            parts_v.append(np.asarray(t.values))
+            off += t.shape[dim]
+        shape = list(base.shape)
+        shape[dim] = off
+        return SparseTensor(np.concatenate(parts_i, 0),
+                            np.concatenate(parts_v, 0), shape)
+
+    def sum(self) -> jnp.ndarray:
+        return jnp.sum(self.values)
+
+    def apply(self, fn) -> "SparseTensor":
+        """Map ``fn`` over the stored values (ref applyFun; zeros stay
+        zero, so fn must satisfy fn(0)=0 for dense equivalence — the
+        reference has the same contract)."""
+        return SparseTensor(self.indices, fn(self.values), self.shape)
+
     def __repr__(self):
         return (f"SparseTensor(shape={self.shape}, nnz={self.nnz}, "
                 f"dtype={self.dtype})")

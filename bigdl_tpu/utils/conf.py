@@ -1,0 +1,142 @@
+"""Layered configuration system.
+
+Reference: BigDL's property/conf/env layering (SURVEY.md §5 config row):
+Java system properties (``bigdl.coreNumber``, ``bigdl.engineType``,
+``bigdl.localMode``, ...), SparkConf keys injected by
+``Engine.createSparkConf``, the shipped ``conf/spark-bigdl.conf`` defaults
+file, and env vars for the native libs — resolved lowest-to-highest:
+defaults file < environment < explicit ``set()`` calls < call-site kwargs.
+
+TPU translation, same four layers:
+
+1. **defaults** — baked-in table below (+ an optional
+   ``bigdl-tpu.conf`` properties file: ``key=value`` lines, ``#``
+   comments — the spark-bigdl.conf analog; path from
+   ``BIGDL_TPU_CONF`` or ``./bigdl-tpu.conf``);
+2. **environment** — ``BIGDL_TPU_<KEY>`` with dots mapped to
+   underscores (``bigdl.engine.type`` ← ``BIGDL_TPU_ENGINE_TYPE``);
+3. **programmatic** — ``conf.set("bigdl.engine.type", "cpu")``
+   (the System.setProperty analog);
+4. **call-site kwargs** — Engine.init(...) arguments win outright.
+
+Typed getters (``get_int``/``get_bool``/``get_float``) validate at read
+time, replacing the reference's scattered ad-hoc parses.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict, Optional
+
+_DEFAULTS: Dict[str, str] = {
+    "bigdl.engine.type": "",            # "" = auto (jax.default_backend)
+    "bigdl.mesh.axes": "data",          # comma-separated axis names
+    "bigdl.mesh.shape": "",             # comma-separated ints; "" = auto
+    "bigdl.coordinator.address": "",
+    "bigdl.num.processes": "",
+    "bigdl.process.id": "",
+    "bigdl.check.singleton": "false",
+    "bigdl.log.level": "INFO",
+    "bigdl.optimizer.max.retry": "0",   # iteration-retry attempts
+    "bigdl.checkpoint.overwrite": "true",
+}
+
+
+def _env_key(key: str) -> str:
+    return "BIGDL_TPU_" + key.replace("bigdl.", "", 1) \
+        .replace(".", "_").upper()
+
+
+class BigDLConf:
+    """The layered store. One process-global instance lives at
+    ``bigdl_tpu.utils.conf.conf`` (the System-properties analog)."""
+
+    def __init__(self, conf_file: Optional[str] = None):
+        self._lock = threading.RLock()
+        self._file_layer: Dict[str, str] = {}
+        self._set_layer: Dict[str, str] = {}
+        path = conf_file or os.environ.get("BIGDL_TPU_CONF",
+                                           "bigdl-tpu.conf")
+        if path and os.path.exists(path):
+            self.load_file(path)
+
+    # -- layers --------------------------------------------------------------
+    def load_file(self, path: str) -> "BigDLConf":
+        """Parse a ``key=value`` properties file (# comments)."""
+        with self._lock, open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line or line.startswith("#") or "=" not in line:
+                    continue
+                k, v = line.split("=", 1)
+                self._file_layer[k.strip()] = v.strip()
+        return self
+
+    def set(self, key: str, value: Any) -> "BigDLConf":
+        with self._lock:
+            self._set_layer[key] = str(value)
+        return self
+
+    def unset(self, key: str) -> "BigDLConf":
+        with self._lock:
+            self._set_layer.pop(key, None)
+        return self
+
+    # -- resolution ----------------------------------------------------------
+    def get(self, key: str, default: Optional[str] = None) -> Optional[str]:
+        with self._lock:
+            if key in self._set_layer:
+                return self._set_layer[key]
+            env = os.environ.get(_env_key(key))
+            if env is not None:
+                return env
+            if key in self._file_layer:
+                return self._file_layer[key]
+            if key in _DEFAULTS:
+                return _DEFAULTS[key] or default
+            return default
+
+    def get_int(self, key: str, default: Optional[int] = None
+                ) -> Optional[int]:
+        v = self.get(key)
+        if v in (None, ""):
+            return default
+        try:
+            return int(v)
+        except ValueError:
+            raise ValueError(f"config {key}={v!r} is not an int") from None
+
+    def get_float(self, key: str, default: Optional[float] = None
+                  ) -> Optional[float]:
+        v = self.get(key)
+        if v in (None, ""):
+            return default
+        try:
+            return float(v)
+        except ValueError:
+            raise ValueError(f"config {key}={v!r} is not a float") from None
+
+    def get_bool(self, key: str, default: bool = False) -> bool:
+        v = self.get(key)
+        if v in (None, ""):
+            return default
+        if v.lower() in ("true", "1", "yes", "on"):
+            return True
+        if v.lower() in ("false", "0", "no", "off"):
+            return False
+        raise ValueError(f"config {key}={v!r} is not a bool")
+
+    def get_list(self, key: str, default=None):
+        v = self.get(key)
+        if v in (None, ""):
+            return default
+        return [s.strip() for s in v.split(",") if s.strip()]
+
+    def effective(self) -> Dict[str, str]:
+        """Fully-resolved view of every known key (for logging/debug)."""
+        keys = set(_DEFAULTS) | set(self._file_layer) | set(self._set_layer)
+        return {k: self.get(k) for k in sorted(keys)}
+
+
+conf = BigDLConf()

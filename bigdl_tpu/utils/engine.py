@@ -76,7 +76,19 @@ class Engine:
         """
         import jax
 
+        from bigdl_tpu.utils.conf import conf
+
         with cls._lock:
+            # layered config (ref: Engine.createSparkConf property
+            # injection): call-site kwargs > conf.set > env > conf file
+            # > defaults — see bigdl_tpu.utils.conf
+            coordinator_address = (coordinator_address
+                                   or conf.get("bigdl.coordinator.address")
+                                   or None)
+            num_processes = (num_processes
+                             or conf.get_int("bigdl.num.processes"))
+            if process_id is None:
+                process_id = conf.get_int("bigdl.process.id")
             if coordinator_address or os.environ.get("JAX_COORDINATOR_ADDRESS"):
                 try:
                     jax.distributed.initialize(
@@ -87,13 +99,20 @@ class Engine:
                 except RuntimeError as e:  # already initialized
                     logger.debug("jax.distributed.initialize skipped: %s", e)
 
-            backend = engine_type or os.environ.get(
-                "BIGDL_ENGINE_TYPE", jax.default_backend()
-            )
+            backend = (engine_type or conf.get("bigdl.engine.type")
+                       or os.environ.get("BIGDL_ENGINE_TYPE",
+                                         jax.default_backend()))
             devices = jax.devices()
             local = jax.local_devices()
-            axes = tuple(mesh_axes) if mesh_axes else ("data",)
-            shape = tuple(mesh_shape) if mesh_shape else None
+            if mesh_axes:
+                axes = tuple(mesh_axes)
+            else:
+                axes = tuple(conf.get_list("bigdl.mesh.axes", ["data"]))
+            if mesh_shape:
+                shape = tuple(mesh_shape)
+            else:
+                cs = conf.get_list("bigdl.mesh.shape")
+                shape = tuple(int(v) for v in cs) if cs else None
             if shape is None:
                 shape = cls._default_shape(len(devices), axes)
             if math.prod(shape) != len(devices):

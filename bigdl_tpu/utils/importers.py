@@ -1,0 +1,258 @@
+"""Legacy checkpoint importers.
+
+Reference: ``S:dllib/utils/serializer`` + ``S:dllib/utils/tf`` +
+``CaffeLoader`` (SURVEY.md §2.3 serialization row): BigDL loads Caffe
+prototxt/caffemodel, TF checkpoints/frozen graphs and Torch t7 files
+into its own modules. The rebuild's own format is
+``utils.checkpoint`` (manifest + safetensors) and HF safetensors load
+directly (llm.transformers); this module covers the *legacy import
+breadth*:
+
+- :func:`load_torch_state_dict` — torch ``.pt``/``.pth`` state dicts
+  (``weights_only=True``: no pickled code execution) into a Module tree;
+- :func:`load_tf_checkpoint` — TF2 checkpoint variables (via the baked-in
+  tensorflow) into a Module tree;
+- :class:`CaffeLoader` — reads ``.caffemodel`` layer blobs with a
+  built-in protobuf **wire-format** parser (no caffe/protobuf-schema
+  dependency): NetParameter's repeated LayerParameter (field 100; V1
+  ``layers`` field 2 also handled), each with name (1), type (2) and
+  BlobProto blobs (7) carrying shape (7)/legacy num..width (1-4) and
+  packed float data (5).
+
+Name mapping: an explicit ``mapping`` {our_param_path: their_name} wins;
+otherwise parameters are matched positionally by shape, the strategy the
+reference's loaders fall back to for unnamed graphs.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+# ---------------------------------------------------------------------------
+# shared: assign a flat {name: array} set into a Module tree
+# ---------------------------------------------------------------------------
+
+def _flatten_params(tree, prefix=""):
+    out = []
+    if isinstance(tree, dict):
+        for k in tree:
+            out += _flatten_params(tree[k], f"{prefix}{k}.")
+    else:
+        out.append((prefix[:-1], tree))
+    return out
+
+
+def _assign(model, foreign: Dict[str, np.ndarray],
+            mapping: Optional[Dict[str, str]] = None,
+            transpose_linear: bool = False) -> int:
+    """Write foreign arrays into ``model``'s params. Returns #assigned."""
+    import jax.numpy as jnp
+
+    params = model.parameters_dict()
+    flat = _flatten_params(params)
+    used = set()
+    n = 0
+
+    def fit(ours_shape, arr):
+        if tuple(arr.shape) == tuple(ours_shape):
+            return arr
+        if transpose_linear and arr.ndim == 2 \
+                and tuple(arr.T.shape) == tuple(ours_shape):
+            return arr.T
+        return None
+
+    by_name = dict(foreign)
+    # reserve every explicitly-mapped tensor FIRST so the positional
+    # matcher can never consume one that a later parameter's mapping
+    # entry names (which would double-assign it)
+    if mapping:
+        used.update(mapping.values())
+
+    def write(path, leaf, src):
+        node = params
+        parts = path.split(".")
+        for p in parts[:-1]:
+            node = node[p]
+        node[parts[-1]] = jnp.asarray(np.ascontiguousarray(src),
+                                      leaf.dtype)
+
+    for path, leaf in flat:
+        src = None
+        if mapping and path in mapping:
+            cand = by_name.get(mapping[path])
+            if cand is None:
+                raise KeyError(f"mapping {path} -> {mapping[path]}: "
+                               "no such tensor in the checkpoint")
+            src = fit(leaf.shape, cand)
+            if src is None:
+                raise ValueError(
+                    f"{mapping[path]} shape {cand.shape} does not fit "
+                    f"{path} {leaf.shape}")
+        else:
+            for name, arr in by_name.items():
+                if name in used:
+                    continue
+                src = fit(leaf.shape, arr)
+                if src is not None:
+                    used.add(name)
+                    break
+        if src is not None:
+            write(path, leaf, src)
+            n += 1
+    model.load_parameters_dict(params)
+    return n
+
+
+# ---------------------------------------------------------------------------
+# torch / tf
+# ---------------------------------------------------------------------------
+
+def load_torch_state_dict(model, src,
+                          mapping: Optional[Dict[str, str]] = None,
+                          transpose_linear: bool = False) -> int:
+    """Load a torch checkpoint path / state_dict into ``model``."""
+    if isinstance(src, (str, bytes)):
+        import torch
+        sd = torch.load(src, map_location="cpu", weights_only=True)
+    else:
+        sd = src
+    if hasattr(sd, "state_dict"):
+        sd = sd.state_dict()
+    arrays = {k: (v.detach().cpu().numpy()
+                  if hasattr(v, "detach") else np.asarray(v))
+              for k, v in sd.items()}
+    return _assign(model, arrays, mapping, transpose_linear)
+
+
+def load_tf_checkpoint(model, path: str,
+                       mapping: Optional[Dict[str, str]] = None,
+                       transpose_linear: bool = True) -> int:
+    """Load TF2 checkpoint variables into ``model`` (TF kernels are
+    (in, out) — transposed into our (out, in) linears by default)."""
+    import tensorflow as tf
+
+    reader = tf.train.load_checkpoint(path)
+    arrays = {}
+    for name in reader.get_variable_to_shape_map():
+        if ".OPTIMIZER_SLOT" in name or name.startswith("_CHECKPOINT"):
+            continue
+        arrays[name] = np.asarray(reader.get_tensor(name))
+    return _assign(model, arrays, mapping, transpose_linear)
+
+
+# ---------------------------------------------------------------------------
+# caffe (hand-rolled protobuf wire parser — no caffe dependency)
+# ---------------------------------------------------------------------------
+
+def _read_varint(buf: memoryview, pos: int) -> Tuple[int, int]:
+    out = shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        out |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return out, pos
+        shift += 7
+
+
+def _fields(buf: memoryview):
+    """Yield (field_number, wire_type, value) over one message."""
+    pos = 0
+    n = len(buf)
+    while pos < n:
+        key, pos = _read_varint(buf, pos)
+        field, wt = key >> 3, key & 7
+        if wt == 0:                       # varint
+            val, pos = _read_varint(buf, pos)
+        elif wt == 1:                     # 64-bit
+            val = buf[pos:pos + 8]
+            pos += 8
+        elif wt == 2:                     # length-delimited
+            ln, pos = _read_varint(buf, pos)
+            val = buf[pos:pos + ln]
+            pos += ln
+        elif wt == 5:                     # 32-bit
+            val = buf[pos:pos + 4]
+            pos += 4
+        else:
+            raise ValueError(f"unsupported wire type {wt}")
+        yield field, wt, val
+
+
+def _parse_blob(buf: memoryview) -> np.ndarray:
+    shape: List[int] = []
+    legacy = {}
+    data = b""
+    for field, wt, val in _fields(buf):
+        if field == 7 and wt == 2:        # BlobShape { repeated int64 dim }
+            dims = []
+            for f2, w2, v2 in _fields(val):
+                if f2 == 1 and w2 == 0:
+                    dims.append(v2)
+                elif f2 == 1 and w2 == 2:  # packed
+                    p = 0
+                    while p < len(v2):
+                        d, p = _read_varint(v2, p)
+                        dims.append(d)
+            shape = dims
+        elif field == 5 and wt == 2:      # packed float data
+            data += bytes(val)
+        elif field == 5 and wt == 5:      # unpacked float
+            data += bytes(val)
+        elif field in (1, 2, 3, 4) and wt == 0:   # legacy num/ch/h/w
+            legacy[field] = val
+    arr = np.frombuffer(data, np.float32).copy()
+    if not shape and legacy:
+        shape = [legacy.get(i, 1) for i in (1, 2, 3, 4)]
+    if shape and int(np.prod(shape)) == arr.size:
+        arr = arr.reshape(shape)
+    return arr
+
+
+class CaffeLoader:
+    """Read ``.caffemodel`` layer blobs (ref: CaffeLoader.scala).
+
+    ``load(path)`` → {layer_name: [blob arrays]} (blob 0 = weights,
+    blob 1 = bias, Caffe convention); ``load_into(model, path, mapping)``
+    assigns them into a Module tree.
+    """
+
+    @staticmethod
+    def load(path: str) -> Dict[str, List[np.ndarray]]:
+        with open(path, "rb") as f:
+            buf = memoryview(f.read())
+        layers: Dict[str, List[np.ndarray]] = {}
+        for field, wt, val in _fields(buf):
+            # NetParameter: field 100 = repeated LayerParameter (V2),
+            # field 2 = repeated V1LayerParameter — same sub-layout for
+            # the pieces we need (name=1, blobs=6/7)
+            if field in (100, 2) and wt == 2:
+                name = f"layer{len(layers)}"
+                blobs: List[np.ndarray] = []
+                for f2, w2, v2 in _fields(val):
+                    if f2 == 1 and w2 == 2:
+                        name = bytes(v2).decode("utf-8", "replace")
+                    elif f2 in (6, 7) and w2 == 2:
+                        # V1 blobs = 6, V2 blobs = 7
+                        try:
+                            blobs.append(_parse_blob(v2))
+                        except Exception:   # not a blob (e.g. top name)
+                            continue
+                if blobs:
+                    layers[name] = blobs
+        return layers
+
+    @staticmethod
+    def load_into(model, path: str,
+                  mapping: Optional[Dict[str, str]] = None) -> int:
+        layers = CaffeLoader.load(path)
+        arrays: Dict[str, np.ndarray] = {}
+        for lname, blobs in layers.items():
+            for i, b in enumerate(blobs):
+                suffix = {0: "weight", 1: "bias"}.get(i, str(i))
+                arrays[f"{lname}.{suffix}"] = b
+        return _assign(model, arrays, mapping)

@@ -1,0 +1,51 @@
+"""Layered config system (ref: SURVEY.md §5 config row — properties/
+conf-file/env layering of the reference's Engine.createSparkConf)."""
+
+import os
+
+import pytest
+
+from bigdl_tpu.utils.conf import BigDLConf, _env_key
+
+
+class TestBigDLConf:
+    def test_defaults(self):
+        c = BigDLConf(conf_file="/nonexistent")
+        assert c.get("bigdl.mesh.axes") == "data"
+        assert c.get_bool("bigdl.check.singleton") is False
+        assert c.get_int("bigdl.optimizer.max.retry") == 0
+
+    def test_layering_file_env_set(self, tmp_path, monkeypatch):
+        f = tmp_path / "bigdl-tpu.conf"
+        f.write_text("# comment\nbigdl.mesh.axes=data,model\n"
+                     "bigdl.optimizer.max.retry=3\n")
+        c = BigDLConf(conf_file=str(f))
+        assert c.get_list("bigdl.mesh.axes") == ["data", "model"]
+        assert c.get_int("bigdl.optimizer.max.retry") == 3
+        # env overrides file
+        monkeypatch.setenv(_env_key("bigdl.optimizer.max.retry"), "5")
+        assert c.get_int("bigdl.optimizer.max.retry") == 5
+        # set() overrides env
+        c.set("bigdl.optimizer.max.retry", 7)
+        assert c.get_int("bigdl.optimizer.max.retry") == 7
+        c.unset("bigdl.optimizer.max.retry")
+        assert c.get_int("bigdl.optimizer.max.retry") == 5
+
+    def test_typed_getters_validate(self):
+        c = BigDLConf(conf_file="/nonexistent")
+        c.set("bigdl.num.processes", "not-a-number")
+        with pytest.raises(ValueError, match="not an int"):
+            c.get_int("bigdl.num.processes")
+        c.set("bigdl.check.singleton", "maybe")
+        with pytest.raises(ValueError, match="not a bool"):
+            c.get_bool("bigdl.check.singleton")
+
+    def test_effective_view(self):
+        c = BigDLConf(conf_file="/nonexistent")
+        c.set("bigdl.engine.type", "cpu")
+        eff = c.effective()
+        assert eff["bigdl.engine.type"] == "cpu"
+        assert "bigdl.mesh.axes" in eff
+
+    def test_env_key_mapping(self):
+        assert _env_key("bigdl.engine.type") == "BIGDL_TPU_ENGINE_TYPE"

@@ -1,0 +1,113 @@
+"""Legacy checkpoint importers (ref: CaffeLoader / TensorflowLoader /
+torch loaders under S:dllib/utils — SURVEY.md §2.3 serialization row)."""
+
+import struct
+
+import numpy as np
+import pytest
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.nn.module import set_seed
+from bigdl_tpu.utils.importers import (
+    CaffeLoader, load_tf_checkpoint, load_torch_state_dict)
+
+
+class TestTorchImport:
+    def test_state_dict_by_name_mapping_and_shape(self):
+        torch = pytest.importorskip("torch")
+        tmodel = torch.nn.Sequential(
+            torch.nn.Linear(8, 16), torch.nn.ReLU(),
+            torch.nn.Linear(16, 4))
+        set_seed(0)
+        ours = (nn.Sequential().add(nn.Linear(8, 16)).add(nn.ReLU())
+                .add(nn.Linear(16, 4)))
+        n = load_torch_state_dict(ours, tmodel.state_dict())
+        assert n == 4    # 2 weights + 2 biases
+        x = np.random.RandomState(0).randn(3, 8).astype(np.float32)
+        with torch.no_grad():
+            ref = tmodel(torch.tensor(x)).numpy()
+        got = np.asarray(ours.forward(x))
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+    def test_file_roundtrip_weights_only(self, tmp_path):
+        torch = pytest.importorskip("torch")
+        tmodel = torch.nn.Linear(5, 3)
+        p = str(tmp_path / "w.pt")
+        torch.save(tmodel.state_dict(), p)
+        set_seed(0)
+        ours = nn.Linear(5, 3)
+        assert load_torch_state_dict(ours, p) == 2
+        np.testing.assert_allclose(
+            np.asarray(ours.parameters_dict()["weight"]),
+            tmodel.weight.detach().numpy(), rtol=1e-6)
+
+
+class TestTFImport:
+    def test_tf2_checkpoint_variables(self, tmp_path):
+        tf = pytest.importorskip("tensorflow")
+        tf.keras.utils.set_random_seed(0)
+        dense = tf.keras.layers.Dense(4)
+        dense.build((None, 6))
+        ckpt = tf.train.Checkpoint(w=dense.kernel, b=dense.bias)
+        path = ckpt.write(str(tmp_path / "ck"))
+        set_seed(0)
+        ours = nn.Linear(6, 4)
+        n = load_tf_checkpoint(ours, path)
+        assert n == 2
+        # TF kernel (in, out) was transposed into our (out, in)
+        np.testing.assert_allclose(
+            np.asarray(ours.parameters_dict()["weight"]),
+            dense.kernel.numpy().T, rtol=1e-6)
+
+
+def _varint(v):
+    out = b""
+    while True:
+        b7 = v & 0x7F
+        v >>= 7
+        if v:
+            out += bytes([b7 | 0x80])
+        else:
+            return out + bytes([b7])
+
+
+def _ld(field, payload):     # length-delimited field
+    return _varint((field << 3) | 2) + _varint(len(payload)) + payload
+
+
+class TestCaffeLoader:
+    def _blob(self, arr):
+        shape = b"".join(_varint((1 << 3) | 0) + _varint(d)
+                         for d in arr.shape)
+        data = _ld(5, arr.astype("<f4").tobytes())
+        return _ld(7, shape) + data
+
+    def test_parse_synthetic_caffemodel(self, tmp_path):
+        """Hand-encode a NetParameter with one conv layer (weights +
+        bias blobs) and parse it back."""
+        w = np.arange(24, dtype=np.float32).reshape(2, 3, 2, 2)
+        b = np.array([0.5, -0.5], np.float32)
+        layer = (_ld(1, b"conv1") + _ld(2, b"Convolution")
+                 + _ld(7, self._blob(w)) + _ld(7, self._blob(b)))
+        net = _ld(1, b"testnet") + _ld(100, layer)
+        p = tmp_path / "net.caffemodel"
+        p.write_bytes(net)
+        layers = CaffeLoader.load(str(p))
+        assert "conv1" in layers
+        np.testing.assert_allclose(layers["conv1"][0], w)
+        np.testing.assert_allclose(layers["conv1"][1], b)
+
+    def test_load_into_model(self, tmp_path):
+        w = np.random.RandomState(0).randn(4, 3, 3, 3).astype(np.float32)
+        b = np.zeros(4, np.float32)
+        layer = (_ld(1, b"conv1") + _ld(2, b"Convolution")
+                 + _ld(7, self._blob(w)) + _ld(7, self._blob(b)))
+        p = tmp_path / "m.caffemodel"
+        p.write_bytes(_ld(100, layer))
+        set_seed(0)
+        model = nn.Sequential().add(
+            nn.SpatialConvolution(3, 4, 3, 3, name="conv1"))
+        n = CaffeLoader.load_into(model, str(p))
+        assert n == 2
+        got = np.asarray(model.parameters_dict()["0"]["weight"])
+        np.testing.assert_allclose(got, w, rtol=1e-6)

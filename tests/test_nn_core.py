@@ -423,3 +423,60 @@ class TestCheckpointContainer:
                                    atol=1e-4)
         np.testing.assert_allclose(np.asarray(new_states["running_mean"]),
                                    0.1 * mean, rtol=1e-4, atol=1e-5)
+
+
+class TestQuantizedLayers:
+    """nn.quantized INT8 inference (ref: S:dllib/nn/quantized + BigQuant)."""
+
+    def test_quantized_linear_close_to_float(self):
+        import jax.numpy as jnp
+        import numpy as np
+        import bigdl_tpu.nn as nn
+        from bigdl_tpu.nn.module import set_seed
+
+        set_seed(0)
+        lin = nn.Linear(32, 16)
+        qlin = nn.quantized.Linear.from_float(lin)
+        x = np.random.RandomState(0).randn(4, 32).astype(np.float32)
+        y = np.asarray(lin.forward(x))
+        yq = np.asarray(qlin.forward(x))
+        rel = np.abs(yq - y).max() / (np.abs(y).max() + 1e-6)
+        assert rel < 0.03, rel
+
+    def test_quantized_conv_close_to_float(self):
+        import numpy as np
+        import bigdl_tpu.nn as nn
+        from bigdl_tpu.nn.module import set_seed
+
+        set_seed(0)
+        conv = nn.SpatialConvolution(3, 8, 3, 3, 1, 1, 1, 1)
+        qconv = nn.quantized.SpatialConvolution.from_float(conv)
+        x = np.random.RandomState(1).randn(2, 3, 8, 8).astype(np.float32)
+        y = np.asarray(conv.forward(x))
+        yq = np.asarray(qconv.forward(x))
+        rel = np.abs(yq - y).max() / (np.abs(y).max() + 1e-6)
+        assert rel < 0.03, rel
+        assert np.asarray(qconv._states["q"]).dtype == np.int8
+
+    def test_quantize_model_surgery(self):
+        import numpy as np
+        import bigdl_tpu.nn as nn
+        from bigdl_tpu.nn.module import set_seed
+        from bigdl_tpu.nn.quantized import quantize_model
+
+        set_seed(0)
+        model = (nn.Sequential()
+                 .add(nn.SpatialConvolution(3, 4, 3, 3, 1, 1, 1, 1))
+                 .add(nn.ReLU())
+                 .add(nn.Flatten())
+                 .add(nn.Linear(4 * 6 * 6, 10)))
+        x = np.random.RandomState(2).randn(2, 3, 6, 6).astype(np.float32)
+        y = np.asarray(model.forward(x))
+        quantize_model(model)
+        kinds = [type(m).__module__ + "." + type(m).__name__
+                 for m in model.modules()]
+        assert any("quantized.SpatialConvolution" in k for k in kinds)
+        assert any("quantized.Linear" in k for k in kinds)
+        yq = np.asarray(model.forward(x))
+        rel = np.abs(yq - y).max() / (np.abs(y).max() + 1e-6)
+        assert rel < 0.05, rel

@@ -318,3 +318,66 @@ class TestLBFGS:
                                    [1.0 - t * 0.5, 2.0 + t * 0.5],
                                    rtol=1e-6)
         np.testing.assert_allclose(float(new["b"]), 3.0 - t, rtol=1e-6)
+
+
+class TestIterationRetry:
+    def test_retry_resumes_from_checkpoint(self, tmp_path):
+        """Inject a failure mid-training; with set_max_retry the driver
+        must restore the newest checkpoint and finish (ref:
+        DistriOptimizer maxRetry recovery)."""
+        import jax
+        import bigdl_tpu.nn as nn
+        from bigdl_tpu.nn.module import set_seed
+        from bigdl_tpu.optim.optimizer import LocalOptimizer
+        from bigdl_tpu.optim.trigger import Trigger
+
+        set_seed(0)
+        model = (nn.Sequential().add(nn.Linear(8, 16)).add(nn.ReLU())
+                 .add(nn.Linear(16, 4)).add(nn.LogSoftMax()))
+        rs = np.random.RandomState(0)
+        x = rs.randn(64, 8).astype(np.float32)
+        t = (rs.randint(0, 4, 64) + 1).astype(np.int32)
+        opt = LocalOptimizer(model, (x, t), nn.ClassNLLCriterion(),
+                             batch_size=16,
+                             end_trigger=Trigger.max_epoch(4))
+        opt.set_checkpoint(str(tmp_path), Trigger.every_epoch())
+        opt.set_max_retry(2)
+
+        # sabotage epoch 3's first batch once via the batch placer
+        orig = opt._place_batch
+        fired = {"n": 0}
+
+        def flaky(xb, tb):
+            if opt.state["epoch"] == 3 and fired["n"] == 0:
+                fired["n"] = 1
+                raise RuntimeError("injected executor failure")
+            return orig(xb, tb)
+
+        opt._place_batch = flaky
+        trained = opt.optimize()
+        assert fired["n"] == 1          # the failure really happened
+        assert opt.state["epoch"] >= 3  # and training still completed
+        y = np.asarray(trained.evaluate().forward(x[:4]))
+        assert y.shape == (4, 4)
+
+    def test_retry_budget_exhausted_reraises(self):
+        import bigdl_tpu.nn as nn
+        from bigdl_tpu.nn.module import set_seed
+        from bigdl_tpu.optim.optimizer import LocalOptimizer
+        from bigdl_tpu.optim.trigger import Trigger
+
+        set_seed(0)
+        model = nn.Sequential().add(nn.Linear(4, 2)).add(nn.LogSoftMax())
+        x = np.random.rand(8, 4).astype(np.float32)
+        t = np.ones(8, np.int32)
+        opt = LocalOptimizer(model, (x, t), nn.ClassNLLCriterion(),
+                             batch_size=4,
+                             end_trigger=Trigger.max_epoch(2))
+        opt.set_max_retry(1)
+
+        def always_fail(xb, tb):
+            raise RuntimeError("permanent failure")
+
+        opt._place_batch = always_fail
+        with pytest.raises(RuntimeError, match="permanent failure"):
+            opt.optimize()
