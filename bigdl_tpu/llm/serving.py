@@ -1,0 +1,263 @@
+"""LLM serving worker — continuous-batching generation service.
+
+Reference: ``P:llm/serving`` (the bigdl-llm FastChat model worker and the
+later vLLM integration, SURVEY.md §2.8 llm serving/tools row). The
+reference wraps its CPU models behind FastChat's worker API; the analog
+here is a TPU-shaped **continuous batching** loop:
+
+- requests enter a queue at any time (``submit`` returns a handle);
+- the scheduler packs up to ``max_batch`` active sequences into fixed
+  batch slots (static shapes: one compiled decode step serves every
+  composition of active requests);
+- each engine step decodes ONE token for every active slot via the
+  fused scan step (llm.models.llama.forward under jit, donated cache);
+  finished sequences (EOS or max_tokens) free their slot immediately and
+  a queued request takes it over — per-slot prefill writes its prompt
+  into the shared cache at the slot's rows (the "continuous" part:
+  no waiting for the whole batch to drain, the vLLM scheduling idea on
+  a slot-static cache);
+- results stream out through the handle (``get()`` blocks; ``tokens``
+  grows as the loop runs).
+
+Single-process and thread-driven: the engine loop runs on a background
+thread like ClusterServing's job loop; the reference's HTTP surface is a
+deployment shim over exactly this object.
+"""
+
+from __future__ import annotations
+
+import functools
+import queue
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class Request:
+    """Handle returned by :meth:`LLMServer.submit`."""
+
+    def __init__(self, prompt_ids: np.ndarray, max_new_tokens: int):
+        self.id = str(uuid.uuid4())
+        self.prompt_ids = np.asarray(prompt_ids, np.int32).ravel()
+        self.max_new_tokens = max_new_tokens
+        self.tokens: List[int] = []
+        self.done = threading.Event()
+
+    def get(self, timeout: Optional[float] = None) -> List[int]:
+        if not self.done.wait(timeout):
+            raise TimeoutError(f"request {self.id} still running")
+        return list(self.tokens)
+
+
+class LLMServer:
+    """Continuous-batching engine over a Llama-family model.
+
+    ``model`` is a LlamaForCausalLM (quantized or dense). ``max_batch``
+    fixes the compiled batch width; ``max_seq_len`` the per-slot cache
+    window.
+    """
+
+    def __init__(self, model, max_batch: int = 4, max_seq_len: int = 256,
+                 eos_token_id: Optional[int] = None):
+        from bigdl_tpu.llm.models.llama import forward, init_cache
+
+        self.model = model
+        self.cfg = model.config
+        self.max_batch = max_batch
+        self.max_seq_len = min(max_seq_len, model.max_cache_len)
+        self.eos_token_id = eos_token_id
+        self._queue: "queue.Queue[Request]" = queue.Queue()
+        self._slots: List[Optional[Request]] = [None] * max_batch
+        self._remaining = np.zeros(max_batch, np.int64)
+        self._cache = init_cache(self.cfg, max_batch, self.max_seq_len,
+                                 dtype=model.cache_dtype)
+        # per-slot write positions (the shared scalar cache["pos"] is
+        # replaced by a vector so slots advance independently)
+        self._pos = np.zeros(max_batch, np.int32)
+        self._last = jnp.zeros((max_batch, self.cfg.vocab_size),
+                               jnp.float32)
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._fwd = jax.jit(functools.partial(forward, cfg=self.cfg))
+        self._thread: Optional[threading.Thread] = None
+        self.steps = 0
+
+    # -- client API ----------------------------------------------------------
+    def submit(self, prompt_ids, max_new_tokens: int = 32) -> Request:
+        req = Request(prompt_ids, max_new_tokens)
+        if len(req.prompt_ids) + max_new_tokens > self.max_seq_len:
+            raise ValueError("prompt + max_new_tokens exceeds max_seq_len")
+        self._queue.put(req)
+        return req
+
+    def start(self) -> "LLMServer":
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=30)
+
+    # -- engine --------------------------------------------------------------
+    def _admit(self):
+        """Fill free slots from the queue; per-slot prefill."""
+        for i in range(self.max_batch):
+            if self._slots[i] is not None:
+                continue
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                return
+            self._prefill_slot(i, req)
+
+    def _prefill_slot(self, i: int, req: Request):
+        """Run the prompt through the model writing kv at slot i only.
+
+        Implementation detail: forward() operates on the whole batch, so
+        the prompt is broadcast into a (max_batch, T) token block but
+        only slot i's cache rows are kept (the other slots' K/V pages
+        are restored from the pre-call cache) — one compiled shape per
+        prompt length, fully static."""
+        t = len(req.prompt_ids)
+        toks = jnp.asarray(
+            np.broadcast_to(req.prompt_ids, (self.max_batch, t)))
+        start = int(self._pos[i])
+        positions = jnp.broadcast_to(jnp.arange(start, start + t),
+                                     (self.max_batch, t))
+        cache_in = dict(self._cache)
+        cache_in["pos"] = jnp.asarray(start, jnp.int32)
+        logits, new_cache = self._fwd(self.model.params, tokens=toks,
+                                      cache=cache_in, positions=positions)
+        row = jnp.arange(self.max_batch) == i
+        keep = row[None, :, None, None, None]
+        self._cache = {
+            "k": jnp.where(keep, new_cache["k"], self._cache["k"]),
+            "v": jnp.where(keep, new_cache["v"], self._cache["v"]),
+            "pos": self._cache["pos"],
+        }
+        self._last = self._last.at[i].set(logits[i, -1])
+        self._pos[i] = start + t
+        self._slots[i] = req
+        self._remaining[i] = req.max_new_tokens
+
+    def _step(self):
+        """Decode one token for every active slot."""
+        active = [i for i, r in enumerate(self._slots) if r is not None]
+        if not active:
+            return False
+        nxt = np.asarray(jnp.argmax(self._last, axis=-1), np.int32)
+        toks = jnp.asarray(nxt[:, None])
+        positions = jnp.asarray(self._pos[:, None])
+        # per-slot positions: slot rows beyond their own pos are masked
+        # by the causal test (slot_index <= q_position) in attention
+        cache_in = dict(self._cache)
+        cache_in["pos"] = jnp.asarray(0, jnp.int32)
+        # write kv at per-slot positions via positions arg; the cache
+        # update slices at pos 0..1 would collide — use scatter per slot
+        logits, new_cache = self._decode_scatter(toks, positions)
+        for i in active:
+            tok = int(nxt[i])
+            req = self._slots[i]
+            req.tokens.append(tok)
+            self._remaining[i] -= 1
+            self._pos[i] += 1
+            if (self.eos_token_id is not None and tok == self.eos_token_id) \
+                    or self._remaining[i] <= 0:
+                req.done.set()
+                self._slots[i] = None
+                # freed slot restarts at position 0: stale kv beyond the
+                # next request's own positions is masked by the causal
+                # valid test and overwritten as it advances
+                self._pos[i] = 0
+        self._last = logits
+        self.steps += 1
+        return True
+
+    def _decode_scatter(self, toks, positions):
+        """One decode step writing each slot's kv at its own position."""
+        if not hasattr(self, "_scatter_step"):
+            from bigdl_tpu.llm.models.llama import (_attention, _linear,
+                                                    rms_norm, rope)
+            cfg = self.cfg
+
+            def step(params, cache_k, cache_v, pos_vec, toks, last_mask):
+                x = params["embed_tokens"][toks[:, 0]][:, None]   # (B,1,H)
+                b = x.shape[0]
+                s_max = cache_k.shape[2]
+                positions = pos_vec                               # (B, 1)
+                valid = (jnp.arange(s_max)[None, :]
+                         <= positions[:, 0][:, None])             # (B, S)
+
+                def layer_step(carry, inputs):
+                    x, = carry
+                    lp, k_cache, v_cache = inputs
+                    h = rms_norm(x, lp["input_layernorm"],
+                                 cfg.rms_norm_eps)
+                    q = _linear(lp["q_proj"], h).reshape(
+                        b, 1, cfg.num_attention_heads, cfg.head_dim)
+                    k = _linear(lp["k_proj"], h).reshape(
+                        b, 1, cfg.num_key_value_heads, cfg.head_dim)
+                    v = _linear(lp["v_proj"], h).reshape(
+                        b, 1, cfg.num_key_value_heads, cfg.head_dim)
+                    q = rope(q, positions, cfg.rope_theta)
+                    k = rope(k, positions, cfg.rope_theta)
+                    # scatter each slot's kv at ITS position
+                    onehot = (jnp.arange(s_max)[None, :]
+                              == positions[:, 0][:, None])        # (B, S)
+                    k_cache = jnp.where(
+                        onehot[:, :, None, None],
+                        k.astype(k_cache.dtype), k_cache)
+                    v_cache = jnp.where(
+                        onehot[:, :, None, None],
+                        v.astype(v_cache.dtype), v_cache)
+                    attn = _attention(q, k_cache, v_cache, positions,
+                                      valid, cfg)
+                    x = x + _linear(lp["o_proj"], attn)
+                    h2 = rms_norm(x, lp["post_attention_layernorm"],
+                                  cfg.rms_norm_eps)
+                    if cfg.num_experts:
+                        from bigdl_tpu.llm.models.llama import _moe_ffn
+                        x = x + _moe_ffn(lp, h2, cfg)
+                    else:
+                        gate = jax.nn.silu(_linear(
+                            lp["gate_proj"], h2).astype(jnp.float32))
+                        up = _linear(lp["up_proj"], h2) \
+                            .astype(jnp.float32)
+                        x = x + _linear(lp["down_proj"],
+                                        (gate * up).astype(x.dtype))
+                    return (x,), (k_cache, v_cache)
+
+                (x,), (k_new, v_new) = jax.lax.scan(
+                    layer_step, (x,),
+                    (params["layers"], cache_k, cache_v))
+                x = rms_norm(x, params["norm"], cfg.rms_norm_eps)
+                head = params.get("lm_head")
+                if head is None:
+                    logits = x @ params["embed_tokens"].T.astype(x.dtype)
+                else:
+                    logits = _linear(head, x)
+                return logits[:, 0].astype(jnp.float32), k_new, v_new
+
+            self._scatter_step = jax.jit(step)
+
+        logits, k_new, v_new = self._scatter_step(
+            self.model.params, self._cache["k"], self._cache["v"],
+            positions, toks, None)
+        self._cache = {"k": k_new, "v": v_new,
+                       "pos": self._cache["pos"]}
+        return logits, None
+
+    def _loop(self):
+        while not self._stop.is_set():
+            with self._lock:
+                self._admit()
+                busy = self._step()
+            if not busy:
+                time.sleep(0.002)

@@ -56,3 +56,11 @@ from bigdl_tpu.nn.criterion import (
     TimeDistributedCriterion)
 
 from bigdl_tpu.nn import quantized  # noqa: E402,F401  (ref: nn/quantized INT8 layers)
+
+from bigdl_tpu.nn.layers.extra2 import (  # noqa: E402
+    ConvLSTMPeephole, GradientReversal, L1Penalty, MaskedFill,
+    MixtureTable, NarrowTable, Pack, Reverse,
+    SpatialContrastiveNormalization, SpatialDivisiveNormalization,
+    SpatialSubtractiveNormalization, Tile)
+from bigdl_tpu.nn.layers.detection import (  # noqa: E402
+    RoiAlign,)

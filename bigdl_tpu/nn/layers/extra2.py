@@ -1,0 +1,299 @@
+"""Additional reference-parity layers (round-3 zoo widening toward the
+reference's ~150-200 layer surface, SURVEY.md §2.3 layer-zoo row).
+
+Each class cites its reference file under ``S:dllib/nn``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.nn.module import TensorModule, Module
+
+
+class Reverse(TensorModule):
+    """Reverse along a dim (ref: nn/Reverse.scala; 1-based dim)."""
+
+    def __init__(self, dimension: int = 1, name: Optional[str] = None):
+        super().__init__(name)
+        self.dimension = dimension
+
+    def _apply(self, params, states, x, *, training, rng):
+        return jnp.flip(x, axis=self.dimension - 1)
+
+
+class Tile(TensorModule):
+    """Repeat along a dim (ref: nn/Tile.scala; 1-based dim)."""
+
+    def __init__(self, dimension: int = 1, copies: int = 2,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.dimension, self.copies = dimension, copies
+
+    def _apply(self, params, states, x, *, training, rng):
+        reps = [1] * x.ndim
+        reps[self.dimension - 1] = self.copies
+        return jnp.tile(x, reps)
+
+
+class Pack(TensorModule):
+    """Stack a table of tensors along a new dim (ref: nn/Pack.scala)."""
+
+    def __init__(self, dimension: int = 1, name: Optional[str] = None):
+        super().__init__(name)
+        self.dimension = dimension
+
+    def _apply(self, params, states, x, *, training, rng):
+        return jnp.stack(list(x), axis=self.dimension - 1)
+
+
+class MaskedFill(TensorModule):
+    """Fill where mask is set (ref: nn/MaskedFill-like; activity
+    [tensor, mask])."""
+
+    def __init__(self, value: float = 0.0, name: Optional[str] = None):
+        super().__init__(name)
+        self.value = value
+
+    def _apply(self, params, states, x, *, training, rng):
+        from bigdl_tpu.nn.layers.misc import _pair
+        t, mask = _pair(x)
+        return jnp.where(jnp.asarray(mask, bool), self.value, t)
+
+
+class L1Penalty(TensorModule):
+    """Identity forward; adds an L1 penalty to the loss via the module's
+    side-loss channel (ref: nn/L1Penalty.scala — adds |x| * weight to the
+    criterion). The penalty is exposed on ``last_penalty`` for training
+    drivers that sum side losses."""
+
+    def __init__(self, l1weight: float = 1e-4,
+                 size_average: bool = False,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.l1weight = l1weight
+        self.size_average = size_average
+        self.last_penalty = 0.0
+
+    def penalty_of(self, x):
+        """Functional penalty — what jitted training steps should add to
+        their loss (the module-attribute channel below is eager-only)."""
+        pen = jnp.sum(jnp.abs(x))
+        if self.size_average:
+            pen = pen / x.size
+        return pen * self.l1weight
+
+    def _apply(self, params, states, x, *, training, rng):
+        import jax.core
+        if training and not isinstance(x, jax.core.Tracer):
+            # eager path only: storing a tracer on the module would leak
+            # it out of the trace (jit/vjp re-run _apply); traced steps
+            # use penalty_of() explicitly
+            self.last_penalty = self.penalty_of(x)
+        return x
+
+
+class GradientReversal(TensorModule):
+    """Identity forward, -lambda * grad backward (ref: nn/
+    GradientReversal.scala — domain-adversarial training)."""
+
+    def __init__(self, the_lambda: float = 1.0,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.the_lambda = the_lambda
+
+    def _apply(self, params, states, x, *, training, rng):
+        lam = self.the_lambda
+
+        @jax.custom_vjp
+        def rev(v):
+            return v
+
+        def fwd(v):
+            return v, None
+
+        def bwd(_, g):
+            return (-lam * g,)
+
+        rev.defvjp(fwd, bwd)
+        return rev(x)
+
+
+class NarrowTable(Module):
+    """Select a slice of a table (ref: nn/NarrowTable.scala; 1-based)."""
+
+    def __init__(self, offset: int = 1, length: int = 1,
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self.offset, self.length = offset, length
+
+    def _apply(self, params, states, x, *, training, rng):
+        out = list(x)[self.offset - 1:self.offset - 1 + self.length]
+        return out[0] if self.length == 1 else out
+
+
+class MixtureTable(Module):
+    """Mixture-of-experts combiner (ref: nn/MixtureTable.scala):
+    activity [gates (B, E), expert table of E tensors (B, ...)] →
+    gate-weighted sum."""
+
+    def _apply(self, params, states, x, *, training, rng):
+        from bigdl_tpu.nn.layers.misc import _pair
+        gates, experts = _pair(x)
+        if hasattr(experts, "values"):                   # Table activity
+            experts = list(experts.values())
+        stacked = jnp.stack(list(experts), axis=1)       # (B, E, ...)
+        g = gates.reshape(gates.shape + (1,) * (stacked.ndim - 2))
+        return jnp.sum(stacked * g.astype(stacked.dtype), axis=1)
+
+
+def _box_filter(x, kernel: jnp.ndarray, format: str):
+    """Cross-plane 2-D filter with SAME padding: one (B, 1, H, W) map
+    averaged over ALL input channels (the reference's normalization
+    layers subtract/divide one cross-plane local statistic from every
+    channel; kernel weights are already sum-normalized, the channel
+    count divides here)."""
+    if format == "NHWC":
+        x = jnp.moveaxis(x, -1, 1)
+    b, c, h, w = x.shape
+    kh, kw = kernel.shape
+    k = jnp.broadcast_to(kernel[None, None], (1, c, kh, kw)) / c
+    y = jax.lax.conv_general_dilated(
+        x, k.astype(x.dtype), (1, 1), "SAME",
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    if format == "NHWC":
+        y = jnp.moveaxis(y, 1, -1)
+    return y
+
+
+class SpatialSubtractiveNormalization(TensorModule):
+    """Subtract the local weighted mean (ref: nn/
+    SpatialSubtractiveNormalization.scala)."""
+
+    def __init__(self, n_input_plane: int = 1, kernel=None,
+                 format: str = "NCHW", name: Optional[str] = None):
+        super().__init__(name)
+        k = np.asarray(kernel if kernel is not None
+                       else np.ones((9, 9)), np.float32)
+        self._kernel = jnp.asarray(k / k.sum())
+        self.format = format
+
+    def _apply(self, params, states, x, *, training, rng):
+        # divide by the kernel's actual coverage so borders (where SAME
+        # padding sees fewer pixels) are not under-estimated — the
+        # reference's coef-map normalization
+        ones = jnp.ones_like(x)
+        cov = _box_filter(ones, self._kernel, self.format)
+        mean = _box_filter(x, self._kernel, self.format) / cov
+        return x - mean
+
+
+class SpatialDivisiveNormalization(TensorModule):
+    """Divide by the local weighted std (ref: nn/
+    SpatialDivisiveNormalization.scala)."""
+
+    def __init__(self, n_input_plane: int = 1, kernel=None,
+                 threshold: float = 1e-4, format: str = "NCHW",
+                 name: Optional[str] = None):
+        super().__init__(name)
+        k = np.asarray(kernel if kernel is not None
+                       else np.ones((9, 9)), np.float32)
+        self._kernel = jnp.asarray(k / k.sum())
+        self.threshold = threshold
+        self.format = format
+
+    def _apply(self, params, states, x, *, training, rng):
+        ones = jnp.ones_like(x)
+        cov = _box_filter(ones, self._kernel, self.format)
+        var = _box_filter(x * x, self._kernel, self.format) / cov
+        std = jnp.sqrt(jnp.maximum(var, 0.0))
+        std = jnp.maximum(std, self.threshold)
+        return x / std
+
+
+class SpatialContrastiveNormalization(TensorModule):
+    """Subtractive then divisive (ref: nn/
+    SpatialContrastiveNormalization.scala)."""
+
+    def __init__(self, n_input_plane: int = 1, kernel=None,
+                 threshold: float = 1e-4, format: str = "NCHW",
+                 name: Optional[str] = None):
+        super().__init__(name)
+        self._sub = SpatialSubtractiveNormalization(
+            n_input_plane, kernel, format)
+        self._div = SpatialDivisiveNormalization(
+            n_input_plane, kernel, threshold, format)
+
+    def _apply(self, params, states, x, *, training, rng):
+        y = self._sub._apply(None, None, x, training=training, rng=rng)
+        return self._div._apply(None, None, y, training=training, rng=rng)
+
+
+class ConvLSTMPeephole(TensorModule):
+    """Convolutional LSTM cell sequence (ref: nn/ConvLSTMPeephole.scala):
+    input (B, T, C, H, W) → outputs (B, T, hidden, H, W). Gates are 2-D
+    convolutions; peephole connections multiply cell state into the
+    input/forget gates as in the reference."""
+
+    def __init__(self, input_size: int, output_size: int,
+                 kernel_i: int = 3, kernel_c: int = 3, stride: int = 1,
+                 with_peephole: bool = True, name: Optional[str] = None):
+        super().__init__(name)
+        self.input_size, self.output_size = input_size, output_size
+        self.ki, self.kc, self.stride = kernel_i, kernel_c, stride
+        self.with_peephole = with_peephole
+        from bigdl_tpu.nn.module import RNG
+        si = float(np.sqrt(1.0 / (input_size * kernel_i * kernel_i)))
+        sc = float(np.sqrt(1.0 / (output_size * kernel_c * kernel_c)))
+        # separate input (kernel_i, strided) and hidden (kernel_c,
+        # stride 1) convolutions, the reference's two-kernel layout
+        self.add_param("wi", jax.random.normal(
+            RNG.next_key(),
+            (4 * output_size, input_size, kernel_i, kernel_i),
+            jnp.float32) * si)
+        self.add_param("wh", jax.random.normal(
+            RNG.next_key(),
+            (4 * output_size, output_size, kernel_c, kernel_c),
+            jnp.float32) * sc)
+        self.add_param("b", jnp.zeros((4 * output_size,), jnp.float32))
+        if with_peephole:
+            for g in ("wci", "wcf", "wco"):
+                self.add_param(g, jnp.zeros((output_size, 1, 1),
+                                            jnp.float32))
+
+    def _apply(self, params, states, x, *, training, rng):
+        b, t, c, h, w = x.shape
+        o = self.output_size
+        st = self.stride
+        ho, wo = -(-h // st), -(-w // st)
+
+        def cell(carry, xt):
+            hprev, cprev = carry
+            zx = jax.lax.conv_general_dilated(
+                xt, params["wi"].astype(xt.dtype), (st, st), "SAME",
+                dimension_numbers=("NCHW", "OIHW", "NCHW"))
+            zh = jax.lax.conv_general_dilated(
+                hprev, params["wh"].astype(hprev.dtype), (1, 1), "SAME",
+                dimension_numbers=("NCHW", "OIHW", "NCHW"))
+            z = zx + zh + params["b"].astype(zx.dtype)[:, None, None]
+            zi, zf, zc, zo = jnp.split(z, 4, axis=1)
+            if self.with_peephole:
+                zi = zi + params["wci"] * cprev
+                zf = zf + params["wcf"] * cprev
+            i = jax.nn.sigmoid(zi)
+            f = jax.nn.sigmoid(zf)
+            cnew = f * cprev + i * jnp.tanh(zc)
+            if self.with_peephole:
+                zo = zo + params["wco"] * cnew
+            onew = jax.nn.sigmoid(zo)
+            hnew = onew * jnp.tanh(cnew)
+            return (hnew, cnew), hnew
+
+        h0 = jnp.zeros((b, o, ho, wo), x.dtype)
+        (_, _), ys = jax.lax.scan(cell, (h0, h0),
+                                  jnp.moveaxis(x, 1, 0))
+        return jnp.moveaxis(ys, 0, 1)                # (B, T, O, H/st, W/st)

@@ -208,3 +208,90 @@ class TestSparseStack:
         np.testing.assert_allclose(
             np.asarray(joined.to_dense()),
             [[1, 0, 0, 3], [0, 2, 4, 0]])
+
+
+class TestExtra2Layers:
+    def test_reverse_tile_pack(self):
+        import numpy as np
+        import bigdl_tpu.nn as nn
+        x = np.arange(6, dtype=np.float32).reshape(2, 3)
+        np.testing.assert_allclose(
+            np.asarray(nn.Reverse(2).forward(x)), x[:, ::-1])
+        np.testing.assert_allclose(
+            np.asarray(nn.Tile(1, 2).forward(x)),
+            np.tile(x, (2, 1)))
+        np.testing.assert_allclose(
+            np.asarray(nn.Pack(1).forward([x, x + 1])),
+            np.stack([x, x + 1], 0))
+
+    def test_masked_fill_and_narrow_table(self):
+        import numpy as np
+        import bigdl_tpu.nn as nn
+        x = np.ones((2, 3), np.float32)
+        m = np.array([[1, 0, 1], [0, 0, 1]], bool)
+        out = np.asarray(nn.MaskedFill(-9.0).forward([x, m]))
+        np.testing.assert_allclose(out, np.where(m, -9.0, 1.0))
+        t = [np.zeros(2), np.ones(2), np.full(2, 2.0)]
+        picked = nn.NarrowTable(2, 1).forward(t)
+        np.testing.assert_allclose(np.asarray(picked), 1.0)
+
+    def test_mixture_table(self):
+        import numpy as np
+        import bigdl_tpu.nn as nn
+        gates = np.array([[0.25, 0.75]], np.float32)
+        e1 = np.full((1, 4), 1.0, np.float32)
+        e2 = np.full((1, 4), 3.0, np.float32)
+        out = np.asarray(nn.MixtureTable().forward([gates, [e1, e2]]))
+        np.testing.assert_allclose(out, 0.25 * 1 + 0.75 * 3)
+
+    def test_gradient_reversal(self):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        import bigdl_tpu.nn as nn
+        layer = nn.GradientReversal(the_lambda=2.0)
+
+        def f(x):
+            y = layer._apply(None, None, x, training=True, rng=None)
+            return jnp.sum(y * y)
+
+        x = jnp.asarray([1.0, -2.0])
+        g = jax.grad(f)(x)
+        np.testing.assert_allclose(np.asarray(g), [-4.0, 8.0])  # -λ·2x
+
+    def test_contrastive_normalization_zero_mean(self):
+        import numpy as np
+        import bigdl_tpu.nn as nn
+        rs = np.random.RandomState(0)
+        x = rs.randn(2, 3, 12, 12).astype(np.float32) * 5 + 10
+        y = np.asarray(nn.SpatialSubtractiveNormalization(3).forward(x))
+        # local mean removed: per-image mean shrinks dramatically
+        assert abs(y.mean()) < abs(x.mean()) * 0.1
+        z = np.asarray(nn.SpatialContrastiveNormalization(3).forward(x))
+        assert np.isfinite(z).all()
+
+    def test_conv_lstm_shapes_and_determinism(self):
+        import numpy as np
+        import bigdl_tpu.nn as nn
+        from bigdl_tpu.nn.module import set_seed
+        set_seed(0)
+        layer = nn.ConvLSTMPeephole(2, 4, 3)
+        x = np.random.RandomState(0).randn(1, 5, 2, 6, 6) \
+            .astype(np.float32)
+        y = np.asarray(layer.forward(x))
+        assert y.shape == (1, 5, 4, 6, 6)
+        assert np.isfinite(y).all()
+        # later steps depend on earlier input (recurrence is real)
+        x2 = x.copy(); x2[0, 0] += 1.0
+        y2 = np.asarray(layer.forward(x2))
+        assert np.abs(y2[0, -1] - y[0, -1]).max() > 1e-6
+
+    def test_l1_penalty_records(self):
+        import numpy as np
+        import bigdl_tpu.nn as nn
+        layer = nn.L1Penalty(l1weight=0.1)
+        x = np.array([[1.0, -2.0]], np.float32)
+        layer.training()
+        y = layer.forward(x)
+        np.testing.assert_allclose(np.asarray(y), x)
+        np.testing.assert_allclose(float(layer.last_penalty), 0.3)

@@ -1,0 +1,49 @@
+"""Continuous-batching LLM serving worker (ref: P:llm/serving — the
+fastchat worker / vLLM integration row of SURVEY.md §2.8)."""
+
+import numpy as np
+import pytest
+
+from bigdl_tpu.llm.models.llama import LlamaConfig, LlamaForCausalLM
+from bigdl_tpu.llm.serving import LLMServer
+
+
+@pytest.fixture(scope="module")
+def model():
+    return LlamaForCausalLM.from_config(LlamaConfig.tiny(), seed=0,
+                                        max_cache_len=64)
+
+
+class TestLLMServer:
+    def test_single_request_matches_generate(self, model):
+        """A served request must produce exactly the model's own greedy
+        continuation."""
+        ids = np.array([3, 1, 4, 1, 5], np.int32)
+        want = model.generate(ids[None], max_new_tokens=6)[0, 5:]
+        srv = LLMServer(model, max_batch=2, max_seq_len=32).start()
+        try:
+            req = srv.submit(ids, max_new_tokens=6)
+            got = req.get(timeout=120)
+        finally:
+            srv.stop()
+        np.testing.assert_array_equal(np.asarray(got), want)
+
+    def test_continuous_batching_concurrent_requests(self, model):
+        """Several overlapping requests of different lengths share the
+        batch; each result equals its solo greedy continuation."""
+        prompts = [np.array(p, np.int32) for p in
+                   ([1, 2, 3], [7, 8], [9, 10, 11, 12], [5], [6, 4])]
+        lens = [5, 3, 4, 6, 2]
+        want = [model.generate(p[None], max_new_tokens=n)[0, len(p):]
+                for p, n in zip(prompts, lens)]
+        srv = LLMServer(model, max_batch=2, max_seq_len=32).start()
+        try:
+            reqs = [srv.submit(p, max_new_tokens=n)
+                    for p, n in zip(prompts, lens)]
+            got = [r.get(timeout=300) for r in reqs]
+        finally:
+            srv.stop()
+        for g, w in zip(got, want):
+            np.testing.assert_array_equal(np.asarray(g), w)
+        # with max_batch=2 and 5 requests, slots must have been reused
+        assert srv.steps >= max(lens)
