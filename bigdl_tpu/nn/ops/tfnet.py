@@ -144,6 +144,73 @@ def _matmul(ins, node):
     return a @ b
 
 
+def _batch_matmul(ins, node):
+    a, b = ins
+    if _attr(node, "adj_x", False):
+        a = jnp.swapaxes(a, -1, -2)
+    if _attr(node, "adj_y", False):
+        b = jnp.swapaxes(b, -1, -2)
+    return a @ b
+
+
+def _strided_slice(ins, node):
+    """Basic StridedSlice: begin/end/strides vectors + begin/end/
+    shrink-axis masks (ellipsis/new-axis masks unsupported → error)."""
+    x, begin, end, strides = (ins[0], np.asarray(ins[1]),
+                              np.asarray(ins[2]), np.asarray(ins[3]))
+    if _attr(node, "ellipsis_mask", 0) or _attr(node, "new_axis_mask", 0):
+        raise NotImplementedError(
+            "StridedSlice ellipsis/new_axis masks are unsupported")
+    bm = _attr(node, "begin_mask", 0)
+    em = _attr(node, "end_mask", 0)
+    sm = _attr(node, "shrink_axis_mask", 0)
+    idx = []
+    for i in range(len(begin)):
+        if sm & (1 << i):
+            idx.append(int(begin[i]))
+            continue
+        b = None if bm & (1 << i) else int(begin[i])
+        e = None if em & (1 << i) else int(end[i])
+        idx.append(slice(b, e, int(strides[i])))
+    return x[tuple(idx)]
+
+
+def _resize(ins, node, method):
+    """jax.image.resize uses half-pixel centers; TF1 graphs that freeze
+    the op's legacy default (half_pixel_centers=false) have shifted
+    sampling we do not reproduce — gate instead of silently diverging."""
+    if not _attr(node, "half_pixel_centers", True) \
+            or _attr(node, "align_corners", False):
+        raise NotImplementedError(
+            "Resize* with align_corners/legacy grid is unsupported "
+            "(half-pixel centers only)")
+    x = ins[0]
+    hw = tuple(int(v) for v in np.asarray(ins[1]))
+    return jax.image.resize(x, (x.shape[0],) + hw + (x.shape[3],),
+                            method=method)
+
+
+def _gather_v2(ins, node):
+    if _attr(node, "batch_dims", 0):
+        raise NotImplementedError("GatherV2 batch_dims > 0 unsupported")
+    idx = (np.asarray(ins[1]).astype(np.int64)
+           if isinstance(ins[1], np.ndarray)
+           else ins[1].astype(jnp.int32))
+    return jnp.take(ins[0], idx, axis=int(np.asarray(ins[2])))
+
+
+def _split_v(ins, node):
+    """SplitV with TF's -1 = "the rest" entry resolved before cumsum."""
+    x = ins[0]
+    sizes = np.asarray(ins[1]).astype(np.int64).copy()
+    axis = int(np.asarray(ins[2]))
+    if (sizes < 0).any():
+        total = x.shape[axis]
+        rest = total - sizes[sizes >= 0].sum()
+        sizes[sizes < 0] = rest
+    return tuple(jnp.split(x, np.cumsum(sizes)[:-1].tolist(), axis=axis))
+
+
 _HANDLERS: Dict[str, Callable] = {
     "Identity": lambda ins, n: ins[0],
     "MatMul": _matmul,
@@ -202,6 +269,101 @@ _HANDLERS: Dict[str, Callable] = {
     "Cast": lambda ins, n: ins[0],        # dtype policy left to jax
     "StopGradient": lambda ins, n: jax.lax.stop_gradient(ins[0]),
     "NoOp": lambda ins, n: None,
+    # ---- round-3 widening toward the reference's ~100-op set ------------
+    "Abs": lambda ins, n: jnp.abs(ins[0]),
+    "Floor": lambda ins, n: jnp.floor(ins[0]),
+    "Ceil": lambda ins, n: jnp.ceil(ins[0]),
+    "Round": lambda ins, n: jnp.round(ins[0]),
+    "Rint": lambda ins, n: jnp.round(ins[0]),
+    "Sign": lambda ins, n: jnp.sign(ins[0]),
+    "Log": lambda ins, n: jnp.log(ins[0]),
+    "Log1p": lambda ins, n: jnp.log1p(ins[0]),
+    "Reciprocal": lambda ins, n: 1.0 / ins[0],
+    "Pow": lambda ins, n: jnp.power(ins[0], ins[1]),
+    "FloorDiv": lambda ins, n: jnp.floor_divide(ins[0], ins[1]),
+    "FloorMod": lambda ins, n: jnp.mod(ins[0], ins[1]),
+    "SquaredDifference": lambda ins, n: (ins[0] - ins[1]) ** 2,
+    "AddN": lambda ins, n: sum(ins),
+    "LeakyRelu": lambda ins, n: jax.nn.leaky_relu(
+        ins[0], _attr(n, "alpha", 0.2)),
+    "Selu": lambda ins, n: jax.nn.selu(ins[0]),
+    "Softplus": lambda ins, n: jax.nn.softplus(ins[0]),
+    "Softsign": lambda ins, n: jax.nn.soft_sign(ins[0]),
+    "Erf": lambda ins, n: jax.lax.erf(ins[0]),
+    "Sin": lambda ins, n: jnp.sin(ins[0]),
+    "Cos": lambda ins, n: jnp.cos(ins[0]),
+    "Tan": lambda ins, n: jnp.tan(ins[0]),
+    "Atan": lambda ins, n: jnp.arctan(ins[0]),
+    "Greater": lambda ins, n: ins[0] > ins[1],
+    "GreaterEqual": lambda ins, n: ins[0] >= ins[1],
+    "Less": lambda ins, n: ins[0] < ins[1],
+    "LessEqual": lambda ins, n: ins[0] <= ins[1],
+    "Equal": lambda ins, n: ins[0] == ins[1],
+    "NotEqual": lambda ins, n: ins[0] != ins[1],
+    "LogicalAnd": lambda ins, n: ins[0] & ins[1],
+    "LogicalOr": lambda ins, n: ins[0] | ins[1],
+    "LogicalNot": lambda ins, n: ~ins[0],
+    "Select": lambda ins, n: jnp.where(
+        # TF1 Select broadcasts a rank-1 cond along the FIRST axis
+        ins[0].reshape((-1,) + (1,) * (ins[1].ndim - 1))
+        if getattr(ins[0], "ndim", 0) == 1 and ins[1].ndim > 1
+        else ins[0], ins[1], ins[2]),
+    "SelectV2": lambda ins, n: jnp.where(ins[0], ins[1], ins[2]),
+    "ArgMax": lambda ins, n: jnp.argmax(
+        ins[0], axis=int(np.asarray(ins[1]))),
+    "ArgMin": lambda ins, n: jnp.argmin(
+        ins[0], axis=int(np.asarray(ins[1]))),
+    "Min": lambda ins, n: jnp.min(
+        ins[0], axis=tuple(int(v) for v in np.ravel(np.asarray(ins[1]))),
+        keepdims=_attr(n, "keep_dims", False)),
+    "Prod": lambda ins, n: jnp.prod(
+        ins[0], axis=tuple(int(v) for v in np.ravel(np.asarray(ins[1]))),
+        keepdims=_attr(n, "keep_dims", False)),
+    "All": lambda ins, n: jnp.all(
+        ins[0], axis=tuple(int(v) for v in np.ravel(np.asarray(ins[1]))),
+        keepdims=_attr(n, "keep_dims", False)),
+    "Any": lambda ins, n: jnp.any(
+        ins[0], axis=tuple(int(v) for v in np.ravel(np.asarray(ins[1]))),
+        keepdims=_attr(n, "keep_dims", False)),
+    "Tile": lambda ins, n: jnp.tile(
+        ins[0], [int(v) for v in np.asarray(ins[1])]),
+    "Slice": lambda ins, n: jax.lax.slice(
+        ins[0],
+        [int(v) for v in np.asarray(ins[1])],
+        [int(b) + (int(sz) if int(sz) >= 0 else
+                   ins[0].shape[i] - int(b))
+         for i, (b, sz) in enumerate(zip(np.asarray(ins[1]),
+                                         np.asarray(ins[2])))]),
+    "StridedSlice": _strided_slice,
+    "Split": lambda ins, n: tuple(jnp.split(
+        ins[1], _attr(n, "num_split", 1),
+        axis=int(np.asarray(ins[0])))),
+    "SplitV": lambda ins, n: _split_v(ins, n),
+    "Pack": lambda ins, n: jnp.stack(ins, axis=_attr(n, "axis", 0)),
+    "Unpack": lambda ins, n: tuple(
+        jnp.moveaxis(ins[0], _attr(n, "axis", 0), 0)),
+    "GatherV2": lambda ins, n: _gather_v2(ins, n),
+    "Fill": lambda ins, n: jnp.full(
+        [int(v) for v in np.asarray(ins[0])], ins[1]),
+    "ZerosLike": lambda ins, n: jnp.zeros_like(ins[0]),
+    "OnesLike": lambda ins, n: jnp.ones_like(ins[0]),
+    "Shape": lambda ins, n: np.asarray(ins[0].shape, np.int32),
+    "Size": lambda ins, n: np.asarray(ins[0].size, np.int32),
+    "Rank": lambda ins, n: np.asarray(ins[0].ndim, np.int32),
+    "Range": lambda ins, n: jnp.arange(
+        np.asarray(ins[0]).item(), np.asarray(ins[1]).item(),
+        np.asarray(ins[2]).item()),
+    "BatchMatMul": _batch_matmul,
+    "BatchMatMulV2": _batch_matmul,
+    "MirrorPad": lambda ins, n: jnp.pad(
+        ins[0], [(int(a), int(b)) for a, b in np.asarray(ins[1])],
+        mode=("reflect" if _attr(n, "mode", b"REFLECT")
+              in (b"REFLECT", "REFLECT") else "symmetric")),
+    "PadV2": lambda ins, n: jnp.pad(
+        ins[0], [(int(a), int(b)) for a, b in np.asarray(ins[1])],
+        constant_values=float(np.asarray(ins[2]))),
+    "ResizeBilinear": lambda ins, n: _resize(ins, n, "bilinear"),
+    "ResizeNearestNeighbor": lambda ins, n: _resize(ins, n, "nearest"),
 }
 
 SUPPORTED_OPS = sorted(set(_HANDLERS) | {"Const", "Placeholder"})
@@ -221,7 +383,9 @@ class TFNet:
         placeholders = [n.name for n in gd.node if n.op == "Placeholder"]
         self.inputs = list(inputs) if inputs else placeholders
         if outputs:
-            self.outputs = [o.split(":")[0] for o in outputs]
+            # keep any ':k' output index — evaluate() resolves it against
+            # multi-output ops (Split/SplitV/Unpack)
+            self.outputs = list(outputs)
         else:
             consumed = {self._base(i) for n in gd.node for i in n.input}
             self.outputs = [n.name for n in gd.node
@@ -272,15 +436,20 @@ class TFNet:
             # compute ops promote numpy operands to device constants
             env.update(consts)
 
-            def evaluate(name: str):
-                name = base(name)
-                if name in env:
-                    return env[name]
-                node = nodes[name]
-                ins = [evaluate(i) for i in node.input
-                       if not i.startswith("^")]
-                env[name] = _HANDLERS[node.op](ins, node)
-                return env[name]
+            def evaluate(ref: str):
+                # "node:k" selects output k of a multi-output op
+                # (Split/SplitV/Unpack return tuples); bare names are
+                # output 0
+                name, _, out_idx = ref.lstrip("^").partition(":")
+                if name not in env:
+                    node = nodes[name]
+                    ins = [evaluate(i) for i in node.input
+                           if not i.startswith("^")]
+                    env[name] = _HANDLERS[node.op](ins, node)
+                val = env[name]
+                if isinstance(val, tuple):
+                    return val[int(out_idx) if out_idx else 0]
+                return val
 
             outs = [evaluate(o) for o in outputs]
             return outs[0] if len(outs) == 1 else tuple(outs)

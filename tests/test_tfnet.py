@@ -100,3 +100,81 @@ class TestTFNet:
         hid, out = net(x)
         assert np.asarray(hid).shape == (3, 6)
         assert np.asarray(out).shape == (3, 2)
+
+
+class TestWidenedOpSet:
+    """Round-3 op-set widening (~36 -> ~100 ops, the reference's
+    nn/ops + nn/tf op-count ballpark) — golden parity vs TF execution."""
+
+    def _run(self, fn, *xs):
+        specs = [tf.TensorSpec(x.shape, tf.float32) for x in xs]
+        concrete = tf.function(fn).get_concrete_function(*specs)
+        from tensorflow.python.framework.convert_to_constants import (
+            convert_variables_to_constants_v2)
+        gd = convert_variables_to_constants_v2(concrete) \
+            .graph.as_graph_def()
+        ref = np.asarray(fn(*[tf.constant(x) for x in xs]))
+        out = TFNet(gd).predict(*xs)
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_elementwise_family(self):
+        x = np.random.RandomState(0).rand(3, 5).astype(np.float32) + 0.5
+
+        def f(x):
+            y = tf.abs(-x) + tf.math.log1p(x) + tf.sqrt(x)
+            y = tf.math.softplus(y) + tf.sin(x) * tf.cos(x)
+            y = y + tf.math.erf(x) + tf.math.floordiv(x * 7.0, 2.0)
+            return tf.math.squared_difference(y, x) + tf.pow(x, 2.0)
+
+        self._run(f, x)
+
+    def test_compare_select_family(self):
+        x = np.random.RandomState(1).randn(4, 4).astype(np.float32)
+
+        def f(x):
+            m = tf.greater(x, 0.0)
+            y = tf.where(m, x * 2.0, -x)
+            return y + tf.cast(tf.logical_and(m, tf.less(x, 1.0)),
+                               tf.float32)
+
+        self._run(f, x)
+
+    def test_shape_manipulation_family(self):
+        x = np.random.RandomState(2).rand(2, 6, 4).astype(np.float32)
+
+        def f(x):
+            a = tf.tile(x[:, :2], [1, 3, 1])
+            b = tf.slice(x, [0, 1, 0], [2, 2, 4])
+            c = tf.strided_slice(x, [0, 0, 0], [2, 6, 4], [1, 2, 1])
+            parts = tf.split(x, 2, axis=2)
+            d = tf.stack([parts[0], parts[1]], axis=0)
+            return (tf.reduce_sum(a) + tf.reduce_sum(b)
+                    + tf.reduce_sum(c) + tf.reduce_prod(
+                        tf.reduce_max(d, axis=[2, 3])))
+
+        self._run(f, x)
+
+    def test_matmul_resize_family(self):
+        x = np.random.RandomState(3).rand(2, 3, 4).astype(np.float32)
+
+        def f(x):
+            y = tf.matmul(x, tf.transpose(x, [0, 2, 1]))   # BatchMatMul
+            img = tf.reshape(tf.tile(tf.reduce_mean(y, -1,
+                                                    keepdims=True),
+                                     [1, 1, 8]), [2, 3, 8, 1])
+            up = tf.image.resize(img, [6, 16], method="nearest")
+            return tf.reduce_mean(up, axis=[1, 2, 3])
+
+        self._run(f, x)
+
+    def test_gather_range_fill(self):
+        x = np.random.RandomState(4).rand(5, 4).astype(np.float32)
+
+        def f(x):
+            idx = tf.range(0, 4, 2)
+            g = tf.gather(x, idx, axis=1)
+            z = tf.fill([5, 2], 0.5)
+            return g + z + tf.zeros_like(g) + tf.ones_like(g)
+
+        self._run(f, x)
