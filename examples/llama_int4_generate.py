@@ -1,0 +1,36 @@
+"""Llama INT4 generate + continuous-batching serving (ref: bigdl-llm
+README demo — AutoModelForCausalLM(load_in_4bit=True).generate, and the
+fastchat-worker analog LLMServer)."""
+
+import numpy as np
+
+
+def main(smoke: bool = False, model_path: str = None):
+    from bigdl_tpu.llm.models.llama import LlamaConfig
+    from bigdl_tpu.llm.serving import LLMServer
+    from bigdl_tpu.llm.transformers import AutoModelForCausalLM
+
+    if model_path:
+        model = AutoModelForCausalLM.from_pretrained(model_path,
+                                                     load_in_4bit=True)
+    else:  # demo-sized random weights
+        model = AutoModelForCausalLM.from_pretrained(
+            LlamaConfig.tiny(), load_in_4bit=True, max_cache_len=64)
+
+    ids = np.array([[1, 2, 3, 4]], np.int32)
+    out = model.generate(ids, max_new_tokens=8)
+    print("generate:", out[0].tolist())
+
+    srv = LLMServer(model, max_batch=2, max_seq_len=32).start()
+    try:
+        reqs = [srv.submit(np.array(p, np.int32), max_new_tokens=4)
+                for p in ([5, 6], [7, 8, 9], [1])]
+        for r in reqs:
+            print("served:", r.get(timeout=300))
+    finally:
+        srv.stop()
+    return out
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,23 @@
+"""Mask R-CNN inference (ref: S:dllib/models/maskrcnn demo): one jitted
+program from image batch to boxes/labels/masks."""
+
+import numpy as np
+
+
+def main(smoke: bool = False):
+    from bigdl_tpu.models.maskrcnn import MaskRCNN, MaskRCNNConfig
+
+    cfg = MaskRCNNConfig.tiny() if smoke else MaskRCNNConfig(
+        num_classes=81, image_size=224)
+    model = MaskRCNN(cfg, seed=0)
+    imgs = np.random.RandomState(0).rand(
+        1, cfg.image_size, cfg.image_size, 3).astype(np.float32)
+    det = model(imgs)
+    kept = int((det["scores"][0] > 0).sum())
+    print(f"detections: {kept} / {cfg.detections_per_img} slots; "
+          f"mask grid {det['masks'].shape[-2:]}")
+    return det
+
+
+if __name__ == "__main__":
+    main(smoke=True)

@@ -1,0 +1,62 @@
+"""Orca foreign-framework hosting (ref: orca quickstarts): the same
+XShards feed a torch estimator and a tf.keras (tf2) estimator."""
+
+import numpy as np
+
+
+def main(smoke: bool = False):
+    from bigdl_tpu.orca.data import XShards
+    from bigdl_tpu.orca.learn.estimator import Estimator
+
+    rs = np.random.RandomState(0)
+    x = rs.randn(200, 8).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.int64)
+    shards = XShards.partition({"x": x, "y": y}, num_shards=4)
+    out = {}
+
+    try:
+        import torch
+
+        def model_creator(config):
+            torch.manual_seed(0)
+            return torch.nn.Sequential(
+                torch.nn.Linear(8, 16), torch.nn.ReLU(),
+                torch.nn.Linear(16, 2))
+
+        est = Estimator.from_torch(
+            model_creator=model_creator,
+            optimizer_creator=lambda m, c: torch.optim.Adam(
+                m.parameters(), lr=c["lr"]),
+            loss_creator=lambda c: torch.nn.CrossEntropyLoss(),
+            config={"lr": 1e-2})
+        est.fit(shards, epochs=1 if smoke else 5, batch_size=32)
+        out["torch"] = est.evaluate({"x": x, "y": y})
+        print("torch estimator:", out["torch"])
+    except ImportError:
+        pass
+
+    try:
+        import tensorflow as tf
+
+        def keras_creator(config):
+            tf.keras.utils.set_random_seed(0)
+            m = tf.keras.Sequential([
+                tf.keras.layers.Dense(16, activation="relu"),
+                tf.keras.layers.Dense(2, activation="softmax")])
+            m.compile(optimizer="adam",
+                      loss=tf.keras.losses
+                      .SparseCategoricalCrossentropy())
+            return m
+
+        est = Estimator.from_keras(model_creator=keras_creator,
+                                   backend="tf2")
+        est.fit(shards, epochs=1 if smoke else 5, batch_size=32)
+        out["tf2"] = est.evaluate({"x": x, "y": y})
+        print("tf2 estimator:", out["tf2"])
+    except ImportError:
+        pass
+    return out
+
+
+if __name__ == "__main__":
+    main()

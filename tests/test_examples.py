@@ -1,0 +1,26 @@
+"""Every examples/ script must run end-to-end in smoke mode (the
+reference's examples double as CI smoke tests, SURVEY.md §4)."""
+
+import importlib.util
+import os
+import sys
+
+import pytest
+
+EXAMPLES = os.path.join(os.path.dirname(__file__), "..", "examples")
+
+
+def _load(name):
+    spec = importlib.util.spec_from_file_location(
+        f"example_{name}", os.path.join(EXAMPLES, f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.mark.parametrize("name", [
+    "lenet_mnist", "llama_int4_generate", "chronos_forecast",
+    "fgboost_federated", "maskrcnn_inference", "orca_estimators"])
+def test_example_smoke(name):
+    mod = _load(name)
+    mod.main(smoke=True)
