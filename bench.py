@@ -302,16 +302,19 @@ def _synthetic_q4_llama_params(cfg, seed: int = 0):
         }
     layers["input_layernorm"] = jnp.ones((L, h), jnp.bfloat16)
     layers["post_attention_layernorm"] = jnp.ones((L, h), jnp.bfloat16)
-    key, k1, k2 = jax.random.split(key, 3)
+    key, k1, k2, k3 = jax.random.split(key, 4)
+    # lm_head quantized too (q4 streams 66 MB instead of 262 MB per token)
     return {
         "embed_tokens": (jax.random.normal(k1, (cfg.vocab_size, h),
                                            jnp.float32) * 0.02
                          ).astype(jnp.bfloat16),
         "norm": jnp.ones((h,), jnp.bfloat16),
         "layers": layers,
-        "lm_head": {"w": (jax.random.normal(k2, (cfg.vocab_size, h),
-                                            jnp.float32) * 0.02
-                          ).astype(jnp.bfloat16)},
+        "lm_head": {
+            "q": jax.random.randint(k2, (h // 2, cfg.vocab_size), 0, 256,
+                                    jnp.uint8),
+            "scale": jax.random.uniform(k3, (h // QK, cfg.vocab_size),
+                                        jnp.float32, 0.001, 0.02)},
     }
 
 
@@ -328,8 +331,9 @@ def _q4_param_bytes(cfg) -> int:
     for name in _LAYER_LINEARS:
         n, k = shapes[name]
         total += L * (n * k // 2 + n * (k // QK) * 4)
-    # lm_head is bf16 in this build
-    total += cfg.vocab_size * cfg.hidden_size * 2
+    # lm_head quantized too
+    h = cfg.hidden_size
+    total += cfg.vocab_size * h // 2 + cfg.vocab_size * (h // QK) * 4
     return total
 
 
@@ -366,7 +370,33 @@ def bench_llama_int4_decode(model_size: str = "7b", batch: int = 1,
     ids = jnp.asarray(rs.randint(0, cfg.vocab_size, (batch, prompt_len)),
                       jnp.int32)
 
-    logits, cache = model(ids)
+    # prefill throughput as a SLOPE between two prompt lengths, netting
+    # out the ~100 ms fixed dispatch/fetch roundtrip exactly like the
+    # decode windows below (distinct tokens dodge result memoization)
+    def prefill_time(plen):
+        pids = jnp.asarray(rs.randint(0, cfg.vocab_size, (batch, plen)),
+                           jnp.int32)
+        lg, ch = model(pids)            # compile for this length
+        int(np.asarray(jnp.argmax(lg[0, -1])))
+        pids = jnp.asarray(rs.randint(0, cfg.vocab_size, (batch, plen)),
+                           jnp.int32)
+        t0 = time.perf_counter()
+        lg, ch = model(pids)
+        int(np.asarray(jnp.argmax(lg[0, -1])))
+        return time.perf_counter() - t0, lg, ch
+
+    p_small = max(prompt_len // 4, 8)
+    t_small, _, _ = prefill_time(p_small)
+    t_full, logits, cache = prefill_time(prompt_len)
+    # wall number includes the ~100 ms dispatch/fetch roundtrip AND the
+    # one-off 4 GB weight stream; the marginal slope shows the per-token
+    # cost once weights are flowing (prefill is weight-stream-bound at
+    # these lengths, so the two differ by orders of magnitude)
+    prefill_tok_s = batch * prompt_len / max(t_full, 1e-9)
+    marginal = (batch * (prompt_len - p_small) / (t_full - t_small)
+                if t_full > t_small else None)
+    prefill_s = t_full
+
     key = jax.random.PRNGKey(0)
     last = logits[:, -1]
     temp = jnp.float32(1.0)
@@ -406,6 +436,10 @@ def bench_llama_int4_decode(model_size: str = "7b", batch: int = 1,
             "window_s": [round(t_small, 3), round(t_big, 3)],
             "weight_bytes": weight_bytes,
             "implied_hbm_gbs": round(hbm_gbs, 1),
+            "prefill_tokens_per_s": round(prefill_tok_s, 1),
+            "prefill_marginal_tokens_per_s": (round(marginal, 1)
+                                              if marginal else None),
+            "prefill_s": round(prefill_s, 3),
             "decode_mode": "fused_scan",
             "backend": jax.default_backend(),
         },

@@ -32,6 +32,7 @@ def main(smoke: bool = False):
     mse = float(np.mean((preds[0] - y) ** 2))
     print(f"parties agree: {agree}; train MSE {mse:.4f} "
           f"(var {float(np.var(y)):.4f})")
+    assert agree, "federated parties diverged — protocol regression"
     return mse
 
 

@@ -14,9 +14,10 @@ def main(smoke: bool = False):
 
     set_seed(0)
     n, epochs = (256, 1) if smoke else (60000, 5)
-    rs = np.random.RandomState(0)
-    x = rs.rand(n, 1, 28, 28).astype(np.float32)
-    y = rs.randint(0, 10, n).astype(np.int32)
+    from bigdl_tpu.feature.mnist import load_mnist
+    x, y = load_mnist(train=True)          # IDX files or learnable
+    x = x.reshape(-1, 1, 28, 28).astype(np.float32)[:n]   # synthetic digits
+    y = np.asarray(y, np.int32)[:n]
 
     m = K.Sequential()
     m.add(K.Convolution2D(6, 5, 5, activation="tanh",

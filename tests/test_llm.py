@@ -563,3 +563,44 @@ class TestMoE:
         lg, _ = forward(sharded, cfg, jnp.asarray(toks), cache,
                         jnp.broadcast_to(jnp.arange(4), (2, 4)))
         assert np.isfinite(np.asarray(lg, np.float32)).all()
+
+
+class TestMistralCrossCheck:
+    def test_matches_hf_mistral_numerics(self):
+        """Golden parity vs HF torch Mistral (sliding-window family) —
+        the same independent-implementation pattern as the Llama and
+        GPT-NeoX cross-checks (SURVEY.md §4)."""
+        torch = pytest.importorskip("torch")
+        transformers = pytest.importorskip("transformers")
+
+        hf_cfg = transformers.MistralConfig(
+            vocab_size=96, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=64,
+            rms_norm_eps=1e-5, rope_theta=10000.0, sliding_window=4,
+            attn_implementation="eager")
+        torch.manual_seed(0)
+        hf = transformers.MistralForCausalLM(hf_cfg).eval()
+
+        from bigdl_tpu.llm.models.llama import LlamaConfig as Cfg
+        from bigdl_tpu.llm.transformers.model import _hf_to_params
+
+        cfg = Cfg.from_hf(hf_cfg)
+        assert cfg.sliding_window == 4
+        params = _hf_to_params(hf, cfg)
+        params = jax.tree_util.tree_map(
+            lambda a: a.astype(jnp.float32)
+            if a.dtype == jnp.bfloat16 else a, params)
+
+        ids = np.array([[3, 17, 42, 9, 61, 7, 25, 50]], np.int32)
+        with torch.no_grad():
+            ref = hf(torch.tensor(ids, dtype=torch.long)) \
+                .logits.numpy()
+
+        cache = init_cache(cfg, 1, 16, dtype=jnp.float32)
+        pos = jnp.arange(ids.shape[1])[None, :]
+        ours, _ = forward(params, cfg, jnp.asarray(ids), cache, pos)
+        ours = np.asarray(ours)
+        scale = np.abs(ref).max()
+        assert np.abs(ours - ref).max() / scale < 0.02, \
+            np.abs(ours - ref).max() / scale
