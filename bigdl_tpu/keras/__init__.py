@@ -12,7 +12,9 @@ from bigdl_tpu.keras.layers import (
     Permute, RepeatVector, Reshape, SeparableConvolution2D, SimpleRNN,
     ThresholdedReLU, TimeDistributed, UpSampling1D, UpSampling2D,
     ZeroPadding1D, ZeroPadding2D, merge,
-)
+    Convolution3D, MaxPooling3D, AveragePooling3D, UpSampling3D,
+    Cropping1D, Cropping2D, Highway, Masking, GaussianNoise,
+    GaussianDropout, SpatialDropout2D, LocallyConnected1D)
 from bigdl_tpu.keras.objectives import to_criterion
 from bigdl_tpu.keras.optimizers import to_optim_method
 from bigdl_tpu.keras.metrics import to_validation_methods
@@ -28,6 +30,9 @@ __all__ = [
     "Merge", "PReLU", "Permute", "RepeatVector", "Reshape",
     "SeparableConvolution2D", "SimpleRNN", "ThresholdedReLU",
     "TimeDistributed", "UpSampling1D", "UpSampling2D", "ZeroPadding1D",
+    "Convolution3D", "MaxPooling3D", "AveragePooling3D", "UpSampling3D",
+    "Cropping1D", "Cropping2D", "Highway", "Masking", "GaussianNoise",
+    "GaussianDropout", "SpatialDropout2D", "LocallyConnected1D",
     "ZeroPadding2D", "merge", "to_criterion", "to_optim_method",
     "to_validation_methods",
 ]

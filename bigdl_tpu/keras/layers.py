@@ -623,3 +623,212 @@ class ThresholdedReLU(KerasLayer):
 
     def compute_output_shape(self, input_shape):
         return input_shape
+
+
+# ---------------------------------------------------------------------------
+# round-3 widening: 3-D family + remaining Keras-1 wrappers
+# (ref: scala keras Convolution3D/MaxPooling3D/... — same shape-inference
+#  contract over the volumetric nn layers)
+# ---------------------------------------------------------------------------
+
+class Convolution3D(KerasLayer):
+    def __init__(self, nb_filter: int, kernel_dim1: int, kernel_dim2: int,
+                 kernel_dim3: int, subsample=(1, 1, 1),
+                 border_mode: str = "valid", activation=None, **kwargs):
+        super().__init__(**kwargs)
+        self.nb_filter = nb_filter
+        self.kernel = (kernel_dim1, kernel_dim2, kernel_dim3)
+        self.subsample = tuple(subsample)
+        self.border_mode = border_mode
+        self.activation = activation
+
+    def build_module(self, input_shape):
+        pad = -1 if self.border_mode == "same" else 0
+        mod = nn.VolumetricConvolution(
+            input_shape[0], self.nb_filter,
+            self.kernel[0], self.kernel[2], self.kernel[1],
+            self.subsample[0], self.subsample[2], self.subsample[1],
+            pad, pad, pad)
+        return _maybe_activate(mod, self.activation)
+
+    def compute_output_shape(self, input_shape):
+        c, d, h, w = input_shape
+        return (self.nb_filter,
+                _conv_len(d, self.kernel[0], self.subsample[0],
+                          self.border_mode),
+                _conv_len(h, self.kernel[1], self.subsample[1],
+                          self.border_mode),
+                _conv_len(w, self.kernel[2], self.subsample[2],
+                          self.border_mode))
+
+
+class MaxPooling3D(KerasLayer):
+    def __init__(self, pool_size=(2, 2, 2), strides=None,
+                 border_mode: str = "valid", **kwargs):
+        super().__init__(**kwargs)
+        self.pool_size = tuple(pool_size)
+        self.strides = tuple(strides) if strides else self.pool_size
+        self.border_mode = border_mode
+
+    def _mod_cls(self):
+        return nn.VolumetricMaxPooling
+
+    def build_module(self, input_shape):
+        # Volumetric pools take literal pads only (no -1 = SAME contract
+        # like the spatial ones): derive the symmetric SAME pads here
+        def same_pad(n, k, s):
+            out = -(-n // s)
+            return max(((out - 1) * s + k - n + 1) // 2, 0)
+
+        c, d, h, w = input_shape
+        if self.border_mode == "same":
+            pt = same_pad(d, self.pool_size[0], self.strides[0])
+            ph = same_pad(h, self.pool_size[1], self.strides[1])
+            pw = same_pad(w, self.pool_size[2], self.strides[2])
+        else:
+            pt = ph = pw = 0
+        return self._mod_cls()(
+            self.pool_size[0], self.pool_size[2], self.pool_size[1],
+            self.strides[0], self.strides[2], self.strides[1],
+            pt, pw, ph)
+
+    def compute_output_shape(self, input_shape):
+        c, d, h, w = input_shape
+        return (c,) + tuple(
+            _conv_len(s, self.pool_size[i], self.strides[i],
+                      self.border_mode)
+            for i, s in enumerate((d, h, w)))
+
+
+class AveragePooling3D(MaxPooling3D):
+    def _mod_cls(self):
+        return nn.VolumetricAveragePooling
+
+
+class UpSampling3D(KerasLayer):
+    def __init__(self, size=(2, 2, 2), **kwargs):
+        super().__init__(**kwargs)
+        self.size = tuple(size)
+
+    def build_module(self, input_shape):
+        return nn.UpSampling3D(self.size)
+
+    def compute_output_shape(self, input_shape):
+        c, d, h, w = input_shape
+        return (c, d * self.size[0], h * self.size[1], w * self.size[2])
+
+
+class Cropping1D(KerasLayer):
+    def __init__(self, cropping=(1, 1), **kwargs):
+        super().__init__(**kwargs)
+        self.cropping = tuple(cropping)
+
+    def build_module(self, input_shape):
+        t, c = input_shape
+        length = t - self.cropping[0] - self.cropping[1]
+        return nn.Narrow(2, self.cropping[0] + 1, length)
+
+    def compute_output_shape(self, input_shape):
+        t, c = input_shape
+        return (t - self.cropping[0] - self.cropping[1], c)
+
+
+class Cropping2D(KerasLayer):
+    def __init__(self, cropping=((0, 0), (0, 0)), **kwargs):
+        super().__init__(**kwargs)
+        self.cropping = tuple(tuple(c) for c in cropping)
+
+    def build_module(self, input_shape):
+        return nn.Cropping2D(self.cropping[0], self.cropping[1])
+
+    def compute_output_shape(self, input_shape):
+        c, h, w = input_shape
+        (t, b), (l, r) = self.cropping
+        return (c, h - t - b, w - l - r)
+
+
+class Highway(KerasLayer):
+    def __init__(self, activation: str = "tanh", **kwargs):
+        super().__init__(**kwargs)
+        self.activation = activation
+
+    def build_module(self, input_shape):
+        import jax
+        import jax.numpy as jnp
+        act = {"tanh": jnp.tanh, "relu": jax.nn.relu,
+               "sigmoid": jax.nn.sigmoid, "linear": (lambda v: v),
+               None: jnp.tanh}[self.activation]
+        return nn.Highway(input_shape[-1], activation=act)
+
+    def compute_output_shape(self, input_shape):
+        return tuple(input_shape)
+
+
+class Masking(KerasLayer):
+    def __init__(self, mask_value: float = 0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.mask_value = mask_value
+
+    def build_module(self, input_shape):
+        return nn.Masking(self.mask_value)
+
+    def compute_output_shape(self, input_shape):
+        return tuple(input_shape)
+
+
+class GaussianNoise(KerasLayer):
+    def __init__(self, sigma: float = 0.1, **kwargs):
+        super().__init__(**kwargs)
+        self.sigma = sigma
+
+    def build_module(self, input_shape):
+        return nn.GaussianNoise(self.sigma)
+
+    def compute_output_shape(self, input_shape):
+        return tuple(input_shape)
+
+
+class GaussianDropout(KerasLayer):
+    def __init__(self, p: float = 0.5, **kwargs):
+        super().__init__(**kwargs)
+        self.p = p
+
+    def build_module(self, input_shape):
+        return nn.GaussianDropout(self.p)
+
+    def compute_output_shape(self, input_shape):
+        return tuple(input_shape)
+
+
+class SpatialDropout2D(KerasLayer):
+    def __init__(self, p: float = 0.5, **kwargs):
+        super().__init__(**kwargs)
+        self.p = p
+
+    def build_module(self, input_shape):
+        return nn.SpatialDropout2D(self.p)
+
+    def compute_output_shape(self, input_shape):
+        return tuple(input_shape)
+
+
+class LocallyConnected1D(KerasLayer):
+    def __init__(self, nb_filter: int, filter_length: int,
+                 subsample_length: int = 1, activation=None, **kwargs):
+        super().__init__(**kwargs)
+        self.nb_filter = nb_filter
+        self.filter_length = filter_length
+        self.subsample_length = subsample_length
+        self.activation = activation
+
+    def build_module(self, input_shape):
+        t, c = input_shape
+        mod = nn.LocallyConnected1D(t, c, self.nb_filter,
+                                    self.filter_length,
+                                    self.subsample_length)
+        return _maybe_activate(mod, self.activation)
+
+    def compute_output_shape(self, input_shape):
+        t, c = input_shape
+        return (_conv_len(t, self.filter_length, self.subsample_length,
+                          "valid"), self.nb_filter)

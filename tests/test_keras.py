@@ -146,3 +146,63 @@ class TestFunctionalModel:
         n1.inputs.append(n1)  # malformed self-loop
         with pytest.raises(ValueError):
             Graph([a], [n1])
+
+
+class TestWidenedKerasLayers:
+    def test_conv3d_pipeline(self):
+        import numpy as np
+        import bigdl_tpu.keras as K
+        from bigdl_tpu.nn.module import set_seed
+        set_seed(0)
+        m = K.Sequential()
+        m.add(K.Convolution3D(4, 3, 3, 3, activation="relu",
+                              input_shape=(2, 8, 8, 8)))
+        m.add(K.MaxPooling3D())
+        m.add(K.Flatten())
+        m.add(K.Dense(5))
+        x = np.random.RandomState(0).rand(2, 2, 8, 8, 8).astype(np.float32)
+        y = m.predict(x)
+        assert y.shape == (2, 5)
+        assert np.isfinite(np.asarray(y)).all()
+
+    def test_cropping_and_misc_wrappers(self):
+        import numpy as np
+        import bigdl_tpu.keras as K
+        from bigdl_tpu.nn.module import set_seed
+        set_seed(0)
+        m = K.Sequential()
+        m.add(K.Cropping2D(((1, 1), (2, 2)), input_shape=(3, 10, 12)))
+        m.add(K.SpatialDropout2D(0.2))
+        m.add(K.Flatten())
+        m.add(K.Highway())
+        m.add(K.Dense(4))
+        x = np.random.RandomState(1).rand(2, 3, 10, 12).astype(np.float32)
+        y = m.predict(x)
+        assert y.shape == (2, 4)
+
+    def test_locally_connected_and_crop1d(self):
+        import numpy as np
+        import bigdl_tpu.keras as K
+        from bigdl_tpu.nn.module import set_seed
+        set_seed(0)
+        m = K.Sequential()
+        m.add(K.Cropping1D((1, 2), input_shape=(12, 6)))
+        m.add(K.LocallyConnected1D(8, 3, activation="tanh"))
+        m.add(K.GlobalMaxPooling1D())
+        m.add(K.Dense(3))
+        x = np.random.RandomState(2).rand(2, 12, 6).astype(np.float32)
+        y = m.predict(x)
+        assert y.shape == (2, 3)
+
+    def test_noise_layers_identity_at_eval(self):
+        import numpy as np
+        import bigdl_tpu.keras as K
+        from bigdl_tpu.nn.module import set_seed
+        set_seed(0)
+        m = K.Sequential()
+        m.add(K.GaussianNoise(0.5, input_shape=(6,)))
+        m.add(K.GaussianDropout(0.3))
+        m.add(K.Masking(0.0))
+        x = np.random.RandomState(3).rand(4, 6).astype(np.float32)
+        y = np.asarray(m.predict(x))
+        np.testing.assert_allclose(y, x, rtol=1e-6)
