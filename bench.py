@@ -404,7 +404,7 @@ def bench_llama_int4_decode(model_size: str = "7b", batch: int = 1,
     def window(n, cache, last, key):
         """One decode_scan window; returns wall time closed by host fetch."""
         t0 = time.perf_counter()
-        toks, cache, last, key = model._decode_scan(
+        toks, cache, last, key, _ = model._decode_scan(
             model.params, cache, last, key, temp, num_tokens=n,
             do_sample=True, top_k=0, eos_token_id=None)
         int(np.asarray(toks)[0, -1])  # host fetch closes the window

@@ -67,6 +67,13 @@ _MAX_BK = 8192          # K above this is chunked to bound VMEM
                         # vmem limit on chip with full-K blocks)
 
 
+def _align_bm(bm: int, m: int) -> int:
+    """Round the M tile up to a 16-aligned shape: Mosaic rejects
+    non-8/16-aligned second-minor block dims, so bm must be a tile
+    multiple even when 16 < m < 128 (e.g. m=100 -> bm=112, pad M)."""
+    return min(bm, max(16, -(-m // 16) * 16))
+
+
 def _scale_expand(scale_ref, half: int, cdt):
     """(G, bn) group scales → (half, bn) per-row scales via an MXU matmul
     against an iota-built expansion matrix (no VPU relayout)."""
@@ -184,7 +191,7 @@ def int4_matmul(x, q_t, scale_t, bm: int = 128, bn: int = 256,
             "convert ggml (N, K/2) dicts with to_tpu_layout() first")
     sub8 = (m >= 256) if mode == "auto" else (mode == "sub8")
     scale_t = scale_t.astype(jnp.float32)
-    bm = min(bm, max(16, m))
+    bm = _align_bm(bm, m)
     m_pad = -m % bm
     if m_pad:
         x = jnp.pad(x, ((0, m_pad), (0, 0)))
@@ -229,7 +236,7 @@ def asym_int4_matmul(x, q_t, scale_t, zero_t, bm: int = 128, bn: int = 256,
     n = q_t.shape[1]
     scale_t = scale_t.astype(jnp.float32)
     zero_t = zero_t.astype(jnp.float32)
-    bm = min(bm, max(16, m))
+    bm = _align_bm(bm, m)
     m_pad = -m % bm
     if m_pad:
         x = jnp.pad(x, ((0, m_pad), (0, 0)))
@@ -280,7 +287,7 @@ def int8_matmul(x, q_t, scale_t, bm: int = 128, bn: int = 256,
             f"q_t {q_t.shape} is not the (K, N) TPU layout for K={k}; "
             "convert ggml (N, K) dicts with to_tpu_layout() first")
     scale_t = scale_t.astype(jnp.float32)
-    bm = min(bm, max(16, m))
+    bm = _align_bm(bm, m)
     m_pad = -m % bm
     if m_pad:
         x = jnp.pad(x, ((0, m_pad), (0, 0)))

@@ -322,16 +322,17 @@ class GptNeoXForCausalLM:
         pieces = [np.asarray(tokens)]
         remaining = max_new_tokens
         chunk = max_new_tokens if eos_token_id is None else decode_chunk
+        finished = jnp.zeros((b,), bool)
         while remaining > 0:
             n = min(chunk, remaining)
-            toks, cache, last, key = self._decode_scan(
-                self.params, cache, last, key, jnp.float32(1.0),
+            toks, cache, last, key, finished = self._decode_scan(
+                self.params, cache, last, key, jnp.float32(1.0), finished,
                 num_tokens=n, eos_token_id=eos_token_id)
             t_np = np.asarray(toks)
             pieces.append(t_np)
             remaining -= n
             if (eos_token_id is not None
-                    and (t_np == eos_token_id).any(axis=1).all()):
+                    and np.asarray(finished).all()):
                 break
         return np.concatenate(pieces, axis=1)
 

@@ -573,6 +573,7 @@ def _pick_token(logits, key, do_sample: bool, temperature, top_k: int):
 
 
 def decode_scan(params, cache, last_logits, key, temperature,
+                finished=None,
                 *, cfg, forward_fn, num_tokens: int, do_sample: bool = False,
                 top_k: int = 0, eos_token_id: Optional[int] = None):
     """``num_tokens`` autoregressive steps as ONE compiled program.
@@ -585,11 +586,17 @@ def decode_scan(params, cache, last_logits, key, temperature,
     with a **donated** kv cache, so decode throughput tracks the HBM
     weight-stream roofline instead of the dispatch rate.
 
-    Returns (tokens (B, num_tokens), cache, last_logits, key). After an
-    EOS hit a row keeps emitting ``eos_token_id`` (HF padding
-    semantics); compute continues but outputs are frozen.
+    Returns (tokens (B, num_tokens), cache, last_logits, key, finished).
+    After an EOS hit a row keeps emitting ``eos_token_id`` (HF padding
+    semantics); compute continues but outputs are frozen. ``finished``
+    (B,) bool carries that state ACROSS windows — callers decoding in
+    chunks must pass the returned mask back in, otherwise a row that hit
+    EOS would resume emitting arbitrary tokens at the next chunk
+    boundary.
     """
     b = last_logits.shape[0]
+    if finished is None:
+        finished = jnp.zeros((b,), bool)
 
     def step(carry, _):
         cache, last, key, finished = carry
@@ -602,10 +609,10 @@ def decode_scan(params, cache, last_logits, key, temperature,
         logits, cache = forward_fn(params, cfg, nxt[:, None], cache, pos)
         return (cache, logits[:, -1], key, finished), nxt
 
-    init = (cache, last_logits, key, jnp.zeros((b,), bool))
-    (cache, last, key, _), toks = jax.lax.scan(step, init, None,
-                                               length=num_tokens)
-    return toks.T, cache, last, key
+    init = (cache, last_logits, key, finished)
+    (cache, last, key, finished), toks = jax.lax.scan(step, init, None,
+                                                      length=num_tokens)
+    return toks.T, cache, last, key, finished
 
 
 # ---------------------------------------------------------------------------
@@ -711,16 +718,17 @@ class LlamaForCausalLM:
         pieces = [np.asarray(tokens)]
         remaining = max_new_tokens
         chunk = max_new_tokens if eos_token_id is None else decode_chunk
+        finished = jnp.zeros((b,), bool)
         while remaining > 0:
             n = min(chunk, remaining)
-            toks, cache, last, key = self._decode_scan(
-                self.params, cache, last, key, temp, num_tokens=n,
-                do_sample=do_sample, top_k=top_k,
+            toks, cache, last, key, finished = self._decode_scan(
+                self.params, cache, last, key, temp, finished,
+                num_tokens=n, do_sample=do_sample, top_k=top_k,
                 eos_token_id=eos_token_id)
             t_np = np.asarray(toks)
             pieces.append(t_np)
             remaining -= n
             if (eos_token_id is not None
-                    and (t_np == eos_token_id).any(axis=1).all()):
+                    and np.asarray(finished).all()):
                 break
         return np.concatenate(pieces, axis=1)

@@ -38,6 +38,21 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def _sync_barrier(*arrays):
+    """Bound the in-flight computations producing ``arrays``.
+
+    ``jax.block_until_ready`` alone is NOT reliable on every runtime
+    (the axon-tunneled TPU runtime returns early from it); the only
+    portable barrier is a real device-to-host fetch, so we pull one
+    element of every array in a single tiny transfer. The engine is
+    already host-synchronous once per token (the argmax fetch), so this
+    adds one small dispatch per step, not a new synchronization regime.
+    """
+    jax.block_until_ready(arrays)
+    np.asarray(jnp.stack([a.ravel()[0].astype(jnp.float32)
+                          for a in arrays]))
+
+
 class Request:
     """Handle returned by :meth:`LLMServer.submit`."""
 
@@ -137,12 +152,23 @@ class LLMServer:
                                       cache=cache_in, positions=positions)
         row = jnp.arange(self.max_batch) == i
         keep = row[None, :, None, None, None]
+        old = self._cache
         self._cache = {
-            "k": jnp.where(keep, new_cache["k"], self._cache["k"]),
-            "v": jnp.where(keep, new_cache["v"], self._cache["v"]),
-            "pos": self._cache["pos"],
+            "k": jnp.where(keep, new_cache["k"], old["k"]),
+            "v": jnp.where(keep, new_cache["v"], old["v"]),
+            "pos": old["pos"],
         }
         self._last = self._last.at[i].set(logits[i, -1])
+        # RACE FIX (round 4): synchronize before the old cache buffers are
+        # released. Under jax's async dispatch, dropping the previous
+        # cache while the step consuming it is still in flight lets the
+        # runtime recycle those buffers for CONCURRENT jax computations on
+        # other threads (e.g. another serving loop or test traffic), and
+        # the in-flight step then reads overwritten memory. Reproduced:
+        # 14/30 greedy-parity mismatches with 4 hammer threads; 0/30 with
+        # this barrier (see the stress test in tests/test_llm_serving.py).
+        _sync_barrier(self._cache["k"], self._cache["v"], self._last)
+        del old
         self._pos[i] = start + t
         self._slots[i] = req
         self._remaining[i] = req.max_new_tokens
@@ -156,11 +182,9 @@ class LLMServer:
         toks = jnp.asarray(nxt[:, None])
         positions = jnp.asarray(self._pos[:, None])
         # per-slot positions: slot rows beyond their own pos are masked
-        # by the causal test (slot_index <= q_position) in attention
-        cache_in = dict(self._cache)
-        cache_in["pos"] = jnp.asarray(0, jnp.int32)
-        # write kv at per-slot positions via positions arg; the cache
-        # update slices at pos 0..1 would collide — use scatter per slot
+        # by the causal test (slot_index <= q_position) in attention;
+        # the cache update slices at pos 0..1 would collide — use
+        # scatter per slot
         logits, new_cache = self._decode_scatter(toks, positions)
         for i in active:
             tok = int(nxt[i])
@@ -250,8 +274,11 @@ class LLMServer:
         logits, k_new, v_new = self._scatter_step(
             self.model.params, self._cache["k"], self._cache["v"],
             positions, toks, None)
-        self._cache = {"k": k_new, "v": v_new,
-                       "pos": self._cache["pos"]}
+        old = self._cache
+        self._cache = {"k": k_new, "v": v_new, "pos": old["pos"]}
+        # same async-dispatch buffer-lifetime barrier as _prefill_slot
+        _sync_barrier(k_new, v_new, logits)
+        del old
         return logits, None
 
     def _loop(self):

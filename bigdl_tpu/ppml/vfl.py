@@ -80,6 +80,15 @@ class VFLLinearRegression:
         # fit's still-cached dz from the server kv
         rnd = self._fit_round
         self._fit_round += 1
+        if self._n_peers is None:
+            # party-count collective: every party contributes 1; the sum
+            # is the party count, and peers-expected-to-fetch-dz is that
+            # minus the label party itself — this arms the server-side kv
+            # GC (fl_server._kv_expect) so dz entries are dropped once
+            # every non-label party has fetched them
+            total = self.client.agg(f"{self.model_id}:r{rnd}:nparties",
+                                    [np.ones(1)], op="sum")[0]
+            self._n_peers = max(int(round(float(total[0]))) - 1, 1)
         for epoch in range(epochs):
             for start in range(0, n, bs):
                 sl = slice(start, min(start + bs, n))

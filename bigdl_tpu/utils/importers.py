@@ -231,17 +231,26 @@ class CaffeLoader:
             # field 2 = repeated V1LayerParameter — same sub-layout for
             # the pieces we need (name=1, blobs=6/7)
             if field in (100, 2) and wt == 2:
+                # V2 LayerParameter blobs live in field 7 ONLY (field 6
+                # is repeated ParamSpec, which would parse as a spurious
+                # empty blob and shift the weight/bias convention);
+                # V1LayerParameter blobs live in field 6. Names likewise
+                # differ: V2 name = 1, V1 name = 4 (V1 fields 2/3 are
+                # bottom/top strings).
+                blob_field = 7 if field == 100 else 6
+                name_field = 1 if field == 100 else 4
                 name = f"layer{len(layers)}"
                 blobs: List[np.ndarray] = []
                 for f2, w2, v2 in _fields(val):
-                    if f2 == 1 and w2 == 2:
+                    if f2 == name_field and w2 == 2:
                         name = bytes(v2).decode("utf-8", "replace")
-                    elif f2 in (6, 7) and w2 == 2:
-                        # V1 blobs = 6, V2 blobs = 7
+                    elif f2 == blob_field and w2 == 2:
                         try:
-                            blobs.append(_parse_blob(v2))
+                            b = _parse_blob(v2)
                         except Exception:   # not a blob (e.g. top name)
                             continue
+                        if b.size:
+                            blobs.append(b)
                 if blobs:
                     layers[name] = blobs
         return layers

@@ -97,6 +97,35 @@ class TestCaffeLoader:
         np.testing.assert_allclose(layers["conv1"][0], w)
         np.testing.assert_allclose(layers["conv1"][1], b)
 
+    def test_v2_param_spec_field_not_a_blob(self, tmp_path):
+        """V2 field 6 is repeated ParamSpec (lr_mult etc.), NOT blobs —
+        it must not shift the blob0=weight/blob1=bias convention."""
+        w = np.ones((2, 2), np.float32)
+        b = np.full(2, 7.0, np.float32)
+        param_spec = _ld(1, b"shared_w")        # ParamSpec.name = 1
+        layer = (_ld(1, b"ip1") + _ld(2, b"InnerProduct")
+                 + _ld(6, param_spec)           # would misparse as blob
+                 + _ld(7, self._blob(w)) + _ld(7, self._blob(b)))
+        p = tmp_path / "v2.caffemodel"
+        p.write_bytes(_ld(100, layer))
+        layers = CaffeLoader.load(str(p))
+        assert len(layers["ip1"]) == 2
+        np.testing.assert_allclose(layers["ip1"][0], w)
+        np.testing.assert_allclose(layers["ip1"][1], b)
+
+    def test_v1_layer_name_and_blobs(self, tmp_path):
+        """V1LayerParameter: bottom=2, top=3, name=4, blobs=6."""
+        w = np.arange(6, dtype=np.float32).reshape(2, 3)
+        b = np.array([1.0, 2.0], np.float32)
+        layer = (_ld(2, b"data") + _ld(3, b"fc1_out") + _ld(4, b"fc1")
+                 + _ld(6, self._blob(w)) + _ld(6, self._blob(b)))
+        p = tmp_path / "v1.caffemodel"
+        p.write_bytes(_ld(2, layer))            # NetParameter.layers = 2
+        layers = CaffeLoader.load(str(p))
+        assert "fc1" in layers
+        np.testing.assert_allclose(layers["fc1"][0], w)
+        np.testing.assert_allclose(layers["fc1"][1], b)
+
     def test_load_into_model(self, tmp_path):
         w = np.random.RandomState(0).randn(4, 3, 3, 3).astype(np.float32)
         b = np.zeros(4, np.float32)

@@ -47,3 +47,49 @@ class TestLLMServer:
             np.testing.assert_array_equal(np.asarray(g), w)
         # with max_batch=2 and 5 requests, slots must have been reused
         assert srv.steps >= max(lens)
+
+    def test_greedy_parity_under_concurrent_jax_load(self, model):
+        """Regression for the round-3 flaky race: concurrent jax
+        executions on OTHER threads let the async CPU runtime recycle
+        the engine's just-dropped cache buffers while the step consuming
+        them was still in flight (14/30 greedy-parity mismatches before
+        the block_until_ready barrier in _prefill_slot/_decode_scatter;
+        0/30 after). Hammer threads + randomized submit timing."""
+        import threading
+        import time
+
+        import jax
+        import jax.numpy as jnp
+
+        ids = np.array([3, 1, 4, 1, 5], np.int32)
+        want = model.generate(ids[None], max_new_tokens=6)[0, 5:]
+        stop = threading.Event()
+
+        def hammer():
+            # input changes every call: some runtimes memoize identical
+            # (program, args) executions, which would make a fixed-input
+            # hammer generate zero real concurrent device traffic
+            a = jax.random.normal(jax.random.PRNGKey(1), (256, 256))
+            f = jax.jit(lambda x: jnp.tanh(x @ x) + 1e-6)
+            while not stop.is_set():
+                a = f(a).block_until_ready()
+
+        threads = [threading.Thread(target=hammer, daemon=True)
+                   for _ in range(4)]
+        for t in threads:
+            t.start()
+        try:
+            for it in range(8):
+                srv = LLMServer(model, max_batch=2, max_seq_len=32).start()
+                try:
+                    time.sleep((it % 4) * 0.001)
+                    req = srv.submit(ids, max_new_tokens=6)
+                    got = np.asarray(req.get(timeout=120))
+                finally:
+                    srv.stop()
+                np.testing.assert_array_equal(got, want,
+                                              err_msg=f"iteration {it}")
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=10)
