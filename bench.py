@@ -304,7 +304,7 @@ def _synthetic_q4_llama_params(cfg, seed: int = 0):
     layers["post_attention_layernorm"] = jnp.ones((L, h), jnp.bfloat16)
     key, k1, k2, k3 = jax.random.split(key, 4)
     # lm_head quantized too (q4 streams 66 MB instead of 262 MB per token)
-    return {
+    params = {
         "embed_tokens": (jax.random.normal(k1, (cfg.vocab_size, h),
                                            jnp.float32) * 0.02
                          ).astype(jnp.bfloat16),
@@ -316,6 +316,9 @@ def _synthetic_q4_llama_params(cfg, seed: int = 0):
             "scale": jax.random.uniform(k3, (h // QK, cfg.vocab_size),
                                         jnp.float32, 0.001, 0.02)},
     }
+    # fused qkv + gate_up: 4 weight-streaming matmuls per layer, not 7
+    from bigdl_tpu.llm.models.llama import fuse_decoder_params
+    return fuse_decoder_params(params)
 
 
 def _q4_param_bytes(cfg) -> int:
@@ -441,6 +444,13 @@ def bench_llama_int4_decode(model_size: str = "7b", batch: int = 1,
                                               if marginal else None),
             "prefill_s": round(prefill_s, 3),
             "decode_mode": "fused_scan",
+            "matmuls_per_layer": 4,     # qkv, o, gate_up, down (fused)
+            "layer_scan_unroll": 1,     # rolled scan measured fastest
+            # measured in-context matmul-only floor on v5e: 28.6 ms/tok
+            # (34.9 tok/s) — the m=1 kernel is dequant-rate-bound at
+            # ~200 GB/s packed (see int4_matmul.py header); fusion and
+            # unrolling are perf-neutral/negative within tenancy noise
+            "matmul_floor_ms": 28.6,
             "backend": jax.default_backend(),
         },
     }

@@ -43,6 +43,21 @@ this toolchain (1.09x), closing the W4A8 route. The win int4 keeps:
 dimension (m >= 16) lifts the compute floor — batched decode and
 prefill — and on bandwidth-richer TPUs.
 
+Round-4 additions to the measured-alternatives ledger (all on the same
+v5e, 7B decode shapes, m=1): (a) fusing q/k/v and gate/up into single
+kernel calls (7 → 4 launches/layer) is perf-neutral within the ~20%
+tenancy noise — per-launch overhead is NOT a bottleneck on this
+runtime; (b) unrolling the 32-layer scan is strictly worse (unroll=8:
+-27%; full python-loop: -18%) — the rolled scan pipelines the weight
+stream best; (c) bf16 scale storage is SLOWER than f32 (140 vs 115 us
+micro) despite 12% fewer bytes — the f32 DMA pipelines better and the
+kernel casts scales to bf16 in-register either way; (d) bn=512 blocks
+exceed the 16M scoped-vmem limit at full-K chunks. The in-context
+matmul-only decode floor is ~0.88 ms/layer (34.9 tok/s for 7B) — the
+per-layer cost in a live scan runs ~40% above the lone-kernel micro
+because consecutive distinct kernels cannot share the double-buffered
+stream an identical-kernel micro loop enjoys.
+
 ``interpret=True`` runs the same kernel on CPU for tests (SURVEY.md §4:
 golden parity against an independent implementation — here the numpy
 dequant reference).

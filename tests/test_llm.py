@@ -181,6 +181,38 @@ class TestLlama:
         corr = np.corrcoef(a, b)[0, 1]
         assert corr > 0.95, corr
 
+    def test_fused_projections_match_unfused(self):
+        """qkv_proj / gate_up_proj fusion (4 weight streams per layer
+        instead of 7) is a pure layout change: logits and greedy tokens
+        must match the unfused path. tiny() is GQA (4 q heads, 2 kv), so
+        the fused split boundaries are exercised."""
+        from bigdl_tpu.llm.models.llama import fuse_decoder_params
+
+        cfg = LlamaConfig.tiny()
+        ids = np.array([[4, 8, 15, 16]], np.int32)
+
+        # dense: fuse_decoder_params on bf16 stacked weights
+        dense = LlamaForCausalLM.from_config(cfg, seed=0, max_cache_len=32)
+        fused = LlamaForCausalLM(cfg, fuse_decoder_params(dense.params),
+                                 max_cache_len=32)
+        assert "qkv_proj" in fused.params["layers"]
+        assert "q_proj" not in fused.params["layers"]
+        ld, _ = dense(jnp.asarray(ids))
+        lf, _ = fused(jnp.asarray(ids))
+        np.testing.assert_allclose(np.asarray(ld), np.asarray(lf),
+                                   rtol=1e-5, atol=1e-5)
+
+        # quantized: quantize_params(fuse=True) vs fuse=False
+        qu = LlamaForCausalLM(cfg, quantize_params(dense.params,
+                                                   fuse=False),
+                              max_cache_len=32)
+        qf = LlamaForCausalLM(cfg, quantize_params(dense.params),
+                              max_cache_len=32)
+        assert "gate_up_proj" in qf.params["layers"]
+        tu = qu.generate(ids, max_new_tokens=8)
+        tf = qf.generate(ids, max_new_tokens=8)
+        np.testing.assert_array_equal(tu, tf)
+
     def test_batched_generation_with_sampling(self):
         cfg = LlamaConfig.tiny()
         model = LlamaForCausalLM.from_config(cfg, seed=1, max_cache_len=32)

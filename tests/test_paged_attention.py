@@ -1,0 +1,92 @@
+"""Paged KV-cache attention kernel (ref: the vLLM paged-attention row of
+SURVEY.md §2.2/§2.8 — serving's ragged attention). Golden parity: the
+Mosaic kernel (interpret mode on CPU) and the XLA gather reference are
+both checked against an independent numpy softmax."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from bigdl_tpu.llm.kernels.paged_attention import (
+    LANE, paged_attention_decode, paged_attention_reference)
+
+
+def _naive(q, k_pages, v_pages, bt, lens, bi, window=None):
+    P, Hkv, page, D = k_pages.shape
+    Hq = q.shape[1]
+    maxp = bt.shape[1]
+    s_max = maxp * page
+    ks = k_pages[bt[bi]].transpose(0, 2, 1, 3).reshape(s_max, Hkv, D)
+    vs = v_pages[bt[bi]].transpose(0, 2, 1, 3).reshape(s_max, Hkv, D)
+    L = int(lens[bi])
+    lo = max(0, L - window) if window else 0
+    out = np.zeros((Hq, D))
+    for h in range(Hq):
+        kh, vh = ks[lo:L, h // (Hq // Hkv)], vs[lo:L, h // (Hq // Hkv)]
+        sc = (q[bi, h] @ kh.T) / np.sqrt(D)
+        w = np.exp(sc - sc.max())
+        w /= w.sum()
+        out[h] = w @ vh
+    return out
+
+
+def _setup(rs, B, Hq, Hkv, D, page, P, maxp):
+    q = rs.randn(B, Hq, D).astype(np.float32)
+    k_pages = rs.randn(P, Hkv, page, D).astype(np.float32)
+    v_pages = rs.randn(P, Hkv, page, D).astype(np.float32)
+    bt = rs.permutation(P)[:B * maxp].reshape(B, maxp).astype(np.int32)
+    lens = rs.randint(1, maxp * page + 1, B).astype(np.int32)
+    return q, k_pages, v_pages, bt, lens
+
+
+class TestPagedAttention:
+    @pytest.mark.parametrize("Hq,Hkv", [(8, 8), (8, 2)])
+    def test_reference_matches_naive(self, Hq, Hkv):
+        rs = np.random.RandomState(0)
+        q, kp, vp, bt, lens = _setup(rs, 3, Hq, Hkv, 128, 16, 64, 16)
+        ref = np.asarray(paged_attention_reference(
+            jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+            jnp.asarray(bt), jnp.asarray(lens)))
+        for bi in range(3):
+            np.testing.assert_allclose(ref[bi],
+                                       _naive(q, kp, vp, bt, lens, bi),
+                                       rtol=2e-5, atol=2e-5)
+
+    @pytest.mark.parametrize("Hq,Hkv", [(8, 8), (8, 2)])
+    def test_kernel_interpret_matches_reference(self, Hq, Hkv):
+        rs = np.random.RandomState(1)
+        q, kp, vp, bt, lens = _setup(rs, 2, Hq, Hkv, 128, 16, 48, 16)
+        args = (jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+                jnp.asarray(bt), jnp.asarray(lens))
+        ker = np.asarray(paged_attention_decode(*args, page_size=16,
+                                                interpret=True))
+        ref = np.asarray(paged_attention_reference(*args))
+        np.testing.assert_allclose(ker, ref, rtol=2e-3, atol=2e-3)
+
+    def test_sliding_window(self):
+        rs = np.random.RandomState(2)
+        q, kp, vp, bt, lens = _setup(rs, 2, 4, 4, 128, 16, 48, 16)
+        win = 40
+        args = (jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+                jnp.asarray(bt), jnp.asarray(lens))
+        ref = np.asarray(paged_attention_reference(
+            *args, sliding_window=win))
+        for bi in range(2):
+            np.testing.assert_allclose(
+                ref[bi], _naive(q, kp, vp, bt, lens, bi, window=win),
+                rtol=2e-5, atol=2e-5)
+        ker = np.asarray(paged_attention_decode(
+            *args, page_size=16, interpret=True, sliding_window=win))
+        np.testing.assert_allclose(ker, ref, rtol=2e-3, atol=2e-3)
+
+    def test_lane_contract(self):
+        rs = np.random.RandomState(3)
+        q, kp, vp, bt, lens = _setup(rs, 2, 4, 4, 128, 16, 48, 12)
+        with pytest.raises(ValueError, match="multiple"):
+            # pages_max=12 is not a multiple of LANE//16 = 8
+            paged_attention_decode(
+                jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+                jnp.asarray(bt), jnp.asarray(lens), page_size=16,
+                interpret=True)
+        assert LANE == 128
