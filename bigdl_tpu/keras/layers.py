@@ -832,3 +832,138 @@ class LocallyConnected1D(KerasLayer):
         t, c = input_shape
         return (_conv_len(t, self.filter_length, self.subsample_length,
                           "valid"), self.nb_filter)
+
+
+class SpatialDropout1D(KerasLayer):
+    """Drops whole (B, T, C) channels (keras SpatialDropout1D)."""
+
+    def __init__(self, p: float = 0.5, **kwargs):
+        super().__init__(**kwargs)
+        self.p = p
+
+    def build_module(self, input_shape):
+        return nn.SpatialDropout1D(self.p)
+
+    def compute_output_shape(self, input_shape):
+        return tuple(input_shape)
+
+
+class SpatialDropout3D(KerasLayer):
+    """Drops whole 3-D volumes; input (B, C, D, H, W)."""
+
+    def __init__(self, p: float = 0.5, **kwargs):
+        super().__init__(**kwargs)
+        self.p = p
+
+    def build_module(self, input_shape):
+        return nn.SpatialDropout3D(self.p, format="NCDHW")
+
+    def compute_output_shape(self, input_shape):
+        return tuple(input_shape)
+
+
+class Cropping3D(KerasLayer):
+    def __init__(self, cropping=((1, 1), (1, 1), (1, 1)), **kwargs):
+        super().__init__(**kwargs)
+        self.cropping = tuple(tuple(c) for c in cropping)
+
+    def build_module(self, input_shape):
+        return nn.Cropping3D(*self.cropping)
+
+    def compute_output_shape(self, input_shape):
+        c, d, h, w = input_shape
+        (d0, d1), (h0, h1), (w0, w1) = self.cropping
+        return (c, d - d0 - d1, h - h0 - h1, w - w0 - w1)
+
+
+class ZeroPadding3D(KerasLayer):
+    def __init__(self, padding=(1, 1, 1), **kwargs):
+        super().__init__(**kwargs)
+        self.padding = tuple(padding)
+
+    def build_module(self, input_shape):
+        import jax.numpy as jnp
+        pd, ph, pw = self.padding
+
+        class _Pad3D(nn.TensorModule):
+            def _apply(self, params, states, x, *, training, rng):
+                return jnp.pad(x, ((0, 0), (0, 0), (pd, pd), (ph, ph),
+                                   (pw, pw)))
+
+        return _Pad3D()
+
+    def compute_output_shape(self, input_shape):
+        c, d, h, w = input_shape
+        pd, ph, pw = self.padding
+        return (c, d + 2 * pd, h + 2 * ph, w + 2 * pw)
+
+
+class GlobalMaxPooling3D(KerasLayer):
+    def build_module(self, input_shape):
+        import jax.numpy as jnp
+
+        class _GMP3D(nn.TensorModule):
+            def _apply(self, params, states, x, *, training, rng):
+                return jnp.max(x, axis=(2, 3, 4))
+
+        return _GMP3D()
+
+    def compute_output_shape(self, input_shape):
+        return (input_shape[0],)
+
+
+class GlobalAveragePooling3D(KerasLayer):
+    def build_module(self, input_shape):
+        import jax.numpy as jnp
+
+        class _GAP3D(nn.TensorModule):
+            def _apply(self, params, states, x, *, training, rng):
+                return jnp.mean(x, axis=(2, 3, 4))
+
+        return _GAP3D()
+
+    def compute_output_shape(self, input_shape):
+        return (input_shape[0],)
+
+
+class ActivityRegularization(KerasLayer):
+    def __init__(self, l1: float = 0.0, l2: float = 0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.l1, self.l2 = l1, l2
+
+    def build_module(self, input_shape):
+        return nn.ActivityRegularization(self.l1, self.l2)
+
+    def compute_output_shape(self, input_shape):
+        return tuple(input_shape)
+
+
+class SReLU(KerasLayer):
+    def build_module(self, input_shape):
+        return nn.SReLU((input_shape[-1],))
+
+    def compute_output_shape(self, input_shape):
+        return tuple(input_shape)
+
+
+class LocallyConnected2D(KerasLayer):
+    """Unshared 2-D convolution (keras LocallyConnected2D), NCHW."""
+
+    def __init__(self, nb_filter: int, nb_row: int, nb_col: int,
+                 subsample=(1, 1), **kwargs):
+        super().__init__(**kwargs)
+        self.nb_filter = nb_filter
+        self.kernel = (nb_row, nb_col)
+        self.subsample = tuple(subsample)
+
+    def build_module(self, input_shape):
+        c, h, w = input_shape
+        return nn.LocallyConnected2D(
+            c, h, w, self.nb_filter, self.kernel[0], self.kernel[1],
+            self.subsample[0], self.subsample[1])
+
+    def compute_output_shape(self, input_shape):
+        c, h, w = input_shape
+        oh = (h - self.kernel[0]) // self.subsample[0] + 1
+        ow = (w - self.kernel[1]) // self.subsample[1] + 1
+        return (self.nb_filter, oh, ow)

@@ -265,6 +265,15 @@ class LLMServer:
         self._remaining[i] = req.max_new_tokens
 
     # -- paged engine --------------------------------------------------------
+    def _step_cache_key(self) -> tuple:
+        """Value key for the shared compiled-step cache. id(cfg) would be
+        unsound (a recycled address after GC aliases a different config)
+        and the closures bake every cfg field, the page size and the
+        cache dtype — so all of them key the entry."""
+        import dataclasses
+        return (dataclasses.astuple(self.cfg), self._page,
+                str(jnp.dtype(self.model.cache_dtype)))
+
     def _build_paged_prefill(self, bucket: int):
         """Compile a prompt prefill for one padded length ``bucket``:
         run the prompt through forward() with a temporary dense cache of
@@ -308,7 +317,7 @@ class LLMServer:
         npages = -(-t // page)
         ids = [self._free.pop() for _ in range(npages)]
         bucket = max(page, 1 << (t - 1).bit_length())   # pow2, >= page
-        key = (id(self.cfg), page, "prefill", bucket)
+        key = self._step_cache_key() + ("prefill", bucket)
         fn = _PAGED_STEP_CACHE.get(key)
         if fn is None:
             fn = _PAGED_STEP_CACHE[key] = self._build_paged_prefill(bucket)
@@ -395,7 +404,7 @@ class LLMServer:
                 self._bt[i, pos // page] = pid
                 self._slot_pages[i].append(pid)
         nxt = np.asarray(jnp.argmax(self._last, axis=-1), np.int32)
-        key = (id(self.cfg), page, "decode")
+        key = self._step_cache_key() + ("decode",)
         pdecode = _PAGED_STEP_CACHE.get(key)
         if pdecode is None:
             pdecode = _PAGED_STEP_CACHE[key] = self._build_paged_decode()

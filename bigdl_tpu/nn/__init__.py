@@ -53,9 +53,21 @@ from bigdl_tpu.nn.criterion import (
     MSECriterion, MultiCriterion, MultiLabelSoftMarginCriterion,
     MultiMarginCriterion, ParallelCriterion, PoissonCriterion,
     SmoothL1Criterion, SoftMarginCriterion, SoftmaxWithCriterion,
-    TimeDistributedCriterion)
+    TimeDistributedCriterion,
+    ClassSimplexCriterion, CosineDistanceCriterion,
+    DiceCoefficientCriterion, GaussianCriterion, KLDCriterion,
+    L1HingeEmbeddingCriterion, MultiLabelMarginCriterion,
+    TimeDistributedMaskCriterion)
 
 from bigdl_tpu.nn import quantized  # noqa: E402,F401  (ref: nn/quantized INT8 layers)
+
+from bigdl_tpu.nn.layers.extra3 import (  # noqa: E402
+    ActivityRegularization, Anchor, BifurcateSplitTable, BinaryThreshold,
+    Cropping1D, DenseToSparse, GaussianSampler, HardShrink, Input,
+    LogSigmoid, MaskedSelect, MultiRNNCell, NegativeEntropyPenalty,
+    PriorBox, ResizeBilinear, RoiPooling, SoftShrink,
+    SpatialConvolutionMap, SpatialDropout1D, SpatialDropout3D,
+    SpatialShareConvolution, TanhShrink)
 
 from bigdl_tpu.nn.layers.extra2 import (  # noqa: E402
     ConvLSTMPeephole, GradientReversal, L1Penalty, MaskedFill,

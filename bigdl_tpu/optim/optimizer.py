@@ -260,7 +260,8 @@ class BaseOptimizer:
         if self._step_fn is None:
             self._step_fn = self._build_step()
         step = self._step_fn
-        key = jax.random.PRNGKey(self.optim_method.host_state.get("seed", 0))
+        from bigdl_tpu.utils.engine import train_rng_key
+        key = train_rng_key(self.optim_method.host_state.get("seed", 0))
 
         batcher = SampleToMiniBatch(self.batch_size)
         state = self.state

@@ -17,6 +17,8 @@ the ``data`` mesh axis, and the Estimator backends are:
 from bigdl_tpu.orca.common import (
     OrcaContext, init_orca_context, stop_orca_context)
 from bigdl_tpu.orca.data import XShards
+from bigdl_tpu.orca.ray_pool import (
+    RayContext, RemoteError, init_ray_on_spark)
 
 __all__ = ["init_orca_context", "stop_orca_context", "OrcaContext",
-           "XShards"]
+           "XShards", "RayContext", "RemoteError", "init_ray_on_spark"]

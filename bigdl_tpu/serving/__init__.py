@@ -5,5 +5,7 @@ Redis out; and orca InferenceModel)."""
 from bigdl_tpu.serving.inference_model import InferenceModel
 from bigdl_tpu.serving.cluster_serving import (
     ClusterServing, InputQueue, OutputQueue)
+from bigdl_tpu.serving.http_frontend import ServingFrontend
 
-__all__ = ["InferenceModel", "ClusterServing", "InputQueue", "OutputQueue"]
+__all__ = ["InferenceModel", "ClusterServing", "InputQueue",
+           "OutputQueue", "ServingFrontend"]

@@ -184,3 +184,21 @@ def init_engine(**kwargs):
 
 def get_mesh():
     return Engine.mesh()
+
+
+def train_rng_key(seed: int = 0):
+    """RNG key for training loops (dropout masks etc.).
+
+    On TPU this returns a key for the hardware RBG generator: threefry
+    dropout masks cost ~40% of a BERT-base fine-tune step on v5e
+    (measured: batch 64, dropout 0.1 — threefry 992 samples/s / MFU
+    0.36, RBG 1517 / MFU 0.52, dropout-off ceiling 1746 / MFU 0.60).
+    Elsewhere it stays threefry for bit-exact test determinism. RBG is
+    counter-based and splittable; it is not a cryptographic stream, which
+    dropout does not need.
+    """
+    import jax
+
+    if jax.default_backend() == "tpu":
+        return jax.random.key(seed, impl="rbg")
+    return jax.random.PRNGKey(seed)

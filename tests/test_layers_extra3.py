@@ -1,0 +1,276 @@
+"""Round-4 layer-zoo tail + criterion tail (ref: S:dllib/nn one-file
+rows; VERDICT r3 missing #2). Golden values are independent numpy."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import bigdl_tpu.nn as nn
+
+
+def _run(layer, x, training=False):
+    y, _ = layer.apply(layer.parameters_dict(), layer.states_dict(),
+                       jnp.asarray(x), training=training,
+                       rng=jax.random.PRNGKey(0))
+    return np.asarray(y)
+
+
+class TestActivationTail:
+    def test_hard_soft_tanh_shrink_logsigmoid(self):
+        x = np.array([[-2.0, -0.3, 0.0, 0.4, 1.5]], np.float32)
+        np.testing.assert_allclose(
+            _run(nn.HardShrink(0.5), x), np.where(np.abs(x) > 0.5, x, 0))
+        np.testing.assert_allclose(
+            _run(nn.SoftShrink(0.5), x),
+            np.sign(x) * np.maximum(np.abs(x) - 0.5, 0))
+        np.testing.assert_allclose(_run(nn.TanhShrink(), x),
+                                   x - np.tanh(x), rtol=1e-5, atol=1e-7)
+        np.testing.assert_allclose(
+            _run(nn.LogSigmoid(), x), np.log(1 / (1 + np.exp(-x))),
+            rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(
+            _run(nn.BinaryThreshold(0.1), x), (x > 0.1).astype(np.float32))
+
+    def test_spatial_dropout_1d_3d(self):
+        rs = np.random.RandomState(0)
+        x = rs.rand(4, 10, 8).astype(np.float32) + 1.0
+        y = _run(nn.SpatialDropout1D(0.5), x, training=True)
+        # whole channels dropped: each (b, :, c) column all-zero or scaled
+        col_zero = (y == 0).all(axis=1)
+        col_live = (y != 0).all(axis=1)
+        assert ((col_zero | col_live)).all()
+        assert col_zero.any() and col_live.any()
+        x3 = rs.rand(2, 6, 3, 4, 5).astype(np.float32) + 1.0
+        y3 = _run(nn.SpatialDropout3D(0.5), x3, training=True)
+        vol = y3.reshape(2, 6, -1)
+        assert (((vol == 0).all(axis=2)) | ((vol != 0).all(axis=2))).all()
+        # inference = identity
+        np.testing.assert_array_equal(_run(nn.SpatialDropout1D(0.5), x), x)
+
+    def test_penalty_identities(self):
+        x = np.array([[0.5, -1.0, 2.0]], np.float32)
+        ar = nn.ActivityRegularization(l1=0.1, l2=0.01)
+        np.testing.assert_array_equal(_run(ar, x, training=True), x)
+        pen = float(ar.penalty_of(jnp.asarray(x)))
+        assert abs(pen - (0.1 * 3.5 + 0.01 * 5.25)) < 1e-5
+        ne = nn.NegativeEntropyPenalty(beta=1.0)
+        p = np.array([[0.5, 0.5]], np.float32)
+        np.testing.assert_array_equal(_run(ne, p, training=True), p)
+        assert abs(float(ne.penalty_of(jnp.asarray(p)))
+                   - (2 * 0.5 * np.log(0.5))) < 1e-5
+
+
+class TestShapeTableTail:
+    def test_cropping1d(self):
+        x = np.arange(2 * 6 * 3, dtype=np.float32).reshape(2, 6, 3)
+        np.testing.assert_array_equal(_run(nn.Cropping1D(1, 2), x),
+                                      x[:, 1:4])
+
+    def test_bifurcate_split(self):
+        x = np.arange(12, dtype=np.float32).reshape(2, 6)
+        m = nn.BifurcateSplitTable(dimension=2)
+        lo, hi = m.apply(m.parameters_dict(), m.states_dict(),
+                         jnp.asarray(x), training=False, rng=None)[0]
+        np.testing.assert_array_equal(np.asarray(lo), x[:, :3])
+        np.testing.assert_array_equal(np.asarray(hi), x[:, 3:])
+
+    def test_masked_select_eager_and_jit_error(self):
+        m = nn.MaskedSelect()
+        x = np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)
+        mask = np.array([[1, 0], [0, 1]], np.float32)
+        out = m.apply(m.parameters_dict(), m.states_dict(),
+                      [jnp.asarray(x), jnp.asarray(mask)],
+                      training=False, rng=None)[0]
+        np.testing.assert_array_equal(np.asarray(out), [1.0, 4.0])
+        with pytest.raises(Exception):
+            jax.jit(lambda a, b: m.apply(
+                {}, {}, [a, b], training=False, rng=None)[0])(
+                    jnp.asarray(x), jnp.asarray(mask))
+
+    def test_dense_to_sparse(self):
+        m = nn.DenseToSparse()
+        x = np.array([[0.0, 2.0], [3.0, 0.0]], np.float32)
+        st = m.apply({}, {}, jnp.asarray(x), training=False, rng=None)[0]
+        np.testing.assert_array_equal(np.asarray(st.to_dense()), x)
+
+    def test_gaussian_sampler_stats(self):
+        m = nn.GaussianSampler()
+        mean = np.full((4096, 2), 3.0, np.float32)
+        logv = np.full((4096, 2), np.log(0.25), np.float32)
+        out = m.apply({}, {}, [jnp.asarray(mean), jnp.asarray(logv)],
+                      training=True, rng=jax.random.PRNGKey(1))[0]
+        out = np.asarray(out)
+        assert abs(out.mean() - 3.0) < 0.05
+        assert abs(out.std() - 0.5) < 0.05
+
+    def test_input_identity(self):
+        x = np.ones((2, 3), np.float32)
+        np.testing.assert_array_equal(_run(nn.Input(), x), x)
+
+
+class TestVisionTail:
+    def test_resize_bilinear(self):
+        x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+        y = _run(nn.ResizeBilinear(2, 2), x)
+        assert y.shape == (1, 1, 2, 2)
+        # downscale preserves mean approximately
+        assert abs(y.mean() - x.mean()) < 1.0
+
+    def test_roi_pooling_max_semantics(self):
+        feats = np.zeros((1, 8, 8, 1), np.float32)
+        feats[0, 2, 3, 0] = 5.0
+        feats[0, 6, 6, 0] = 9.0
+        rois = np.array([[0, 0, 0, 7, 7]], np.float32)
+        m = nn.RoiPooling(pooled_h=2, pooled_w=2, spatial_scale=1.0)
+        out = m.apply({}, {}, [jnp.asarray(feats), jnp.asarray(rois)],
+                      training=False, rng=None)[0]
+        out = np.asarray(out)    # (1, 2, 2, 1)
+        assert out.max() == 9.0
+        assert out[0, 0, 0, 0] == 5.0     # top-left quadrant max
+        assert out[0, 1, 1, 0] == 9.0     # bottom-right quadrant max
+
+    def test_spatial_convolution_map_masks_connections(self):
+        table = [[1, 1], [2, 2]]     # plane i -> plane i only
+        m = nn.SpatialConvolutionMap(table, 3, 3, pad_w=1, pad_h=1)
+        x = np.zeros((1, 2, 5, 5), np.float32)
+        x[0, 0] = 1.0                # only input plane 1 carries signal
+        y = _run(m, x)
+        p = m.parameters_dict()
+        # weight mask: cross connections are zeroed in the effective kernel
+        w = np.asarray(p["weight"]) * np.asarray(m._mask)
+        assert (w[0, 1] == 0).all() and (w[1, 0] == 0).all()
+        # output plane 2 sees no signal from input plane 1 beyond bias
+        assert np.allclose(y[0, 1], y[0, 1].flat[0])
+
+    def test_share_convolution_is_convolution(self):
+        m = nn.SpatialShareConvolution(2, 3, 3, 3)
+        x = np.random.RandomState(0).rand(1, 2, 6, 6).astype(np.float32)
+        ref = nn.SpatialConvolution(2, 3, 3, 3)
+        ref.load_parameters_dict(m.parameters_dict())
+        np.testing.assert_allclose(_run(m, x), _run(ref, x), rtol=1e-5)
+
+    def test_priorbox_and_anchor(self):
+        x = np.zeros((1, 4, 2, 2), np.float32)
+        pb = nn.PriorBox(min_sizes=[30.0], aspect_ratios=(2.0,),
+                         img_h=300, img_w=300)
+        out = np.asarray(_run(pb, x))
+        # 2x2 cells x 3 anchors (min, ar2, ar1/2) x 4 coords
+        assert out.shape == (1, 2, 2 * 2 * 3 * 4)
+        anc = nn.Anchor(stride=16, sizes=(32.0,), ratios=(1.0,))
+        a = np.asarray(_run(anc, x))
+        assert a.shape == (2 * 2 * 1, 4)
+
+
+class TestMultiRNNCell:
+    def test_stacked_cells_in_recurrent(self):
+        rs = np.random.RandomState(0)
+        cell = nn.MultiRNNCell([nn.RnnCell(4, 8), nn.RnnCell(8, 6)])
+        rec = nn.Recurrent(cell)
+        x = rs.rand(3, 5, 4).astype(np.float32)
+        y = _run(rec, x)
+        assert y.shape == (3, 5, 6)   # return_sequences default
+        assert np.isfinite(y).all()
+
+
+class TestCriterionTail:
+    def test_cosine_distance(self):
+        x = np.array([[1.0, 0.0]], np.float32)
+        t = np.array([[0.0, 1.0]], np.float32)
+        c = nn.CosineDistanceCriterion()
+        assert abs(c.forward(x, t) - 1.0) < 1e-6
+        assert abs(c.forward(x, x) - 0.0) < 1e-6
+
+    def test_dice(self):
+        c = nn.DiceCoefficientCriterion(epsilon=0.0)
+        x = np.array([[1.0, 1.0, 0.0, 0.0]], np.float32)
+        assert abs(c.forward(x, x)) < 1e-6        # perfect overlap
+        t = np.array([[0.0, 0.0, 1.0, 1.0]], np.float32)
+        assert abs(c.forward(x, t) - 1.0) < 1e-6  # disjoint
+
+    def test_kld_and_gaussian(self):
+        mean = np.zeros((2, 3), np.float32)
+        logv = np.zeros((2, 3), np.float32)
+        kld = nn.KLDCriterion()
+        assert abs(kld.forward([mean, logv], None)) < 1e-6  # N(0,1)||N(0,1)
+        g = nn.GaussianCriterion()
+        t = np.zeros((2, 3), np.float32)
+        want = 3 * 0.5 * np.log(2 * np.pi)
+        assert abs(g.forward([mean, logv], t) - want) < 1e-4
+
+    def test_l1_hinge_embedding(self):
+        c = nn.L1HingeEmbeddingCriterion(margin=2.0)
+        x1 = np.array([[1.0, 2.0]], np.float32)
+        x2 = np.array([[0.0, 0.5]], np.float32)   # L1 distance 2.5
+        assert abs(c.forward([x1, x2], np.array([1.0])) - 2.5) < 1e-6
+        assert abs(c.forward([x1, x2], np.array([-1.0])) - 0.0) < 1e-6
+
+    def test_multilabel_margin(self):
+        c = nn.MultiLabelMarginCriterion()
+        x = np.array([[0.1, 0.2, 0.4, 0.8]], np.float32)
+        t = np.array([[3, 0, 0, 0]], np.float32)  # class 3 (1-based)
+        # torch golden: sum over non-target i of max(0,1-(x[2]-x[i]))/4
+        want = (max(0, 1 - (0.4 - 0.1)) + max(0, 1 - (0.4 - 0.2))
+                + max(0, 1 - (0.4 - 0.8))) / 4
+        assert abs(c.forward(x, t) - want) < 1e-5
+        # class 1 as a target must not be clobbered by the zero padding
+        # that scatters to the same index (review r4 finding)
+        t1 = np.array([[1, 0, 0, 0]], np.float32)
+        want1 = (max(0, 1 - (0.1 - 0.2)) + max(0, 1 - (0.1 - 0.4))
+                 + max(0, 1 - (0.1 - 0.8))) / 4
+        assert abs(c.forward(x, t1) - want1) < 1e-5
+
+    def test_class_simplex(self):
+        c = nn.ClassSimplexCriterion(n_classes=3)
+        goal = np.asarray(c._targets)
+        # vertices are unit-norm, pairwise-equidistant
+        np.testing.assert_allclose(np.linalg.norm(goal, axis=1), 1.0,
+                                   rtol=1e-5)
+        x = goal[0][None]
+        assert abs(c.forward(x, np.array([1.0]))) < 1e-10
+
+    def test_time_distributed_mask(self):
+        base = nn.MSECriterion()
+        c = nn.TimeDistributedMaskCriterion(base)
+        x = np.ones((2, 3, 4), np.float32)
+        labels = np.zeros((2, 3, 4), np.float32)
+        mask = np.ones((2, 3), np.float32)
+        # all steps live: equals plain per-step MSE = 1.0
+        assert abs(c.forward(x, [labels, mask]) - 1.0) < 1e-6
+        # masked SAMPLES contribute exactly zero (review r4 finding):
+        # row 1's labels are garbage but row 1 is fully masked out
+        labels2 = labels.copy()
+        labels2[1] = 100.0
+        mask2 = np.stack([np.ones(3), np.zeros(3)]).astype(np.float32)
+        assert abs(c.forward(x, [labels2, mask2]) - 1.0) < 1e-6
+
+
+class TestKerasTail:
+    def test_new_keras_layers_shape_inference(self):
+        from bigdl_tpu.keras.layers import (
+            ActivityRegularization, Cropping3D, GlobalAveragePooling3D,
+            GlobalMaxPooling3D, LocallyConnected2D, SReLU,
+            SpatialDropout1D, SpatialDropout3D, ZeroPadding3D)
+        from bigdl_tpu.keras.topology import Sequential
+
+        rs = np.random.RandomState(0)
+        m = Sequential()
+        m.add(ZeroPadding3D((1, 1, 1), input_shape=(2, 3, 4, 5)))
+        m.add(Cropping3D(((1, 1), (1, 1), (1, 1))))
+        m.add(SpatialDropout3D(0.3))
+        m.add(GlobalAveragePooling3D())
+        out = m.predict(rs.rand(2, 2, 3, 4, 5).astype(np.float32))
+        assert out.shape == (2, 2)
+
+        m2 = Sequential()
+        m2.add(SpatialDropout1D(0.3, input_shape=(6, 4)))
+        m2.add(SReLU())
+        m2.add(ActivityRegularization(l1=0.01))
+        out2 = m2.predict(rs.rand(3, 6, 4).astype(np.float32))
+        assert out2.shape == (3, 6, 4)
+
+        m3 = Sequential()
+        m3.add(LocallyConnected2D(6, 3, 3, input_shape=(2, 8, 8)))
+        out3 = m3.predict(rs.rand(2, 2, 8, 8).astype(np.float32))
+        assert out3.shape == (2, 6, 6, 6)

@@ -211,3 +211,87 @@ class TestTF2Estimator:
         assert stats[-1] < stats[0]
         metrics = est.evaluate({"x": x, "y": y})
         assert metrics["Accuracy"] > 0.9, metrics
+
+
+def _square(v):
+    return v * v
+
+
+class TestRayPool:
+    """RayContext — the RayOnSpark worker-pool role on stdlib spawn
+    processes (SURVEY §2.7 row 49; VERDICT r3 missing #6)."""
+
+    def test_remote_map_and_errors(self):
+        from bigdl_tpu.orca import RayContext, RemoteError
+
+        with RayContext(num_workers=2) as ctx:
+            ref = ctx.remote(_square)(7)
+            assert ctx.get(ref, timeout=60) == 49
+            assert ctx.map(_square, [1, 2, 3], timeout=60) == [1, 4, 9]
+            # closures travel via cloudpickle like Ray remotes
+            k = 10
+            assert ctx.get(ctx.remote(lambda v: v + k)(5), timeout=60) == 15
+            with pytest.raises(RemoteError, match="ValueError"):
+                def boom(_):
+                    raise ValueError("nope")
+                ctx.get(ctx.remote(boom)(1), timeout=60)
+
+    def test_parallel_automl_trials(self):
+        from bigdl_tpu.orca import RayContext
+        from bigdl_tpu.orca.automl import hp
+        from bigdl_tpu.orca.automl.auto_estimator import AutoEstimator
+
+        rs = np.random.RandomState(0)
+        x = rs.rand(128, 4).astype(np.float32)
+        y = (x @ np.array([1.0, -2.0, 0.5, 3.0], np.float32))[:, None]
+
+        class Ridge:
+            def __init__(self, config):
+                self.lam = config["lam"]
+                self.w = None
+
+            def fit(self, data, epochs=1, batch_size=32):
+                xx, yy = data
+                a = xx.T @ xx + self.lam * np.eye(xx.shape[1])
+                self.w = np.linalg.solve(a, xx.T @ yy)
+
+            def evaluate(self, data, metrics=("mse",)):
+                xx, yy = data
+                return [float(np.mean((xx @ self.w - yy) ** 2))]
+
+        est = AutoEstimator(lambda cfg: Ridge(cfg), metric="mse",
+                            mode="min")
+        with RayContext(num_workers=2) as ctx:
+            est.fit((x, y), search_space={"lam": hp.grid_search(
+                [10.0, 1.0, 1e-4])}, ray_ctx=ctx)
+        assert est.get_best_config()["lam"] == 1e-4
+        assert est.get_best_model() is not None
+        assert len(est.trials) == 3
+
+    def test_asha_scheduler_spends_fewer_epochs(self):
+        from bigdl_tpu.orca.automl import hp
+        from bigdl_tpu.orca.automl.auto_estimator import AutoEstimator
+
+        spent = []
+
+        class Slow:
+            def __init__(self, config):
+                self.q = config["q"]
+                self.epochs = 0
+
+            def fit(self, data, epochs=1, batch_size=32):
+                self.epochs += epochs
+                spent.append(epochs)
+
+            def evaluate(self, data, metrics=("mse",)):
+                # score improves with epochs; quality gap dominates
+                return [self.q + 1.0 / (1 + self.epochs)]
+
+        est = AutoEstimator(lambda cfg: Slow(cfg), metric="mse",
+                            mode="min")
+        est.fit(None, search_space={"q": hp.choice(
+            [3.0, 2.0, 1.0, 0.0])}, epochs=8, scheduler="asha",
+            grace_epochs=1, reduction_factor=2)
+        assert est.get_best_config()["q"] == 0.0
+        total = sum(spent)
+        assert total < 4 * 8, total    # strictly below exhaustive budget

@@ -141,8 +141,10 @@ class TestHFSafetensorsInterop:
         path, ids, ref = hf_ckpt
         model = AutoModelForCausalLM.from_pretrained(
             path, load_in_4bit=True, max_cache_len=32)
-        lp = model.params["layers"]["q_proj"]
+        # quantize-on-load emits the fused-projection layout (r4)
+        lp = model.params["layers"]["qkv_proj"]
         assert "q" in lp and "scale" in lp and "w" not in lp
+        assert "q_proj" not in model.params["layers"]
         out = model.generate(ids.astype(np.int32), max_new_tokens=8)
         assert out.shape == (1, ids.shape[1] + 8)
         # q4 logits still rank like fp32 on the first next token
