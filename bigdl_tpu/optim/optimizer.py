@@ -324,6 +324,11 @@ class BaseOptimizer:
             jax.tree_util.tree_map(np.asarray, params))
         self.model.load_states_dict(
             jax.tree_util.tree_map(np.asarray, states))
+        # expose the final optimizer slots (momenta etc.) so drivers that
+        # re-enter training across process boundaries (nano
+        # multi-instance) can resume instead of resetting them
+        self._last_opt_state = jax.tree_util.tree_map(np.asarray,
+                                                      opt_state)
         return self.model
 
     def _drain_loss(self):

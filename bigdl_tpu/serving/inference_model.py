@@ -23,7 +23,12 @@ class InferenceModel:
         self._fwd = None
         self._params = None
         self._states = None
-        self._lock = threading.Lock()
+        # the reference's knob bounds in-flight requests per model (its
+        # OpenVINO executables are pooled); XLA executables are
+        # thread-safe, so here it is an admission semaphore — requests
+        # beyond the limit queue instead of stacking device work
+        self.supported_concurrent_num = max(1, supported_concurrent_num)
+        self._gate = threading.Semaphore(self.supported_concurrent_num)
 
     # -- loaders (ref: doLoadBigDL/doLoadTF/doLoadOpenVINO/doLoadPytorch) ----
     def load_bigdl(self, model_path: str = None, model: Module = None):
@@ -52,8 +57,9 @@ class InferenceModel:
     def do_predict(self, x: np.ndarray) -> np.ndarray:
         if self._fwd is None:
             raise RuntimeError("load a model first")
-        return np.asarray(self._fwd(self._params, self._states,
-                                    jnp.asarray(x)))
+        with self._gate:
+            return np.asarray(self._fwd(self._params, self._states,
+                                        jnp.asarray(x)))
 
     predict = do_predict
 
