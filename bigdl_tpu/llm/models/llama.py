@@ -48,6 +48,9 @@ class LlamaConfig:
     # Mistral-style sliding-window attention: position p attends only to
     # [p - sliding_window + 1, p]. None = full causal (Llama).
     sliding_window: Optional[int] = None
+    # Qwen2-style attention bias: q/k/v projections carry biases
+    # (o_proj and the MLP stay bias-free, matching HF Qwen2)
+    attention_bias: bool = False
     # Mixture-of-experts FFN (Mixtral-style): 0 = dense FFN. With
     # num_experts > 0 every decoder MLP becomes num_experts switch-FFN
     # experts with top-k routing and static expert capacity
@@ -78,6 +81,23 @@ class LlamaConfig:
         return cls(intermediate_size=14336, num_key_value_heads=8,
                    max_position_embeddings=8192, sliding_window=4096,
                    rms_norm_eps=1e-5, rope_theta=10000.0)
+
+    @classmethod
+    def qwen2_7b(cls) -> "LlamaConfig":
+        """Qwen2-7B: Llama block + GQA(4) + q/k/v biases (ref:
+        P:llm/transformers model families — qwen lineage)."""
+        return cls(vocab_size=152064, hidden_size=3584,
+                   intermediate_size=18944, num_hidden_layers=28,
+                   num_attention_heads=28, num_key_value_heads=4,
+                   max_position_embeddings=32768, rope_theta=1e6,
+                   rms_norm_eps=1e-6, attention_bias=True)
+
+    @classmethod
+    def tiny_qwen2(cls, vocab: int = 256) -> "LlamaConfig":
+        return cls(vocab_size=vocab, hidden_size=64, intermediate_size=128,
+                   num_hidden_layers=2, num_attention_heads=4,
+                   num_key_value_heads=2, max_position_embeddings=128,
+                   attention_bias=True)
 
     @classmethod
     def mixtral_8x7b(cls) -> "LlamaConfig":
@@ -116,7 +136,13 @@ class LlamaConfig:
             rms_norm_eps=g("rms_norm_eps", 1e-5),
             rope_theta=g("rope_theta", 10000.0),
             tie_word_embeddings=g("tie_word_embeddings", False),
-            sliding_window=g("sliding_window", None),
+            # Qwen2 configs carry sliding_window=4096 but apply it only
+            # when use_sliding_window is set (HF default False) — an
+            # unconditional read would window-mask every layer
+            sliding_window=(g("sliding_window", None)
+                            if g("use_sliding_window", True) else None),
+            attention_bias=bool(g("attention_bias",
+                                  g("model_type", "") == "qwen2")),
             num_experts=g("num_local_experts", 0) or 0,
             num_experts_per_tok=g("num_experts_per_tok", 2) or 2)
 
@@ -152,14 +178,24 @@ def fuse_decoder_params(params: Dict[str, Any]) -> Dict[str, Any]:
         if "w" in ds[0]:
             if any("w" not in d or d["w"].ndim != 3 for d in ds):
                 continue                      # MoE expert-stacked: skip
-            layers[fused] = {"w": jnp.concatenate([d["w"] for d in ds],
-                                                  axis=1)}
+            fd = {"w": jnp.concatenate([d["w"] for d in ds], axis=1)}
         else:
             if any("q" not in d for d in ds):
                 continue
-            layers[fused] = {
-                k: jnp.concatenate([d[k] for d in ds], axis=-1)
-                for k in ("q", "scale", "zero") if k in ds[0]}
+            fd = {k: jnp.concatenate([d[k] for d in ds], axis=-1)
+                  for k in ("q", "scale", "zero") if k in ds[0]}
+        if any("b" in d for d in ds):
+            # bias rides along (zeros where a part has none, e.g. a
+            # hypothetical mixed layout — lazily built, normal Qwen2
+            # layouts have all three)
+            ref_b = next(d["b"] for d in ds if "b" in d)
+            n_of = (lambda d: d["w"].shape[1] if "w" in d
+                    else d["q"].shape[-1])
+            fd["b"] = jnp.concatenate(
+                [d["b"] if "b" in d
+                 else jnp.zeros((ref_b.shape[0], n_of(d)), ref_b.dtype)
+                 for d in ds], axis=-1)
+        layers[fused] = fd
         for p in parts:
             del layers[p]
     out = dict(params)
@@ -205,6 +241,10 @@ def init_params(cfg: LlamaConfig, seed: int = 0,
                                     (L, cfg.num_experts) + shape)}
         else:
             layers[name] = {"w": mk(keys[i], (L,) + shape)}
+    if cfg.attention_bias:
+        for name in ("q_proj", "k_proj", "v_proj"):
+            n_out = shapes[name][0]
+            layers[name]["b"] = jnp.zeros((L, n_out), dtype)
     if cfg.num_experts:
         layers["router"] = {"w": mk(keys[-4], (L, cfg.num_experts, h))}
     layers["input_layernorm"] = jnp.ones((L, h), dtype)
@@ -255,8 +295,11 @@ def quantize_params(params: Dict[str, Any], qtype: str = "sym_int4",
             ss.append(td["scale"])
         # NOTE: no "qtype" string key here — the stacked layer pytree is
         # scanned, so every leaf must be an L-leading array
-        layers[name] = {"q": jnp.asarray(np.stack(qs)),
-                        "scale": jnp.asarray(np.stack(ss))}
+        nd = {"q": jnp.asarray(np.stack(qs)),
+              "scale": jnp.asarray(np.stack(ss))}
+        if "b" in layers[name]:
+            nd["b"] = layers[name]["b"]      # biases stay dense
+        layers[name] = nd
     out["layers"] = layers
     if fuse:
         out = fuse_decoder_params(out)
@@ -336,10 +379,16 @@ def param_pspecs(params: Dict[str, Any],
 # ---------------------------------------------------------------------------
 
 def _linear(wd: Dict[str, Any], x: jnp.ndarray) -> jnp.ndarray:
-    """Dense or quantized matmul: x (..., K) → (..., N). Quantized
-    weights are the k-major TPU layout (q (K/2, N), scale (G, N))."""
+    """Dense or quantized matmul: x (..., K) → (..., N), plus an
+    optional bias ``b`` (N,) — Qwen2's q/k/v carry one; biases stay
+    dense even when weights are ggml-quantized (reference behavior).
+    Quantized weights are the k-major TPU layout (q (K/2, N),
+    scale (G, N))."""
     if "w" in wd:
-        return x @ wd["w"].T.astype(x.dtype)
+        y = x @ wd["w"].T.astype(x.dtype)
+        if "b" in wd:
+            y = y + wd["b"].astype(y.dtype)
+        return y
     shape = x.shape
     x2 = x.reshape(-1, shape[-1])
     if jax.default_backend() == "tpu":
@@ -347,6 +396,8 @@ def _linear(wd: Dict[str, Any], x: jnp.ndarray) -> jnp.ndarray:
         y = int4_matmul(x2, wd["q"], wd["scale"], out_dtype=x.dtype)
     else:
         y = (x2 @ _dequant_q4(wd, x.dtype)).astype(x.dtype)
+    if "b" in wd:
+        y = y + wd["b"].astype(y.dtype)
     return y.reshape(shape[:-1] + (y.shape[-1],))
 
 

@@ -112,6 +112,13 @@ def load_hf_llama_safetensors(path: str, cfg: Optional[LlamaConfig] = None,
             layers[name] = {"w": jnp.asarray(np.stack(
                 [np.asarray(get(fmt.format(l)), np.float32)
                  for l in range(L)]), dtype)}
+    for name in ("q_proj", "k_proj", "v_proj"):
+        bias_key = f"model.layers.0.self_attn.{name}.bias"
+        if bias_key in key_map:
+            layers[name]["b"] = jnp.asarray(np.stack(
+                [np.asarray(get(
+                    f"model.layers.{l}.self_attn.{name}.bias"),
+                    np.float32) for l in range(L)]))
     for norm in ("input_layernorm", "post_attention_layernorm"):
         layers[norm] = jnp.asarray(np.stack(
             [np.asarray(get(f"model.layers.{l}.{norm}.weight"), np.float32)
@@ -168,6 +175,11 @@ def _hf_to_params(model, cfg: LlamaConfig) -> Dict[str, Any]:
             stack("model.layers.{}.post_attention_layernorm.weight"),
             jnp.bfloat16),
     }
+    # Qwen2-family attention biases ride along when present
+    for name in ("q_proj", "k_proj", "v_proj"):
+        key = "model.layers.{}.self_attn." + name + ".bias"
+        if key.format(0) in sd:
+            layers[name]["b"] = jnp.asarray(stack(key), jnp.float32)
     params = {
         "embed_tokens": jnp.asarray(sd["model.embed_tokens.weight"],
                                     jnp.bfloat16),

@@ -1,0 +1,50 @@
+"""Parallel hyperparameter search over the RayContext process pool +
+ASHA successive halving (ref: orca.automl's Ray-Tune lineage and the
+RayOnSpark worker-pool role)."""
+
+import numpy as np
+
+
+class _Ridge:
+    def __init__(self, config):
+        self.lam = config["lam"]
+        self.w = None
+
+    def fit(self, data, epochs=1, batch_size=32):
+        x, y = data
+        a = x.T @ x + self.lam * np.eye(x.shape[1])
+        self.w = np.linalg.solve(a, x.T @ y)
+
+    def evaluate(self, data, metrics=("mse",)):
+        x, y = data
+        return [float(np.mean((x @ self.w - y) ** 2))]
+
+
+def main(smoke: bool = False):
+    from bigdl_tpu.orca import RayContext
+    from bigdl_tpu.orca.automl import hp
+    from bigdl_tpu.orca.automl.auto_estimator import AutoEstimator
+
+    rs = np.random.RandomState(0)
+    x = rs.rand(256, 6).astype(np.float32)
+    y = (x @ rs.randn(6, 1)).astype(np.float32)
+
+    est = AutoEstimator(lambda cfg: _Ridge(cfg), metric="mse",
+                        mode="min")
+    with RayContext(num_workers=2) as ctx:
+        est.fit((x, y), search_space={
+            "lam": hp.grid_search([10.0, 0.1, 1e-5])}, ray_ctx=ctx)
+    print("parallel grid best:", est.get_best_config(),
+          "mse:", est.best_score)
+
+    est2 = AutoEstimator(lambda cfg: _Ridge(cfg), metric="mse",
+                         mode="min")
+    est2.fit((x, y), search_space={
+        "lam": hp.choice([10.0, 1.0, 0.1, 1e-5])}, n_sampling=4,
+        scheduler="asha", epochs=4)
+    print("asha best:", est2.get_best_config())
+    return est.get_best_config()
+
+
+if __name__ == "__main__":
+    main()

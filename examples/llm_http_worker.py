@@ -1,0 +1,42 @@
+"""Paged-KV LLM serving behind the FastChat-style HTTP worker (ref:
+bigdl-llm's FastChat integration — a worker process serving
+/worker_generate over the continuous-batching engine)."""
+
+import http.client
+import json
+
+import numpy as np
+
+
+def main(smoke: bool = False):
+    from bigdl_tpu.llm.models.llama import LlamaConfig
+    from bigdl_tpu.llm.serving import LLMServer
+    from bigdl_tpu.llm.transformers import AutoModelForCausalLM
+    from bigdl_tpu.llm.worker import LLMWorker
+
+    model = AutoModelForCausalLM.from_pretrained(
+        LlamaConfig.tiny(), load_in_4bit=True, max_cache_len=64)
+    # paged KV cache: HBM proportional to tokens in flight
+    srv = LLMServer(model, max_batch=2, max_seq_len=32,
+                    page_size=16).start()
+    worker = LLMWorker(srv, model_name="demo-llm").start()
+    try:
+        conn = http.client.HTTPConnection(*worker.address, timeout=300)
+        conn.request("POST", "/worker_generate",
+                     json.dumps({"prompt_ids": [1, 2, 3],
+                                 "max_new_tokens": 6}),
+                     {"Content-Type": "application/json"})
+        out = json.loads(conn.getresponse().read())
+        print("worker_generate:", out)
+        conn.request("GET", "/worker_get_status")
+        print("status:", json.loads(conn.getresponse().read()))
+        conn.close()
+        assert len(out["output_ids"]) == 6
+        return out
+    finally:
+        worker.stop()
+        srv.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -597,6 +597,95 @@ class TestMoE:
         assert np.isfinite(np.asarray(lg, np.float32)).all()
 
 
+class TestQwen2CrossCheck:
+    def test_matches_hf_qwen2_numerics(self):
+        """Golden parity vs HF torch Qwen2 — third cross-checked family
+        (Llama block + GQA + q/k/v biases, the qwen lineage of the
+        reference's model zoo)."""
+        torch = pytest.importorskip("torch")
+        transformers = pytest.importorskip("transformers")
+
+        hf_cfg = transformers.Qwen2Config(
+            vocab_size=96, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=64,
+            rms_norm_eps=1e-6, rope_theta=10000.0,
+            tie_word_embeddings=False, use_sliding_window=False,
+            attn_implementation="eager")
+        torch.manual_seed(0)
+        hf = transformers.Qwen2ForCausalLM(hf_cfg).eval()
+
+        from bigdl_tpu.llm.models.llama import LlamaConfig as Cfg
+        from bigdl_tpu.llm.transformers.model import _hf_to_params
+
+        cfg = Cfg.from_hf(hf_cfg)
+        assert cfg.attention_bias, "qwen2 must map to attention_bias"
+        # HF Qwen2 default: sliding_window present but NOT applied
+        # (use_sliding_window=False) — must not window-mask our layers
+        assert cfg.sliding_window is None
+        params = _hf_to_params(hf, cfg)
+        assert "b" in params["layers"]["q_proj"]
+        params = jax.tree_util.tree_map(
+            lambda a: a.astype(jnp.float32)
+            if a.dtype == jnp.bfloat16 else a, params)
+
+        ids = np.array([[3, 17, 42, 9, 61, 7, 25, 50]], np.int32)
+        with torch.no_grad():
+            ref = hf(torch.tensor(ids, dtype=torch.long)) \
+                .logits.numpy()
+
+        cache = init_cache(cfg, 1, 16, dtype=jnp.float32)
+        pos = jnp.arange(ids.shape[1])[None, :]
+        ours, _ = forward(params, cfg, jnp.asarray(ids), cache, pos)
+        ours = np.asarray(ours)
+        scale = np.abs(ref).max()
+        assert np.abs(ours - ref).max() / scale < 0.02, \
+            np.abs(ours - ref).max() / scale
+
+    def test_quantized_bias_generate_and_fusion(self):
+        """Quantized Qwen2: biases stay dense beside q4 planes, survive
+        qkv fusion, and fused == unfused greedy tokens."""
+        cfg = LlamaConfig.tiny_qwen2()
+        dense = LlamaForCausalLM.from_config(cfg, seed=0, max_cache_len=32)
+        assert "b" in dense.params["layers"]["q_proj"]
+        qf = LlamaForCausalLM(cfg, quantize_params(dense.params),
+                              max_cache_len=32)
+        assert "b" in qf.params["layers"]["qkv_proj"]
+        qu = LlamaForCausalLM(cfg, quantize_params(dense.params,
+                                                   fuse=False),
+                              max_cache_len=32)
+        ids = np.array([[4, 8, 15]], np.int32)
+        np.testing.assert_array_equal(
+            qf.generate(ids, max_new_tokens=8),
+            qu.generate(ids, max_new_tokens=8))
+
+    def test_paged_server_serves_qwen2(self):
+        """The paged server handles bias models (attention_qkv plumbs
+        the fused bias through prefill and decode)."""
+        from bigdl_tpu.llm.serving import LLMServer
+
+        cfg = LlamaConfig.tiny_qwen2()
+        # non-zero biases so a dropped bias would change tokens
+        model = LlamaForCausalLM.from_config(cfg, seed=0, max_cache_len=64)
+        key = jax.random.PRNGKey(9)
+        lay = dict(model.params["layers"])
+        for i, name in enumerate(("q_proj", "k_proj", "v_proj")):
+            d = dict(lay[name])
+            d["b"] = jax.random.normal(
+                jax.random.fold_in(key, i), d["b"].shape,
+                jnp.float32) * 0.3
+            lay[name] = d
+        model.params = dict(model.params, layers=lay)
+        ids = np.array([3, 1, 4, 1, 5], np.int32)
+        want = model.generate(ids[None], max_new_tokens=6)[0, 5:]
+        srv = LLMServer(model, max_batch=2, max_seq_len=32).start()
+        try:
+            got = srv.submit(ids, max_new_tokens=6).get(timeout=300)
+        finally:
+            srv.stop()
+        np.testing.assert_array_equal(np.asarray(got), want)
+
+
 class TestMistralCrossCheck:
     def test_matches_hf_mistral_numerics(self):
         """Golden parity vs HF torch Mistral (sliding-window family) —
