@@ -529,6 +529,55 @@ def bench_llama_int4_decode(model_size: str = "7b", batch: int = 1,
     }
 
 
+def bench_llama_longctx_prefill(prompt_len: int = 4096,
+                                model_size: str = "7b") -> dict:
+    """Long-context north star: 7B q4_0 prefill at 4k on one chip via
+    the blockwise online-softmax attention path (the (T, S) score
+    matrix never materializes past one attn_block_size column — what
+    lets 4k+ fit beside 4.1 GB of weights). Throughput reported as the
+    slope between half- and full-length prompts so the fixed
+    dispatch/fetch roundtrip cancels."""
+    import jax
+    import jax.numpy as jnp
+
+    from bigdl_tpu.llm.models.llama import LlamaConfig, LlamaForCausalLM
+
+    cfg = {"7b": LlamaConfig.llama2_7b,
+           "tiny": LlamaConfig.tiny}[model_size]()
+    limit = min(prompt_len, cfg.max_position_embeddings)
+    params = _synthetic_q4_llama_params(cfg)
+    model = LlamaForCausalLM(cfg, params, max_cache_len=limit)
+    rs = np.random.RandomState(0)
+
+    def run(plen):
+        ids = jnp.asarray(rs.randint(0, cfg.vocab_size, (1, plen)),
+                          jnp.int32)
+        lg, _ = model(ids)              # compile
+        int(np.asarray(jnp.argmax(lg[0, -1])))
+        ids = jnp.asarray(rs.randint(0, cfg.vocab_size, (1, plen)),
+                          jnp.int32)
+        t0 = time.perf_counter()
+        lg, _ = model(ids)
+        int(np.asarray(jnp.argmax(lg[0, -1])))
+        return time.perf_counter() - t0
+
+    t_half = run(limit // 2)
+    t_full = run(limit)
+    marginal = ((limit - limit // 2) / (t_full - t_half)
+                if t_full > t_half else None)   # dispatch-dominated:
+    # a noise-driven slope would print nonsense throughput
+    return {"metric": "llama2_7b_int4_prefill_4k",
+            "value": round(limit / t_full, 1),
+            "unit": "tokens/sec",
+            "vs_baseline": None,
+            "extra": {"prompt_len": limit,
+                      "wall_s": round(t_full, 3),
+                      "marginal_tokens_per_s": (round(marginal, 1)
+                                                if marginal else None),
+                      "attn_block_size": cfg.attn_block_size,
+                      "backend": jax.default_backend()}}
+
+
 def bench_int4_kernel_micro(m: int = 1, k: int = 4096, n: int = 11008,
                             iters: int = 2000) -> dict:
     """Kernel roofline check: Pallas q4_0 matmul vs dense bf16 matmul at a
@@ -634,6 +683,10 @@ def _default_run(quick: bool) -> dict:
         out["extra"]["bert_finetune"] = bench_bert_finetune()
     except Exception as e:
         out["extra"]["bert_finetune"] = {"error": repr(e)}
+    try:
+        out["extra"]["llama_longctx_prefill"] = bench_llama_longctx_prefill()
+    except Exception as e:
+        out["extra"]["llama_longctx_prefill"] = {"error": repr(e)}
     try:
         out["extra"]["lenet_convergence"] = bench_lenet_convergence()
     except Exception as e:

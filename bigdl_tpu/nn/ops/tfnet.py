@@ -364,7 +364,93 @@ _HANDLERS: Dict[str, Callable] = {
         constant_values=float(np.asarray(ins[2]))),
     "ResizeBilinear": lambda ins, n: _resize(ins, n, "bilinear"),
     "ResizeNearestNeighbor": lambda ins, n: _resize(ins, n, "nearest"),
+    # r4 tail toward the reference's full op table
+    "Gather": lambda ins, n: jnp.take(
+        ins[0], jnp.asarray(ins[1]).astype(jnp.int32), axis=0),
+    "GatherNd": lambda ins, n: ins[0][
+        tuple(jnp.moveaxis(jnp.asarray(ins[1]).astype(jnp.int32),
+                           -1, 0))],
+    "OneHot": lambda ins, n: _one_hot(ins, n),
+    "Cumsum": lambda ins, n: _cumsum(
+        ins[0], int(np.asarray(ins[1])),
+        exclusive=_attr(n, "exclusive", False),
+        reverse=_attr(n, "reverse", False)),
+    "Cumprod": lambda ins, n: _cumprod(
+        ins[0], int(np.asarray(ins[1])),
+        exclusive=_attr(n, "exclusive", False),
+        reverse=_attr(n, "reverse", False)),
+    "TopKV2": lambda ins, n: tuple(jax.lax.top_k(
+        ins[0], int(np.asarray(ins[1])))),   # list->tuple: the executor
+    # indexes multi-output ops only when the value is a tuple
+    "DepthToSpace": lambda ins, n: _depth_space(ins[0],
+                                                _attr(n, "block_size"),
+                                                _nhwc(n), up=True),
+    "SpaceToDepth": lambda ins, n: _depth_space(ins[0],
+                                                _attr(n, "block_size"),
+                                                _nhwc(n), up=False),
+    "L2Loss": lambda ins, n: jnp.sum(jnp.square(ins[0])) / 2.0,
+    "InvertPermutation": lambda ins, n: jnp.argsort(
+        jnp.asarray(ins[0]).astype(jnp.int32)),
 }
+
+
+def _one_hot(ins, node):
+    axis = _attr(node, "axis", -1)
+    if axis not in (-1, None):
+        raise NotImplementedError(f"OneHot axis={axis} unsupported "
+                                  "(only the default last axis)")
+    return (jax.nn.one_hot(jnp.asarray(ins[0]).astype(jnp.int32),
+                           int(np.asarray(ins[1])), dtype=jnp.float32)
+            * (float(np.asarray(ins[2])) - float(np.asarray(ins[3])))
+            + float(np.asarray(ins[3])))
+
+
+def _cumprod(x, axis: int, exclusive: bool, reverse: bool):
+    """TF Cumprod semantics (shift-based exclusive: division would blow
+    up on zeros)."""
+    if reverse:
+        x = jnp.flip(x, axis)
+    if exclusive:
+        ones = jnp.ones_like(jax.lax.slice_in_dim(x, 0, 1, axis=axis))
+        x = jnp.concatenate(
+            [ones, jax.lax.slice_in_dim(x, 0, x.shape[axis] - 1,
+                                        axis=axis)], axis=axis)
+    y = jnp.cumprod(x, axis=axis)
+    if reverse:
+        y = jnp.flip(y, axis)
+    return y
+
+
+def _cumsum(x, axis: int, exclusive: bool, reverse: bool):
+    """TF Cumsum semantics: optional suffix-direction and exclusive
+    (shift-by-one, i.e. sum of STRICTLY earlier elements)."""
+    if reverse:
+        x = jnp.flip(x, axis)
+    y = jnp.cumsum(x, axis=axis)
+    if exclusive:
+        y = y - x
+    if reverse:
+        y = jnp.flip(y, axis)
+    return y
+
+
+def _depth_space(x, block, nhwc: bool, up: bool):
+    """DepthToSpace / SpaceToDepth (pixel-shuffle pair)."""
+    if not nhwc:
+        x = jnp.transpose(x, (0, 2, 3, 1))
+    b, h, w, c = x.shape
+    k = block
+    if up:
+        x = x.reshape(b, h, w, k, k, c // (k * k))
+        x = jnp.transpose(x, (0, 1, 3, 2, 4, 5))
+        x = x.reshape(b, h * k, w * k, c // (k * k))
+    else:
+        x = x.reshape(b, h // k, k, w // k, k, c)
+        x = jnp.transpose(x, (0, 1, 3, 2, 4, 5))
+        x = x.reshape(b, h // k, w // k, c * k * k)
+    if not nhwc:
+        x = jnp.transpose(x, (0, 3, 1, 2))
+    return x
 
 SUPPORTED_OPS = sorted(set(_HANDLERS) | {"Const", "Placeholder"})
 

@@ -102,21 +102,25 @@ class TestTFNet:
         assert np.asarray(out).shape == (3, 2)
 
 
+def _freeze_and_compare(fn, *xs):
+    """Freeze fn to a GraphDef, run through TFNet, compare vs TF."""
+    specs = [tf.TensorSpec(x.shape, tf.float32) for x in xs]
+    concrete = tf.function(fn).get_concrete_function(*specs)
+    from tensorflow.python.framework.convert_to_constants import (
+        convert_variables_to_constants_v2)
+    gd = convert_variables_to_constants_v2(concrete).graph.as_graph_def()
+    ref = np.asarray(fn(*[tf.constant(x) for x in xs]))
+    out = TFNet(gd).predict(*xs)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4,
+                               atol=1e-5)
+
+
 class TestWidenedOpSet:
     """Round-3 op-set widening (~36 -> ~100 ops, the reference's
     nn/ops + nn/tf op-count ballpark) — golden parity vs TF execution."""
 
     def _run(self, fn, *xs):
-        specs = [tf.TensorSpec(x.shape, tf.float32) for x in xs]
-        concrete = tf.function(fn).get_concrete_function(*specs)
-        from tensorflow.python.framework.convert_to_constants import (
-            convert_variables_to_constants_v2)
-        gd = convert_variables_to_constants_v2(concrete) \
-            .graph.as_graph_def()
-        ref = np.asarray(fn(*[tf.constant(x) for x in xs]))
-        out = TFNet(gd).predict(*xs)
-        np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4,
-                                   atol=1e-5)
+        _freeze_and_compare(fn, *xs)
 
     def test_elementwise_family(self):
         x = np.random.RandomState(0).rand(3, 5).astype(np.float32) + 0.5
@@ -176,5 +180,55 @@ class TestWidenedOpSet:
             g = tf.gather(x, idx, axis=1)
             z = tf.fill([5, 2], 0.5)
             return g + z + tf.zeros_like(g) + tf.ones_like(g)
+
+        self._run(f, x)
+
+
+class TestRound4OpTail:
+    """r4 op-set tail (Gather/GatherNd/OneHot/Cumsum/TopK/DepthToSpace/
+    SpaceToDepth/L2Loss/...) — same golden-parity harness."""
+
+    def _run(self, fn, *xs):
+        _freeze_and_compare(fn, *xs)
+
+    def test_gather_onehot_family(self):
+        x = np.random.RandomState(0).rand(5, 4).astype(np.float32)
+
+        def f(x):
+            idx = tf.constant([3, 1, 0])
+            g = tf.gather(x, idx)
+            nd = tf.gather_nd(x, tf.constant([[0, 1], [4, 3]]))
+            oh = tf.one_hot(tf.constant([1, 3]), 4, on_value=2.0,
+                            off_value=-1.0)
+            return (tf.reduce_sum(g) + tf.reduce_sum(nd)
+                    + tf.reduce_sum(oh * x[:2]))
+
+        self._run(f, x)
+
+    def test_cumsum_topk_family(self):
+        x = np.random.RandomState(1).rand(3, 6).astype(np.float32)
+
+        def f(x):
+            c1 = tf.cumsum(x, axis=1)
+            c2 = tf.cumsum(x, axis=1, exclusive=True)
+            c3 = tf.cumsum(x, axis=1, reverse=True)
+            c4 = tf.cumsum(x, axis=1, exclusive=True, reverse=True)
+            cp = tf.math.cumprod(x + 1.0, axis=0)
+            cp2 = tf.math.cumprod(x + 0.5, axis=1, exclusive=True,
+                                  reverse=True)
+            vals, _ = tf.math.top_k(x, k=2)
+            return (tf.reduce_sum(c1 + c2 + c3 + c4)
+                    + tf.reduce_sum(cp) + tf.reduce_sum(cp2)
+                    + tf.reduce_sum(vals) + tf.nn.l2_loss(x))
+
+        self._run(f, x)
+
+    def test_depth_space_family(self):
+        x = np.random.RandomState(2).rand(2, 4, 4, 8).astype(np.float32)
+
+        def f(x):
+            up = tf.nn.depth_to_space(x, 2)
+            down = tf.nn.space_to_depth(up, 2)
+            return tf.reduce_sum(up) + tf.reduce_sum(down * x)
 
         self._run(f, x)
