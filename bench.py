@@ -566,7 +566,10 @@ def bench_llama_longctx_prefill(prompt_len: int = 4096,
     marginal = ((limit - limit // 2) / (t_full - t_half)
                 if t_full > t_half else None)   # dispatch-dominated:
     # a noise-driven slope would print nonsense throughput
-    return {"metric": "llama2_7b_int4_prefill_4k",
+    name = f"llama_{model_size}_int4_prefill_{limit}"
+    return {"metric": ("llama2_7b_int4_prefill_4k"
+                       if model_size == "7b" and limit == 4096
+                       else name),
             "value": round(limit / t_full, 1),
             "unit": "tokens/sec",
             "vs_baseline": None,

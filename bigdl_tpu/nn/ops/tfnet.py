@@ -367,9 +367,10 @@ _HANDLERS: Dict[str, Callable] = {
     # r4 tail toward the reference's full op table
     "Gather": lambda ins, n: jnp.take(
         ins[0], jnp.asarray(ins[1]).astype(jnp.int32), axis=0),
-    "GatherNd": lambda ins, n: ins[0][
+    "GatherNd": lambda ins, n: jnp.asarray(ins[0])[
         tuple(jnp.moveaxis(jnp.asarray(ins[1]).astype(jnp.int32),
-                           -1, 0))],
+                           -1, 0))],   # promote: a host-numpy Const
+    # table fancy-indexed by tracers would force concretization
     "OneHot": lambda ins, n: _one_hot(ins, n),
     "Cumsum": lambda ins, n: _cumsum(
         ins[0], int(np.asarray(ins[1])),
@@ -426,9 +427,14 @@ def _cumsum(x, axis: int, exclusive: bool, reverse: bool):
     (shift-by-one, i.e. sum of STRICTLY earlier elements)."""
     if reverse:
         x = jnp.flip(x, axis)
-    y = jnp.cumsum(x, axis=axis)
     if exclusive:
-        y = y - x
+        # shift, not y - x: TF's exclusive keeps [0, inf, ...] finite on
+        # inf inputs where subtraction would manufacture inf - inf = NaN
+        zeros = jnp.zeros_like(jax.lax.slice_in_dim(x, 0, 1, axis=axis))
+        x = jnp.concatenate(
+            [zeros, jax.lax.slice_in_dim(x, 0, x.shape[axis] - 1,
+                                         axis=axis)], axis=axis)
+    y = jnp.cumsum(x, axis=axis)
     if reverse:
         y = jnp.flip(y, axis)
     return y

@@ -228,6 +228,30 @@ class TestLlama:
         out = model.generate(np.array([[1, 2, 3]]), max_new_tokens=4)
         assert out.shape == (1, 7)
 
+    def test_fused_projections_under_tensor_parallelism(self):
+        """Fused qkv/gate_up shard over the model axis: the shard
+        boundaries cross the fused segments (at and inside q/k/v), so
+        greedy tokens must still match the unsharded model exactly —
+        XLA reshards the post-matmul split if a boundary misaligns."""
+        import dataclasses
+
+        from jax.sharding import Mesh
+
+        cfg = dataclasses.replace(LlamaConfig.tiny(), hidden_size=128,
+                                  intermediate_size=256)
+        dense = LlamaForCausalLM.from_config(cfg, seed=0,
+                                             max_cache_len=32)
+        q = quantize_params(dense.params)
+        ids = np.array([[4, 8, 15, 16]], np.int32)
+        want = LlamaForCausalLM(cfg, q, max_cache_len=32).generate(
+            ids, max_new_tokens=6)
+        for tp in (2, 4):    # boundary exactly at q|k vs inside q
+            mesh = Mesh(np.asarray(jax.devices()[:tp]).reshape(tp),
+                        ("model",))
+            got = LlamaForCausalLM(cfg, q, max_cache_len=32).shard(
+                mesh).generate(ids, max_new_tokens=6)
+            np.testing.assert_array_equal(want, got, err_msg=f"tp={tp}")
+
     def test_tp_pspecs_cover_linears(self):
         cfg = LlamaConfig.tiny()
         params = init_params(cfg, seed=0)

@@ -232,3 +232,23 @@ class TestRound4OpTail:
             return tf.reduce_sum(up) + tf.reduce_sum(down * x)
 
         self._run(f, x)
+
+    def test_gather_nd_const_table_traced_indices(self):
+        """Const tables stay host numpy in the executor env; GatherNd
+        must promote before fancy-indexing with traced indices (review
+        r4: raw numpy indexing concretized the tracer)."""
+        table = tf.constant(np.arange(12, dtype=np.float32).reshape(4, 3))
+
+        def f(x):
+            return tf.reduce_sum(tf.gather_nd(table, tf.cast(x, tf.int32)))
+
+        _freeze_and_compare(f, np.array([[0, 1], [3, 2]], np.float32))
+
+    def test_cumsum_exclusive_inf_safe(self):
+        """Exclusive cumsum is shift-based: inf inputs must not produce
+        inf - inf = NaN (review r4)."""
+        from bigdl_tpu.nn.ops.tfnet import _cumsum
+        import jax.numpy as jnp
+        out = np.asarray(_cumsum(jnp.asarray([np.inf, 1.0, 2.0]), 0,
+                                 True, False))
+        assert out[0] == 0.0 and np.isinf(out[1:]).all()
