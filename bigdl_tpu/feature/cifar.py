@@ -36,9 +36,7 @@ def _read_bin(path: str, label_bytes: int) -> Tuple[np.ndarray, np.ndarray]:
     return imgs / 255.0, labels + 1.0                    # 1-based
 
 
-def _synthetic_cifar(n: int, classes: int, seed: int
-                     ) -> Tuple[np.ndarray, np.ndarray]:
-    rs = np.random.RandomState(seed)
+def _protos(classes: int) -> np.ndarray:
     protos = np.zeros((classes, 3, 32, 32), np.float32)
     for k in range(classes):
         prs = np.random.RandomState(2000 + k)
@@ -47,15 +45,46 @@ def _synthetic_cifar(n: int, classes: int, seed: int
             ch = prs.randint(0, 3)
             protos[k, ch, r:r + 6, c:c + 6] += prs.rand() * 0.8 + 0.4
         protos[k] = np.clip(protos[k], 0, 1)
+    return protos
+
+
+_HARD_SIGMA: dict = {}
+
+
+def _synthetic_cifar(n: int, classes: int, seed: int,
+                     hard: bool = False) -> Tuple[np.ndarray, np.ndarray]:
+    rs = np.random.RandomState(seed)
+    protos = _protos(classes)
+    if hard:
+        # Bayes-calibrated noise (see feature.mnist.calibrate_sigma):
+        # pins the nearest-prototype ceiling at ~0.955 so the
+        # convergence benchmark's accuracy is falsifiable
+        from bigdl_tpu.feature.mnist import calibrate_sigma
+        if classes not in _HARD_SIGMA:
+            _HARD_SIGMA[classes] = calibrate_sigma(protos)
+        sigma = _HARD_SIGMA[classes]
+    else:
+        sigma = 0.1
     labels = rs.randint(0, classes, n)
-    imgs = protos[labels] + 0.1 * rs.randn(n, 3, 32, 32).astype(np.float32)
+    imgs = protos[labels] + sigma * rs.randn(n, 3, 32, 32).astype(np.float32)
     return (np.clip(imgs, 0, 1).astype(np.float32),
             (labels + 1).astype(np.float32))
 
 
+def nearest_prototype_accuracy(images: np.ndarray, labels: np.ndarray,
+                               classes: int = 10) -> float:
+    """Top-1 of the nearest-prototype classifier (the Bayes anchor the
+    convergence bench reports; labels 1-based)."""
+    pf = _protos(classes).reshape(classes, -1)
+    x = images.reshape(len(images), -1)
+    d = (pf * pf).sum(1)[None, :] - 2.0 * (x @ pf.T)
+    return float((d.argmin(1) == (labels - 1).astype(np.int64)).mean())
+
+
 def load_cifar(folder: Optional[str] = None, train: bool = True,
                classes: int = 10, synthetic_size: int = 2048,
-               seed: int = 0) -> Tuple[np.ndarray, np.ndarray]:
+               seed: int = 0, hard: bool = False
+               ) -> Tuple[np.ndarray, np.ndarray]:
     """Returns (images (N,3,32,32) float32 in [0,1], labels (N,) 1-based).
 
     Reads the binary distribution from ``folder`` when present; otherwise
@@ -74,7 +103,8 @@ def load_cifar(folder: Optional[str] = None, train: bool = True,
             parts = [_read_bin(p, label_bytes) for p in paths]
             return (np.concatenate([p[0] for p in parts]),
                     np.concatenate([p[1] for p in parts]))
-    return _synthetic_cifar(synthetic_size, classes, seed)
+    return _synthetic_cifar(synthetic_size, classes,
+                            seed if train else seed + 1, hard=hard)
 
 
 def normalizer(x: np.ndarray) -> np.ndarray:

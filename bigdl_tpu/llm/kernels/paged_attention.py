@@ -44,7 +44,8 @@ LANE = 128          # score-tile lane width: pages per block × page_size
 def _paged_decode_kernel(len_ref, bt_ref, q_ref, k_hbm, v_hbm, o_ref,
                          kbuf, vbuf, sem, acc_ref, m_ref, l_ref,
                          *, page: int, ppb: int, pages_max: int,
-                         scale: float, window: Optional[int] = None):
+                         scale: float, window: Optional[int] = None,
+                         m_out=None, l_out=None):
     """One (batch row b, kv head h, page block blk) step.
 
     len_ref: (B,) lengths INCLUDING the current token; bt_ref:
@@ -105,9 +106,30 @@ def _paged_decode_kernel(len_ref, bt_ref, q_ref, k_hbm, v_hbm, o_ref,
 
     @pl.when(blk == nblk - 1)
     def _finish():
-        o_ref[0, 0] = (acc_ref[...]
-                       / jnp.maximum(l_ref[:, :1], 1e-30)).astype(
-                           o_ref.dtype)
+        if m_out is None:
+            o_ref[0, 0] = (acc_ref[...]
+                           / jnp.maximum(l_ref[:, :1], 1e-30)).astype(
+                               o_ref.dtype)
+        else:
+            # stats mode: UNNORMALIZED accumulator + running (max, sum),
+            # so the caller can merge further tokens (e.g. the current
+            # decode token, written to its page only after attention)
+            # with the flash-style combine rule
+            o_ref[0, 0] = acc_ref[...].astype(o_ref.dtype)
+            m_out[0, 0] = m_ref[...]
+            l_out[0, 0] = l_ref[...]
+
+
+def _paged_decode_kernel_stats(len_ref, bt_ref, q_ref, k_hbm, v_hbm,
+                               o_ref, mo_ref, lo_ref, kbuf, vbuf, sem,
+                               acc_ref, m_ref, l_ref, *, page: int,
+                               ppb: int, pages_max: int, scale: float,
+                               window: Optional[int] = None):
+    _paged_decode_kernel(len_ref, bt_ref, q_ref, k_hbm, v_hbm, o_ref,
+                         kbuf, vbuf, sem, acc_ref, m_ref, l_ref,
+                         page=page, ppb=ppb, pages_max=pages_max,
+                         scale=scale, window=window,
+                         m_out=mo_ref, l_out=lo_ref)
 
 
 @functools.partial(jax.jit, static_argnames=("page_size", "interpret",
@@ -187,6 +209,162 @@ def paged_attention_decode(q, k_pages, v_pages, block_tables, lengths,
       qg, k_pages, v_pages)
     return (out[:, :, :g, :d_orig].reshape(b, hq, d_orig)
             .astype(q.dtype))
+
+
+@functools.partial(jax.jit, static_argnames=("page_size", "interpret",
+                                             "sliding_window"))
+def paged_attention_decode_stats(q, k_pages, v_pages, block_tables,
+                                 lengths, page_size: int = 16,
+                                 interpret: bool = False,
+                                 sliding_window: Optional[int] = None):
+    """Like :func:`paged_attention_decode` but over the first ``lengths``
+    tokens WITHOUT normalizing, returning the flash-style partial state
+    ``(acc (B, Hq, D) f32 unnormalized, m (B, Hq) f32, l (B, Hq) f32)``
+    so the caller can fold in further key/value tokens (the current
+    decode token before its page write) with the online-softmax combine.
+    Rows with ``lengths == 0`` return ``(0, -1e30, 0)`` — the identity
+    of the combine."""
+    b, hq, d = q.shape
+    p_, hkv, page, _ = k_pages.shape
+    assert page == page_size
+    ppb = LANE // page_size
+    pages_max = block_tables.shape[1]
+    if pages_max % ppb:
+        raise ValueError(f"pages_max {pages_max} not a multiple of {ppb}")
+    nblk = pages_max // ppb
+    g = hq // hkv
+    gp = max(8, -(-g // 8) * 8)
+    scale = 1.0 / float(np.sqrt(d))
+
+    qg = q.reshape(b, hkv, g, d)
+    if gp != g:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, gp - g), (0, 0)))
+    d_orig = d
+    if d % 128:
+        dp = -(-d // 128) * 128
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, 0), (0, dp - d)))
+        k_pages = jnp.pad(k_pages, ((0, 0), (0, 0), (0, 0), (0, dp - d)))
+        v_pages = jnp.pad(v_pages, ((0, 0), (0, 0), (0, 0), (0, dp - d)))
+        d = dp
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, hkv, nblk),
+        in_specs=[
+            pl.BlockSpec((1, 1, gp, d), lambda b_, h_, k_, *_: (b_, h_, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, gp, d),
+                         lambda b_, h_, k_, *_: (b_, h_, 0, 0)),
+            pl.BlockSpec((1, 1, gp, LANE),
+                         lambda b_, h_, k_, *_: (b_, h_, 0, 0)),
+            pl.BlockSpec((1, 1, gp, LANE),
+                         lambda b_, h_, k_, *_: (b_, h_, 0, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((ppb, page, d), k_pages.dtype),
+            pltpu.VMEM((ppb, page, d), v_pages.dtype),
+            pltpu.SemaphoreType.DMA,
+            pltpu.VMEM((gp, d), jnp.float32),
+            pltpu.VMEM((gp, LANE), jnp.float32),
+            pltpu.VMEM((gp, LANE), jnp.float32),
+        ],
+    )
+    acc, m, l = pl.pallas_call(
+        functools.partial(_paged_decode_kernel_stats, page=page_size,
+                          ppb=ppb, pages_max=pages_max, scale=scale,
+                          window=sliding_window),
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct((b, hkv, gp, d), jnp.float32),
+                   jax.ShapeDtypeStruct((b, hkv, gp, LANE), jnp.float32),
+                   jax.ShapeDtypeStruct((b, hkv, gp, LANE), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(lengths.astype(jnp.int32), block_tables.reshape(-1).astype(jnp.int32),
+      qg, k_pages, v_pages)
+    return (acc[:, :, :g, :d_orig].reshape(b, hq, d_orig),
+            m[:, :, :g, 0].reshape(b, hq),
+            l[:, :, :g, 0].reshape(b, hq))
+
+
+def paged_attention_reference_stats(q, k_pages, v_pages, block_tables,
+                                    lengths,
+                                    sliding_window: Optional[int] = None):
+    """XLA twin of :func:`paged_attention_decode_stats` (same contract)."""
+    b, hq, d = q.shape
+    p_, hkv, page, _ = k_pages.shape
+    g = hq // hkv
+    pages_max = block_tables.shape[1]
+    s_max = pages_max * page
+    k_all = (k_pages[block_tables].transpose(0, 1, 3, 2, 4)
+             .reshape(b, s_max, hkv, d))
+    v_all = (v_pages[block_tables].transpose(0, 1, 3, 2, 4)
+             .reshape(b, s_max, hkv, d))
+    qg = q.reshape(b, hkv, g, d).astype(jnp.float32)
+    scale = 1.0 / float(np.sqrt(d))
+    s = jnp.einsum("bhgd,bshd->bhgs", qg,
+                   k_all.astype(jnp.float32)) * scale
+    pos = jnp.arange(s_max)[None, :]
+    mask = pos < lengths[:, None]                              # (B, S)
+    if sliding_window is not None:
+        mask &= pos >= lengths[:, None] - sliding_window
+    s = jnp.where(mask[:, None, None, :], s, -1e30)
+    m = jnp.max(s, axis=-1)                                    # (B,H,G)
+    # p must be 0 (not exp(0)) on masked slots of all-masked rows,
+    # where m == -1e30 would make s - m == 0
+    p = jnp.where(mask[:, None, None, :], jnp.exp(s - m[..., None]), 0.0)
+    l = jnp.sum(p, axis=-1)
+    acc = jnp.einsum("bhgs,bshd->bhgd", p, v_all.astype(jnp.float32))
+    any_valid = jnp.any(mask, axis=-1)[:, None, None]          # (B,1,1)
+    m = jnp.where(any_valid, m, -1e30)
+    return (acc.reshape(b, hq, d), m.reshape(b, hq), l.reshape(b, hq))
+
+
+def paged_attention_stats(q, k_pages, v_pages, block_tables, lengths,
+                          page_size: int = 16,
+                          interpret: Optional[bool] = None,
+                          sliding_window: Optional[int] = None):
+    """Backend dispatch for the stats variant: Mosaic kernel on TPU, XLA
+    gather elsewhere."""
+    if interpret is None:
+        if jax.default_backend() != "tpu":
+            return paged_attention_reference_stats(
+                q, k_pages, v_pages, block_tables, lengths,
+                sliding_window=sliding_window)
+        interpret = False
+    return paged_attention_decode_stats(
+        q, k_pages, v_pages, block_tables, lengths, page_size=page_size,
+        interpret=interpret, sliding_window=sliding_window)
+
+
+def merge_attention_partial(acc, m, l, q, k_new, v_new):
+    """Fold one extra key/value token into a flash-style partial state.
+
+    ``(acc, m, l)`` from :func:`paged_attention_stats` (acc (B, Hq, D)
+    f32 unnormalized); ``q`` (B, Hq, D) current queries; ``k_new/v_new``
+    (B, Hkv, D) the token being decoded (pre page-write). Returns the
+    NORMALIZED attention output (B, Hq, D) f32 over the union — exactly
+    ``paged_attention`` after writing the token, but with the pool
+    untouched (what lets the serving decode scan keep the page pool
+    read-only and defer all layers' page writes to one post-scan
+    scatter)."""
+    b, hq, d = q.shape
+    hkv = k_new.shape[1]
+    g = hq // hkv
+    scale = 1.0 / float(np.sqrt(d))
+    kr = jnp.repeat(k_new.astype(jnp.float32), g, axis=1)     # (B, Hq, D)
+    vr = jnp.repeat(v_new.astype(jnp.float32), g, axis=1)
+    s_self = jnp.sum(q.astype(jnp.float32) * kr, axis=-1) * scale
+    m_new = jnp.maximum(m, s_self)
+    alpha = jnp.exp(m - m_new)                                # (B, Hq)
+    beta = jnp.exp(s_self - m_new)
+    l_new = l * alpha + beta
+    out = (acc * alpha[..., None] + vr * beta[..., None]) \
+        / jnp.maximum(l_new, 1e-30)[..., None]
+    return out
 
 
 def paged_attention_reference(q, k_pages, v_pages, block_tables, lengths,

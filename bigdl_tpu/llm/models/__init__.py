@@ -1,10 +1,17 @@
 """jax LLM implementations (ref: the per-arch forward rewrites under
-P:llm/transformers/models/ — here full TPU-native models)."""
+P:llm/transformers/models/ — here full TPU-native models). Five ggml
+families (P:llm/ggml/model/): Llama (also covering Mistral, Mixtral,
+Qwen2 and the GLM/ChatGLM rotary variant), GPT-NeoX, Bloom, StarCoder."""
 
+from bigdl_tpu.llm.models.bloom import BloomConfig, BloomForCausalLM
 from bigdl_tpu.llm.models.gptneox import (
     GptNeoXConfig, GptNeoXForCausalLM)
 from bigdl_tpu.llm.models.llama import (
     LlamaConfig, LlamaForCausalLM)
+from bigdl_tpu.llm.models.starcoder import (
+    StarCoderConfig, StarCoderForCausalLM)
 
-__all__ = ["GptNeoXConfig", "GptNeoXForCausalLM",
-           "LlamaConfig", "LlamaForCausalLM"]
+__all__ = ["BloomConfig", "BloomForCausalLM",
+           "GptNeoXConfig", "GptNeoXForCausalLM",
+           "LlamaConfig", "LlamaForCausalLM",
+           "StarCoderConfig", "StarCoderForCausalLM"]

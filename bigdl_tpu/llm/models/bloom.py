@@ -1,0 +1,369 @@
+"""Bloom family on TPU (ref: P:llm/ggml/model/bloom — the third of the
+reference's five ggml model families; SURVEY.md §2.8 row 65). Bloom is
+architecturally distinct from Llama AND GPT-NeoX: **ALiBi** linear
+position biases instead of rotary, an extra LayerNorm directly after the
+word embeddings, sequential residuals, tanh-GELU MLP, fused per-head
+qkv, tied lm_head, no GQA.
+
+Same TPU-first skeleton as llama.py/gptneox.py: scan-stacked decoder
+layers, static ring kv cache updated in-program, q4_0 quantized linears
+dispatching to the Pallas kernel on TPU. ALiBi biases enter through the
+shared :func:`llama._attention` (single-block score path — Bloom's 2k
+context fits one block)."""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.llm.models.gptneox import _layer_norm, _linear_b
+from bigdl_tpu.llm.models.llama import _attention, decode_scan
+
+
+@dataclasses.dataclass
+class BloomConfig:
+    vocab_size: int = 250880
+    hidden_size: int = 4096
+    num_hidden_layers: int = 30
+    num_attention_heads: int = 32
+    layer_norm_epsilon: float = 1e-5
+    max_position_embeddings: int = 2048
+    sliding_window = None              # read by the shared _attention
+    num_experts = 0
+
+    @property
+    def intermediate_size(self) -> int:
+        return 4 * self.hidden_size
+
+    @property
+    def num_key_value_heads(self) -> int:
+        return self.num_attention_heads
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    @property
+    def attn_block_size(self) -> int:
+        # ALiBi rides the single-block attention path (llama._attention)
+        return max(self.max_position_embeddings, 1024)
+
+    @classmethod
+    def bloom_7b1(cls) -> "BloomConfig":
+        return cls()
+
+    @classmethod
+    def tiny(cls, vocab: int = 256) -> "BloomConfig":
+        return cls(vocab_size=vocab, hidden_size=64, num_hidden_layers=2,
+                   num_attention_heads=4, max_position_embeddings=128)
+
+    @classmethod
+    def from_hf(cls, hf) -> "BloomConfig":
+        g = (lambda k, d: getattr(hf, k, d))
+        return cls(vocab_size=g("vocab_size", 250880),
+                   hidden_size=g("hidden_size", g("n_embed", 4096)),
+                   num_hidden_layers=g("num_hidden_layers",
+                                       g("n_layer", 30)),
+                   num_attention_heads=g("num_attention_heads",
+                                         g("n_head", 32)),
+                   layer_norm_epsilon=g("layer_norm_epsilon", 1e-5))
+
+
+def alibi_slopes(n_heads: int) -> np.ndarray:
+    """Per-head ALiBi slopes — the closest-power-of-2 recipe of the
+    ALiBi paper that HF's ``build_alibi_tensor`` implements: for
+    ``p = 2^floor(log2 n)`` heads, slope_i = 2^(-8(i+1)/p); remaining
+    heads interleave the odd steps of the 2p schedule."""
+    p = 2 ** int(np.floor(np.log2(n_heads)))
+    base = 2.0 ** (-(2.0 ** -(np.log2(p) - 3)))
+    slopes = base ** np.arange(1, p + 1)
+    if p < n_heads:
+        base2 = 2.0 ** (-(2.0 ** -(np.log2(2 * p) - 3)))
+        extra = base2 ** np.arange(1, 2 * (n_heads - p) + 1, 2)
+        slopes = np.concatenate([slopes, extra])
+    return slopes.astype(np.float32)
+
+
+_LAYER_LINEARS = ("q_proj", "k_proj", "v_proj", "o_proj",
+                  "fc_in", "fc_out")
+
+
+def linear_shapes(cfg: BloomConfig) -> Dict[str, Tuple[int, int]]:
+    h = cfg.hidden_size
+    return {"q_proj": (h, h), "k_proj": (h, h), "v_proj": (h, h),
+            "o_proj": (h, h), "fc_in": (cfg.intermediate_size, h),
+            "fc_out": (h, cfg.intermediate_size)}
+
+
+def init_params(cfg: BloomConfig, seed: int = 0,
+                dtype=jnp.bfloat16) -> Dict[str, Any]:
+    key = jax.random.PRNGKey(seed)
+    h = cfg.hidden_size
+    L = cfg.num_hidden_layers
+    shapes = linear_shapes(cfg)
+
+    def mk(key, shape, scale=None):
+        scale = scale or (1.0 / np.sqrt(shape[-1]))
+        return (jax.random.normal(key, shape, jnp.float32)
+                * scale).astype(dtype)
+
+    keys = jax.random.split(key, 4 + len(shapes))
+    layers: Dict[str, Any] = {}
+    for i, (name, shape) in enumerate(shapes.items()):
+        layers[name] = {"w": mk(keys[i], (L,) + shape),
+                        "b": jnp.zeros((L, shape[0]), dtype)}
+    for norm in ("input_layernorm", "post_attention_layernorm"):
+        layers[norm] = {"w": jnp.ones((L, h), dtype),
+                        "b": jnp.zeros((L, h), dtype)}
+    return {
+        "word_embeddings": mk(keys[-3], (cfg.vocab_size, h), 0.02),
+        "word_embeddings_layernorm": {"w": jnp.ones((h,), dtype),
+                                      "b": jnp.zeros((h,), dtype)},
+        "ln_f": {"w": jnp.ones((h,), dtype), "b": jnp.zeros((h,), dtype)},
+        "layers": layers,
+    }
+
+
+def quantize_params(params: Dict[str, Any], qtype: str = "sym_int4"
+                    ) -> Dict[str, Any]:
+    """ggml-quantize the decoder linears into the k-major TPU layout
+    (weights only; biases/norms stay bf16)."""
+    from bigdl_tpu.llm.kernels import quantize_tpu
+
+    if qtype != "sym_int4":
+        raise NotImplementedError(
+            "the scanned decoder path implements q4_0 (sym_int4)")
+    out = dict(params)
+    layers = dict(params["layers"])
+    for name in _LAYER_LINEARS:
+        w = np.asarray(layers[name]["w"], np.float32)
+        qs, ss = [], []
+        for l in range(w.shape[0]):
+            qd = quantize_tpu(w[l], qtype)
+            qs.append(qd["q"])
+            ss.append(qd["scale"])
+        layers[name] = {"q": jnp.asarray(np.stack(qs)),
+                        "scale": jnp.asarray(np.stack(ss)),
+                        "b": layers[name]["b"]}
+    out["layers"] = layers
+    return out
+
+
+def init_cache(cfg: BloomConfig, batch: int, max_len: int,
+               dtype=jnp.bfloat16) -> Dict[str, jnp.ndarray]:
+    shape = (cfg.num_hidden_layers, batch, max_len,
+             cfg.num_attention_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype),
+            "pos": jnp.zeros((), jnp.int32)}
+
+
+def forward(params: Dict[str, Any], cfg: BloomConfig,
+            tokens: jnp.ndarray, cache: Dict[str, jnp.ndarray],
+            positions: jnp.ndarray) -> Tuple[jnp.ndarray, Dict]:
+    x = _layer_norm(params["word_embeddings"][tokens],
+                    params["word_embeddings_layernorm"],
+                    cfg.layer_norm_epsilon)
+    start = cache["pos"]
+    s_max = cache["k"].shape[2]
+    valid = jnp.arange(s_max)[None, :] < (start + tokens.shape[1])
+    nh, hd = cfg.num_attention_heads, cfg.head_dim
+    slopes = jnp.asarray(alibi_slopes(nh))
+
+    def layer_step(carry, inputs):
+        x, = carry
+        lp, k_cache, v_cache = inputs
+        b, t, _ = x.shape
+        h1 = _layer_norm(x, lp["input_layernorm"], cfg.layer_norm_epsilon)
+        q = _linear_b(lp["q_proj"], h1).reshape(b, t, nh, hd)
+        k = _linear_b(lp["k_proj"], h1).reshape(b, t, nh, hd)
+        v = _linear_b(lp["v_proj"], h1).reshape(b, t, nh, hd)
+        # no rotary: ALiBi carries all position information
+        k_cache = jax.lax.dynamic_update_slice(
+            k_cache, k.astype(k_cache.dtype), (0, start, 0, 0))
+        v_cache = jax.lax.dynamic_update_slice(
+            v_cache, v.astype(v_cache.dtype), (0, start, 0, 0))
+        attn = _attention(q, k_cache, v_cache, positions, valid, cfg,
+                          alibi_slopes=slopes)
+        x = x + _linear_b(lp["o_proj"], attn)
+        h2 = _layer_norm(x, lp["post_attention_layernorm"],
+                         cfg.layer_norm_epsilon)
+        mlp = _linear_b(lp["fc_out"], jax.nn.gelu(
+            _linear_b(lp["fc_in"], h2).astype(jnp.float32),
+            approximate=True).astype(x.dtype))   # Bloom's tanh GELU
+        x = x + mlp
+        return (x,), (k_cache, v_cache)
+
+    (x,), (k_new, v_new) = jax.lax.scan(
+        layer_step, (x,), (params["layers"], cache["k"], cache["v"]))
+    x = _layer_norm(x, params["ln_f"], cfg.layer_norm_epsilon)
+    # tied head: logits through the word embedding matrix
+    logits = x @ params["word_embeddings"].T.astype(x.dtype)
+    return logits.astype(jnp.float32), {
+        "k": k_new, "v": v_new, "pos": start + tokens.shape[1]}
+
+
+class BloomForCausalLM:
+    """Generation facade — same driver contract as LlamaForCausalLM."""
+
+    def __init__(self, cfg: BloomConfig, params: Dict[str, Any],
+                 max_cache_len: int = 512, cache_dtype=jnp.bfloat16):
+        self.config = cfg
+        self.params = params
+        self.cache_dtype = cache_dtype
+        self.max_cache_len = min(max_cache_len, cfg.max_position_embeddings)
+        self._step = jax.jit(functools.partial(forward, cfg=cfg))
+        self._decode_scan = jax.jit(
+            functools.partial(decode_scan, cfg=cfg, forward_fn=forward),
+            static_argnames=("num_tokens", "do_sample", "top_k",
+                             "eos_token_id"),
+            donate_argnames=("cache",))
+
+    @classmethod
+    def from_config(cls, cfg: BloomConfig, seed: int = 0,
+                    load_in_low_bit: Optional[str] = None,
+                    max_cache_len: int = 512) -> "BloomForCausalLM":
+        params = init_params(cfg, seed)
+        if load_in_low_bit:
+            params = quantize_params(params, load_in_low_bit)
+        return cls(cfg, params, max_cache_len)
+
+    def __call__(self, tokens, cache=None, positions=None):
+        b, t = tokens.shape
+        if cache is None:
+            cache = init_cache(self.config, b, self.max_cache_len,
+                               dtype=self.cache_dtype)
+        if positions is None:
+            base = jnp.asarray(cache["pos"])
+            positions = base + jnp.broadcast_to(jnp.arange(t), (b, t))
+        return self._step(self.params, tokens=jnp.asarray(tokens),
+                          cache=cache, positions=positions)
+
+    def generate(self, input_ids, max_new_tokens: int = 32,
+                 eos_token_id: Optional[int] = None,
+                 decode_chunk: int = 32):
+        tokens = jnp.asarray(np.asarray(input_ids), jnp.int32)
+        b, t0 = tokens.shape
+        if t0 + max_new_tokens > self.max_cache_len:
+            raise ValueError(f"sequence {t0}+{max_new_tokens} exceeds "
+                             f"cache {self.max_cache_len}")
+        logits, cache = self(tokens)
+        key = jax.random.PRNGKey(0)
+        last = logits[:, -1]
+        pieces = [np.asarray(tokens)]
+        remaining = max_new_tokens
+        chunk = max_new_tokens if eos_token_id is None else decode_chunk
+        finished = jnp.zeros((b,), bool)
+        while remaining > 0:
+            n = min(chunk, remaining)
+            toks, cache, last, key, finished = self._decode_scan(
+                self.params, cache, last, key, jnp.float32(1.0), finished,
+                num_tokens=n, eos_token_id=eos_token_id)
+            pieces.append(np.asarray(toks))
+            remaining -= n
+            if (eos_token_id is not None
+                    and np.asarray(finished).all()):
+                break
+        return np.concatenate(pieces, axis=1)
+
+
+# ---------------------------------------------------------------------------
+# HF interop (safetensors, no torch)
+# ---------------------------------------------------------------------------
+
+def load_hf_bloom_safetensors(path: str, cfg: Optional[BloomConfig] = None,
+                              qtype: Optional[str] = None,
+                              dtype=jnp.bfloat16) -> Dict[str, Any]:
+    """HF BloomForCausalLM checkpoint → our stacked layout. HF fuses qkv
+    as ``self_attention.query_key_value`` with per-head [q; k; v]
+    interleaving — split back into separate projections here."""
+    import glob as _glob
+    import json as _json
+    import os as _os
+
+    from safetensors import safe_open
+
+    from bigdl_tpu.llm.kernels import quantize_tpu
+
+    if qtype and qtype != "sym_int4":
+        raise NotImplementedError("q4_0 only on the scanned path")
+    if cfg is None:
+        with open(_os.path.join(path, "config.json")) as f:
+            raw = _json.load(f)
+        cfg = BloomConfig.from_hf(type("HFConfig", (), raw)())
+
+    key_map: Dict[str, str] = {}
+    for fname in sorted(_glob.glob(_os.path.join(path, "*.safetensors"))):
+        with safe_open(fname, framework="numpy") as f:
+            for k in f.keys():
+                key_map[k] = fname
+    handles: Dict[str, Any] = {}
+
+    def get(name):
+        # bloom checkpoints may or may not carry the "transformer." prefix
+        if name not in key_map and "transformer." + name in key_map:
+            name = "transformer." + name
+        fname = key_map[name]
+        if fname not in handles:
+            handles[fname] = safe_open(fname, framework="numpy")
+        return np.asarray(handles[fname].get_tensor(name), np.float32)
+
+    L = cfg.num_hidden_layers
+    nh, hd, h = cfg.num_attention_heads, cfg.head_dim, cfg.hidden_size
+    _HF_LIN = {"o_proj": "self_attention.dense",
+               "fc_in": "mlp.dense_h_to_4h", "fc_out": "mlp.dense_4h_to_h"}
+    acc: Dict[str, Dict[str, list]] = {
+        n: {"w": [], "q": [], "scale": [], "b": []} for n in _LAYER_LINEARS}
+
+    def put_linear(name, w, b):
+        a = acc[name]
+        a["b"].append(b)
+        if qtype:
+            qd = quantize_tpu(w, qtype)
+            a["q"].append(qd["q"])
+            a["scale"].append(qd["scale"])
+        else:
+            a["w"].append(w.astype(np.float32))
+
+    for l in range(L):
+        w = get(f"h.{l}.self_attention.query_key_value.weight")
+        b = get(f"h.{l}.self_attention.query_key_value.bias")
+        w = w.reshape(nh, 3, hd, h)
+        b = b.reshape(nh, 3, hd)
+        for i, name in enumerate(("q_proj", "k_proj", "v_proj")):
+            put_linear(name, w[:, i].reshape(h, h), b[:, i].reshape(h))
+        for name, hf in _HF_LIN.items():
+            put_linear(name, get(f"h.{l}.{hf}.weight"),
+                       get(f"h.{l}.{hf}.bias"))
+
+    layers: Dict[str, Any] = {}
+    for name, a in acc.items():
+        entry: Dict[str, Any] = {"b": jnp.asarray(np.stack(a["b"]), dtype)}
+        if qtype:
+            entry["q"] = jnp.asarray(np.stack(a["q"]))
+            entry["scale"] = jnp.asarray(np.stack(a["scale"]))
+        else:
+            entry["w"] = jnp.asarray(np.stack(a["w"]), dtype)
+        layers[name] = entry
+    for norm in ("input_layernorm", "post_attention_layernorm"):
+        layers[norm] = {
+            "w": jnp.asarray(np.stack(
+                [get(f"h.{l}.{norm}.weight") for l in range(L)]), dtype),
+            "b": jnp.asarray(np.stack(
+                [get(f"h.{l}.{norm}.bias") for l in range(L)]), dtype)}
+    return {
+        "word_embeddings": jnp.asarray(get("word_embeddings.weight"),
+                                       dtype),
+        "word_embeddings_layernorm": {
+            "w": jnp.asarray(get("word_embeddings_layernorm.weight"),
+                             dtype),
+            "b": jnp.asarray(get("word_embeddings_layernorm.bias"),
+                             dtype)},
+        "ln_f": {"w": jnp.asarray(get("ln_f.weight"), dtype),
+                 "b": jnp.asarray(get("ln_f.bias"), dtype)},
+        "layers": layers,
+    }

@@ -51,6 +51,13 @@ class LlamaConfig:
     # Qwen2-style attention bias: q/k/v projections carry biases
     # (o_proj and the MLP stay bias-free, matching HF Qwen2)
     attention_bias: bool = False
+    # RoPE layout: "half" = Llama rotate-half over the full head dim;
+    # "glm" = ChatGLM/GLM-4 lineage — INTERLEAVED pairs (2i, 2i+1) over
+    # the first ``head_dim * partial_rotary_factor`` dims, rest passed
+    # through (ref: P:llm/ggml/model/chatglm — the fifth ggml family;
+    # HF transformers GlmModel is the same rotary/residual layout)
+    rope_mode: str = "half"
+    partial_rotary_factor: float = 1.0
     # Mixture-of-experts FFN (Mixtral-style): 0 = dense FFN. With
     # num_experts > 0 every decoder MLP becomes num_experts switch-FFN
     # experts with top-k routing and static expert capacity
@@ -100,6 +107,27 @@ class LlamaConfig:
                    attention_bias=True)
 
     @classmethod
+    def glm4_9b(cls) -> "LlamaConfig":
+        """GLM-4-9B (the ChatGLM lineage): Llama-shaped block +
+        INTERLEAVED partial rotary (first half of head dims), GQA(2),
+        qkv biases, fused gate_up MLP (ref: P:llm/ggml/model/chatglm —
+        fifth ggml family; HF ``GlmForCausalLM`` is this layout)."""
+        return cls(vocab_size=151552, hidden_size=4096,
+                   intermediate_size=13696, num_hidden_layers=40,
+                   num_attention_heads=32, num_key_value_heads=2,
+                   max_position_embeddings=8192, rms_norm_eps=1.5625e-07,
+                   rope_theta=10000.0, attention_bias=True,
+                   rope_mode="glm", partial_rotary_factor=0.5)
+
+    @classmethod
+    def tiny_glm(cls, vocab: int = 256) -> "LlamaConfig":
+        return cls(vocab_size=vocab, hidden_size=64, intermediate_size=128,
+                   num_hidden_layers=2, num_attention_heads=4,
+                   num_key_value_heads=2, max_position_embeddings=128,
+                   attention_bias=True, rope_mode="glm",
+                   partial_rotary_factor=0.5)
+
+    @classmethod
     def mixtral_8x7b(cls) -> "LlamaConfig":
         """Mixtral-8x7B: Mistral block + 8-expert top-2 MoE FFN."""
         return cls(intermediate_size=14336, num_key_value_heads=8,
@@ -143,6 +171,9 @@ class LlamaConfig:
                             if g("use_sliding_window", True) else None),
             attention_bias=bool(g("attention_bias",
                                   g("model_type", "") == "qwen2")),
+            # GLM/ChatGLM lineage: interleaved partial rotary
+            rope_mode=("glm" if g("model_type", "") == "glm" else "half"),
+            partial_rotary_factor=g("partial_rotary_factor", 1.0) or 1.0,
             num_experts=g("num_local_experts", 0) or 0,
             num_experts_per_tok=g("num_experts_per_tok", 2) or 2)
 
@@ -494,9 +525,28 @@ def rms_norm(x, w, eps: float):
     return (x32 * jax.lax.rsqrt(var + eps)).astype(x.dtype) * w
 
 
-def rope(x, positions, theta: float):
-    """Rotate-half RoPE. x: (B, T, H, D); positions: (B, T) int32."""
+def rope(x, positions, theta: float, mode: str = "half",
+         partial: float = 1.0):
+    """RoPE. x: (B, T, H, D); positions: (B, T) int32.
+
+    ``mode="half"``: Llama rotate-half over the full head dim.
+    ``mode="glm"``: ChatGLM/GLM-4 layout — INTERLEAVED pairs (2i, 2i+1)
+    over the first ``D * partial`` dims, remainder passed through."""
     d = x.shape[-1]
+    if mode == "glm":
+        rot = int(d * partial)
+        x_rot, x_pass = x[..., :rot], x[..., rot:]
+        inv_freq = 1.0 / (theta ** (jnp.arange(0, rot, 2,
+                                               dtype=jnp.float32) / rot))
+        ang = positions[..., None].astype(jnp.float32) * inv_freq
+        cos = jnp.cos(ang)[:, :, None, :]                  # (B,T,1,rot/2)
+        sin = jnp.sin(ang)[:, :, None, :]
+        xr = x_rot.astype(jnp.float32).reshape(x.shape[:-1] + (rot // 2, 2))
+        x1, x2 = xr[..., 0], xr[..., 1]
+        out = jnp.stack([x1 * cos - x2 * sin,
+                         x2 * cos + x1 * sin], axis=-1).reshape(
+                             x.shape[:-1] + (rot,))
+        return jnp.concatenate([out.astype(x.dtype), x_pass], axis=-1)
     inv_freq = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
     ang = positions[..., None].astype(jnp.float32) * inv_freq  # (B,T,D/2)
     cos = jnp.cos(ang)[:, :, None, :]
@@ -507,6 +557,13 @@ def rope(x, positions, theta: float):
     return out.astype(x.dtype)
 
 
+def rope_cfg(x, positions, cfg: "LlamaConfig"):
+    """cfg-driven dispatch shared by every Llama-stack call site (the
+    prefill scan, the paged serving step, the slot-static decode)."""
+    return rope(x, positions, cfg.rope_theta, cfg.rope_mode,
+                cfg.partial_rotary_factor)
+
+
 def init_cache(cfg: LlamaConfig, batch: int, max_len: int,
                dtype=jnp.bfloat16) -> Dict[str, jnp.ndarray]:
     shape = (cfg.num_hidden_layers, batch, max_len,
@@ -515,10 +572,13 @@ def init_cache(cfg: LlamaConfig, batch: int, max_len: int,
             "pos": jnp.zeros((), jnp.int32)}
 
 
-def _attention(q, k_all, v_all, q_positions, kv_len_mask, cfg):
+def _attention(q, k_all, v_all, q_positions, kv_len_mask, cfg,
+               alibi_slopes=None):
     """q: (B, Tq, Hq, D); k_all/v_all: (B, S, Hkv, D) (full cache window).
     kv_len_mask: (B, S) True where the cache slot is valid.
     Causal: slot position s attends iff s <= q_position.
+    ``alibi_slopes`` (Hq,) adds Bloom-style per-head linear position
+    biases to the scores (single-block path only).
 
     GQA-aware: query heads are grouped onto their kv head inside the
     einsum (q head h uses kv head ``h // (Hq//Hkv)``) — repeated K/V is
@@ -546,6 +606,13 @@ def _attention(q, k_all, v_all, q_positions, kv_len_mask, cfg):
     if s <= cfg.attn_block_size:
         logits = jnp.einsum("bthgd,bshd->bhgts", qg, k_all,
                             preferred_element_type=jnp.float32) * scale
+        if alibi_slopes is not None:
+            # ALiBi (Bloom): score += slope_h * key_position. HF adds
+            # slopes * key_index — row-shift-invariant under softmax, so
+            # the relative -(i-j)*slope form and this agree exactly
+            sl = alibi_slopes.astype(jnp.float32).reshape(hkv, g)
+            logits = logits + (sl[None, :, :, None, None]
+                               * jnp.arange(s, dtype=jnp.float32))
         mask = _causal(jnp.arange(s)) & kv_len_mask[:, None, :]  # (B,Tq,S)
         logits = jnp.where(mask[:, None, None], logits, -1e30)
         p = jax.nn.softmax(logits, axis=-1)
@@ -553,6 +620,10 @@ def _attention(q, k_all, v_all, q_positions, kv_len_mask, cfg):
                          preferred_element_type=jnp.float32)
         return out.astype(q.dtype).reshape(b, tq, hq * d)
 
+    if alibi_slopes is not None:
+        raise NotImplementedError(
+            "ALiBi rides the single-block path: set attn_block_size >= "
+            "max_position_embeddings on ALiBi configs (Bloom does)")
     blk = cfg.attn_block_size
     kv_len_mask = jnp.broadcast_to(kv_len_mask, (b, s))
     nblk = -(-s // blk)
@@ -649,8 +720,8 @@ def forward(params: Dict[str, Any], cfg: LlamaConfig,
         h = rms_norm(x, lp["input_layernorm"], cfg.rms_norm_eps)
         b, t, _ = h.shape
         q, k, v = attention_qkv(lp, h, cfg)
-        q = rope(q, positions, cfg.rope_theta)
-        k = rope(k, positions, cfg.rope_theta)
+        q = rope_cfg(q, positions, cfg)
+        k = rope_cfg(k, positions, cfg)
         k_cache = jax.lax.dynamic_update_slice(
             k_cache, k.astype(k_cache.dtype), (0, start, 0, 0))
         v_cache = jax.lax.dynamic_update_slice(

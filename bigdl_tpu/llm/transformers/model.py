@@ -97,8 +97,39 @@ def load_hf_llama_safetensors(path: str, cfg: Optional[LlamaConfig] = None,
     }
     L = cfg.num_hidden_layers
     layers: Dict[str, Any] = {}
+    # GLM/ChatGLM checkpoints fuse gate/up as mlp.gate_up_proj
+    # (gate = first intermediate_size rows); split back on read
+    glm_fused = "model.layers.0.mlp.gate_up_proj.weight" in key_map
+    if glm_fused:
+        # read each fused tensor ONCE per layer, feeding both halves
+        # (the name-outer loop below would otherwise read every 2I×H
+        # tensor twice — ~double the checkpoint I/O at 9B scale)
+        inter = cfg.intermediate_size
+        acc = {"gate_proj": {"q": [], "scale": [], "w": []},
+               "up_proj": {"q": [], "scale": [], "w": []}}
+        for l in range(L):
+            gu = np.asarray(
+                get(f"model.layers.{l}.mlp.gate_up_proj.weight"),
+                np.float32)
+            for name, half in (("gate_proj", gu[:inter]),
+                               ("up_proj", gu[inter:])):
+                if qtype:
+                    qd = quantize_tpu(half, qtype)
+                    acc[name]["q"].append(qd["q"])
+                    acc[name]["scale"].append(qd["scale"])
+                else:
+                    acc[name]["w"].append(half)
+        for name, a in acc.items():
+            if qtype:
+                layers[name] = {"q": jnp.asarray(np.stack(a["q"])),
+                                "scale": jnp.asarray(np.stack(a["scale"]))}
+            else:
+                layers[name] = {"w": jnp.asarray(np.stack(a["w"]), dtype)}
+
     for name in _LAYER_LINEARS:
         fmt = hf_linear[name]
+        if glm_fused and name in ("gate_proj", "up_proj"):
+            continue                       # built above in one pass
         if qtype:
             qs, ss = [], []
             for l in range(L):
@@ -231,6 +262,24 @@ class AutoModelForCausalLM:
                                                       qtype=qtype)
                 return GptNeoXForCausalLM(ncfg, nparams,
                                           max_cache_len=max_cache_len)
+            if raw.get("model_type") == "bloom":
+                from bigdl_tpu.llm.models.bloom import (
+                    BloomConfig, BloomForCausalLM,
+                    load_hf_bloom_safetensors)
+                bcfg = BloomConfig.from_hf(hf_shim)
+                bparams = load_hf_bloom_safetensors(path, bcfg,
+                                                    qtype=qtype)
+                return BloomForCausalLM(bcfg, bparams,
+                                        max_cache_len=max_cache_len)
+            if raw.get("model_type") == "gpt_bigcode":
+                from bigdl_tpu.llm.models.starcoder import (
+                    StarCoderConfig, StarCoderForCausalLM,
+                    load_hf_starcoder_safetensors)
+                scfg = StarCoderConfig.from_hf(hf_shim)
+                sparams = load_hf_starcoder_safetensors(path, scfg,
+                                                        qtype=qtype)
+                return StarCoderForCausalLM(scfg, sparams,
+                                            max_cache_len=max_cache_len)
             cfg = LlamaConfig.from_hf(hf_shim)
             params = load_hf_llama_safetensors(path, cfg, qtype=qtype)
             return LlamaForCausalLM(cfg, params,

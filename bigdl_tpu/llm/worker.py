@@ -118,6 +118,7 @@ class LLMWorker:
                         self.wfile.flush()
 
                     seen = 0
+                    done = False
                     deadline = time.time() + worker.request_timeout
                     while time.time() < deadline:
                         done = req.done.wait(0.02)
@@ -128,6 +129,12 @@ class LLMWorker:
                                    "done": bool(done)})
                         if done:
                             break
+                    if not done:
+                        # timed out: a stream must never end with
+                        # done:false — clients reading until done:true
+                        # would see a silent truncation (ADVICE r4)
+                        chunk({"output_ids": list(map(int, req.tokens)),
+                               "done": True, "finish_reason": "timeout"})
                     worker._tokens_out += seen
                     self.wfile.write(b"0\r\n\r\n")
                     self.wfile.flush()

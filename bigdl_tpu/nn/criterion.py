@@ -442,7 +442,10 @@ class MultiLabelMarginCriterion(Criterion):
         n, c = x2.shape
 
         def one(xb, tb):
-            valid = tb > 0                                   # (C,) padded
+            # torch semantics: the target list TERMINATES at the first 0
+            # — a row [3, 0, 2, 0] names only class 3 (the later 2 is
+            # unreachable), so validity is a prefix mask, not tb > 0
+            valid = jnp.cumprod((tb > 0).astype(jnp.int32)) > 0  # (C,)
             idx = jnp.clip(tb - 1, 0, c - 1)
             # NOT a scatter: padded entries (tb=0) also map to index 0,
             # and duplicate-index scatter order is undefined — a real

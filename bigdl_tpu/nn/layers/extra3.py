@@ -275,15 +275,41 @@ class ResizeBilinear(TensorModule):
         self.align_corners = align_corners
         self.format = format
 
+    @staticmethod
+    def _lerp_axis(x, axis: int, out_size: int):
+        """align-corners linear interp along one axis: output index i
+        samples src = i * (S-1)/(out-1) over the INCLUSIVE grid (corner
+        pixels map exactly to corner pixels)."""
+        s = x.shape[axis]
+        if out_size == 1 or s == 1:
+            idx = jnp.zeros((out_size,), jnp.int32)
+            return jnp.take(x, idx, axis=axis)
+        src = jnp.arange(out_size, dtype=jnp.float32) * ((s - 1.0)
+                                                         / (out_size - 1.0))
+        lo = jnp.clip(jnp.floor(src).astype(jnp.int32), 0, s - 1)
+        hi = jnp.clip(lo + 1, 0, s - 1)
+        w = (src - lo.astype(jnp.float32))
+        shape = [1] * x.ndim
+        shape[axis] = out_size
+        w = w.reshape(shape)
+        xl = jnp.take(x, lo, axis=axis).astype(jnp.float32)
+        xh = jnp.take(x, hi, axis=axis).astype(jnp.float32)
+        return xl * (1.0 - w) + xh * w
+
     def _apply(self, params, states, x, *, training, rng):
         oh, ow = self.out
+        hax, wax = (2, 3) if self.format == "NCHW" else (1, 2)
+        if self.align_corners:
+            # jax.image.resize has no align-corners mode; explicit
+            # gather + lerp over the inclusive grid (ADVICE r4: silently
+            # using half-pixel here diverged from the reference path)
+            y = self._lerp_axis(x, hax, oh)
+            y = self._lerp_axis(y, wax, ow)
+            return y.astype(x.dtype)
         if self.format == "NCHW":
             shape = (x.shape[0], x.shape[1], oh, ow)
         else:
             shape = (x.shape[0], oh, ow, x.shape[3])
-        # align_corners=True resize = linear interp over an inclusive
-        # grid; jax.image implements the standard (half-pixel) convention
-        # used by the reference's default, which is what we expose.
         return jax.image.resize(x, shape, method="bilinear").astype(x.dtype)
 
 

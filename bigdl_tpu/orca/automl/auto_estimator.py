@@ -141,11 +141,18 @@ class AutoEstimator:
             logger.info("asha rung %d (budget %d): %d trials, best "
                         "%s=%.6f", rung, min(budget, epochs),
                         len(scored), self.metric, scored[0][0])
-            for score, cfg, model, done in scored:
-                self._record(cfg, score, model)
+            # a trial is recorded exactly ONCE, at its FINAL evaluation
+            # (elimination or last rung) — recording every rung let
+            # best_model be captured early and then mutated by later
+            # incremental fit() calls, and duplicated trials entries
+            # (ADVICE r4)
             if budget >= epochs or len(scored) == 1:
+                for score, cfg, model, done in scored:
+                    self._record(cfg, score, model)
                 break
             keep = max(1, len(scored) // reduction_factor)
+            for score, cfg, model, done in scored[keep:]:
+                self._record(cfg, score, model)   # eliminated: final state
             live = [(cfg, model, done)
                     for score, cfg, model, done in scored[:keep]]
             budget *= reduction_factor

@@ -101,6 +101,10 @@ class ServingFrontend:
         if not ev.wait(self.result_timeout):
             with self._lock:
                 self._events.pop(uri, None)
+                # the dispatcher may have stored the result in the window
+                # between wait() returning False and this lock acquire —
+                # dropping only the event would leak that entry forever
+                self._results.pop(uri, None)
             return None
         with self._lock:
             self._events.pop(uri, None)

@@ -221,6 +221,36 @@ class TestCriterionTail:
                  + max(0, 1 - (0.1 - 0.8))) / 4
         assert abs(c.forward(x, t1) - want1) < 1e-5
 
+    def test_multilabel_margin_stops_at_first_zero(self):
+        """torch semantics: [3, 0, 2, 0] names ONLY class 3 — the list
+        terminates at the first zero (ADVICE r4); golden vs torch."""
+        import torch
+        c = nn.MultiLabelMarginCriterion()
+        x = np.array([[0.1, 0.2, 0.4, 0.8]], np.float32)
+        t = np.array([[3, 0, 2, 0]], np.float32)
+        want = float(torch.nn.MultiLabelMarginLoss()(
+            torch.tensor(x), torch.tensor([[2, -1, 1, -1]])))
+        assert abs(c.forward(x, t) - want) < 1e-5
+
+    def test_resize_bilinear_align_corners_matches_torch(self):
+        """align_corners=True is exact inclusive-grid lerp (ADVICE r4:
+        previously silently fell back to half-pixel). False stays on
+        jax.image.resize, whose antialiased downscale intentionally
+        differs from torch — only the True path is a torch golden."""
+        import torch
+        rs = np.random.RandomState(0)
+        x = rs.rand(2, 3, 5, 7).astype(np.float32)
+        got = _run(nn.ResizeBilinear(9, 4, align_corners=True), x)
+        want = torch.nn.functional.interpolate(
+            torch.tensor(x), size=(9, 4), mode="bilinear",
+            align_corners=True).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+        # corner pixels map exactly to corner pixels
+        up = _run(nn.ResizeBilinear(7, 9, align_corners=True), x)
+        np.testing.assert_allclose(up[..., 0, 0], x[..., 0, 0], rtol=1e-6)
+        np.testing.assert_allclose(up[..., -1, -1], x[..., -1, -1],
+                                   rtol=1e-6)
+
     def test_class_simplex(self):
         c = nn.ClassSimplexCriterion(n_classes=3)
         goal = np.asarray(c._targets)

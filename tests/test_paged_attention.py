@@ -80,6 +80,67 @@ class TestPagedAttention:
             *args, page_size=16, interpret=True, sliding_window=win))
         np.testing.assert_allclose(ker, ref, rtol=2e-3, atol=2e-3)
 
+    @pytest.mark.parametrize("interpret", [None, True])
+    @pytest.mark.parametrize("window", [None, 24])
+    def test_stats_merge_equals_write_then_attend(self, interpret, window):
+        """The round-5 serving decode structure: stats over the existing
+        ``lens`` tokens + merge of the current token's K/V must equal
+        writing the token to its page first and attending over lens+1
+        (what the python-loop decode did). interpret=None exercises the
+        XLA reference-stats path, True the Mosaic kernel thunk."""
+        from bigdl_tpu.llm.kernels.paged_attention import (
+            merge_attention_partial, paged_attention_reference,
+            paged_attention_stats)
+        rs = np.random.RandomState(4)
+        B, Hq, Hkv, D, page, P, maxp = 3, 8, 2, 128, 16, 64, 16
+        q, kp, vp, bt, lens = _setup(rs, B, Hq, Hkv, D, page, P, maxp)
+        lens = np.minimum(lens, maxp * page - 1)  # room for the new token
+        k_new = rs.randn(B, Hkv, D).astype(np.float32)
+        v_new = rs.randn(B, Hkv, D).astype(np.float32)
+
+        acc, m, l = paged_attention_stats(
+            jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+            jnp.asarray(bt), jnp.asarray(lens), page_size=page,
+            interpret=interpret,
+            sliding_window=None if window is None else window - 1)
+        got = np.asarray(merge_attention_partial(
+            acc, m, l, jnp.asarray(q), jnp.asarray(k_new),
+            jnp.asarray(v_new)))
+
+        # golden: write the token at (bt[b, lens//page], lens%page), then
+        # full attention over lens+1
+        kp2, vp2 = kp.copy(), vp.copy()
+        for bi in range(B):
+            pid = bt[bi, lens[bi] // page]
+            kp2[pid, :, lens[bi] % page] = k_new[bi]
+            vp2[pid, :, lens[bi] % page] = v_new[bi]
+        want = np.asarray(paged_attention_reference(
+            jnp.asarray(q), jnp.asarray(kp2), jnp.asarray(vp2),
+            jnp.asarray(bt), jnp.asarray(lens + 1),
+            sliding_window=window))
+        np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+
+    def test_stats_empty_row_identity(self):
+        """lens == 0 rows must return the combine identity so the merge
+        yields pure self-attention (softmax of one element = v_new)."""
+        from bigdl_tpu.llm.kernels.paged_attention import (
+            merge_attention_partial, paged_attention_stats)
+        rs = np.random.RandomState(5)
+        B, Hq, Hkv, D, page, P, maxp = 2, 4, 4, 128, 16, 32, 8
+        q, kp, vp, bt, _ = _setup(rs, B, Hq, Hkv, D, page, P, maxp)
+        lens = np.zeros(B, np.int32)
+        v_new = rs.randn(B, Hkv, D).astype(np.float32)
+        k_new = rs.randn(B, Hkv, D).astype(np.float32)
+        acc, m, l = paged_attention_stats(
+            jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+            jnp.asarray(bt), jnp.asarray(lens), page_size=page)
+        np.testing.assert_allclose(np.asarray(l), 0.0)
+        got = np.asarray(merge_attention_partial(
+            acc, m, l, jnp.asarray(q), jnp.asarray(k_new),
+            jnp.asarray(v_new)))
+        np.testing.assert_allclose(got, np.repeat(v_new, Hq // Hkv, 1),
+                                   rtol=1e-5, atol=1e-5)
+
     def test_lane_contract(self):
         rs = np.random.RandomState(3)
         q, kp, vp, bt, lens = _setup(rs, 2, 4, 4, 128, 16, 48, 12)

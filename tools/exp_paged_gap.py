@@ -1,0 +1,144 @@
+"""Chip experiment: where do the ~9.5 ms between the paged decode step
+(54.2 ms, b8 ctx256) and the fused-scan dense-cache step (~44.7 ms) go?
+
+Times three variants of the b8/7B decode step under the same fori-loop
+slope harness as bench_paged_decode_step:
+  full     — the real serving step (paged_attention_stats + merge + scatter)
+  noattn   — attention replaced by v (same matmuls/norms, no paged kernel)
+  nomerge  — kernel runs, merge replaced by acc (no combine math)
+full-noattn isolates the paged kernel + merge; full-nomerge isolates the
+combine. If the kernel dominates, its (b, hkv, nblk)-grid 4 KB page DMAs
+are the suspect (per-(page, head) copies are DMA-latency-bound)."""
+
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.llm.kernels.paged_attention import (
+    LANE, merge_attention_partial, paged_attention_stats)
+from bigdl_tpu.llm.models.llama import (LlamaConfig, _linear,
+                                        attention_qkv, mlp, rms_norm,
+                                        rope_cfg)
+
+import bench as _bench
+
+
+def build_step(cfg, bt, page, num_pages, mode: str):
+    def step(params, k_pages, v_pages, lens, toks):
+        b = toks.shape[0]
+        L = cfg.num_hidden_layers
+        kp_flat = k_pages.reshape((L * num_pages,) + k_pages.shape[2:])
+        vp_flat = v_pages.reshape((L * num_pages,) + v_pages.shape[2:])
+        x = params["embed_tokens"][toks][:, None]
+        positions = lens[:, None].astype(jnp.int32)
+
+        def layer_step(carry, inputs):
+            x, = carry
+            lp, l = inputs
+            h = rms_norm(x, lp["input_layernorm"], cfg.rms_norm_eps)
+            q, k, v = attention_qkv(lp, h, cfg)
+            q = rope_cfg(q, positions, cfg)
+            k = rope_cfg(k, positions, cfg)
+            if mode == "noattn":
+                attn = jnp.repeat(
+                    v[:, 0], cfg.num_attention_heads
+                    // cfg.num_key_value_heads, 1).astype(x.dtype)
+            else:
+                acc, m, lsum = paged_attention_stats(
+                    q[:, 0], kp_flat, vp_flat, bt + l * num_pages, lens,
+                    page_size=page)
+                if mode == "nomerge":
+                    attn = (acc / 256.0).astype(x.dtype)
+                else:
+                    attn = merge_attention_partial(
+                        acc, m, lsum, q[:, 0], k[:, 0],
+                        v[:, 0]).astype(x.dtype)
+            x = x + _linear(lp["o_proj"], attn.reshape(b, 1, -1))
+            h2 = rms_norm(x, lp["post_attention_layernorm"],
+                          cfg.rms_norm_eps)
+            x = x + mlp(lp, h2, x.dtype)
+            return (x,), (k[:, 0], v[:, 0])
+
+        (x,), (k_new, v_new) = jax.lax.scan(
+            layer_step, (x,), (params["layers"],
+                               jnp.arange(cfg.num_hidden_layers)))
+        x = rms_norm(x, params["norm"], cfg.rms_norm_eps)
+        logits = _linear(params["lm_head"], x)
+        pidx = lens // page
+        slot = lens % page
+        phys = bt[jnp.arange(b), pidx]
+        k_pages = k_pages.at[:, phys, :, slot].set(
+            k_new.transpose(1, 0, 2, 3).astype(k_pages.dtype))
+        v_pages = v_pages.at[:, phys, :, slot].set(
+            v_new.transpose(1, 0, 2, 3).astype(v_pages.dtype))
+        return (logits[:, 0].astype(jnp.float32), k_pages, v_pages)
+
+    return step
+
+
+def main(batch=8, ctx_len=256, page_size=16):
+    cfg = LlamaConfig.llama2_7b()
+    params = _bench._synthetic_q4_llama_params(cfg)
+    ppb = LANE // page_size
+    cap = -(-(ctx_len + 160) // page_size)
+    pages_cap = -(-cap // ppb) * ppb
+    num_pages = 1 + batch * pages_cap
+    nl, hkv, hd = (cfg.num_hidden_layers, cfg.num_key_value_heads,
+                   cfg.head_dim)
+    kk, kv = jax.random.split(jax.random.PRNGKey(1))
+    shape = (nl, num_pages, hkv, page_size, hd)
+    k_pages = jax.random.normal(kk, shape, jnp.bfloat16) * 0.1
+    v_pages = jax.random.normal(kv, shape, jnp.bfloat16) * 0.1
+    bt = np.zeros((batch, pages_cap), np.int32)
+    for b in range(batch):
+        bt[b] = 1 + b * pages_cap + np.arange(pages_cap)
+    bt = jnp.asarray(bt)
+    lens0 = jnp.full((batch,), ctx_len, jnp.int32)
+    toks0 = jnp.asarray(
+        np.random.RandomState(0).randint(0, cfg.vocab_size, (batch,)),
+        jnp.int32)
+
+    results = {}
+    for mode in ("full", "nomerge", "noattn"):
+        step = build_step(cfg, bt, page_size, num_pages, mode)
+
+        @functools.partial(jax.jit, static_argnames=("steps",),
+                           donate_argnums=(1, 2))
+        def run(params, kp, vp, lens, toks, steps: int):
+            def body(i, carry):
+                kp, vp, lens, toks = carry
+                logits, kp, vp = step(params, kp, vp, lens, toks)
+                return (kp, vp, lens + 1,
+                        jnp.argmax(logits, -1).astype(jnp.int32))
+            return jax.lax.fori_loop(0, steps, body,
+                                     (kp, vp, lens, toks))
+
+        kp = k_pages + 0
+        vp = v_pages + 0
+
+        def window(n, kp, vp):
+            t0 = time.perf_counter()
+            kp, vp, lens, toks = run(params, kp, vp, lens0, toks0, n)
+            int(np.asarray(toks)[0])
+            return time.perf_counter() - t0, kp, vp
+
+        for n in (8, 32):
+            _, kp, vp = window(n, kp, vp)
+        t_small, kp, vp = window(8, kp, vp)
+        t_big, kp, vp = window(32, kp, vp)
+        per = (t_big - t_small) / 24
+        if per <= 0:
+            per = t_big / 32
+        results[mode] = round(per * 1e3, 2)
+        print(mode, results[mode], "ms/step", flush=True)
+    print({"step_ms": results,
+           "attn_plus_merge_ms": round(
+               results["full"] - results["noattn"], 2),
+           "merge_ms": round(results["full"] - results["nomerge"], 2)})
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,108 @@
+"""Chip experiment (VERDICT r5 item 5): is the b1 in-context penalty
+caused by DISTINCT consecutive kernels failing to share the
+double-buffered weight stream?
+
+Method: slope-time (a) a loop of the qkv-shaped matvec alone, (b) a loop
+of the gate_up-shaped matvec alone, (c) a loop alternating the two, and
+(d) a loop chaining all four per-layer decode matvecs (qkv -> o ->
+gate_up -> down) with data dependencies, like the live layer but without
+rmsnorm/rope/attention. If (c) ≈ (a)+(b) and (d) ≈ sum of all four,
+kernel-transition stream sharing is NOT the bottleneck and a fused
+megakernel cannot recover the gap; the residual must come from the
+non-matmul ops. Uses the fori-loop slope harness (>=500 iteration
+pairs) per the tenancy-noise rule."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.llm.ggml.quantize import QK
+from bigdl_tpu.llm.models.llama import _linear
+
+H, QKV_N, INTER = 4096, 4096 + 4096 + 4096, 11008
+
+
+def mk_q4(key, k, n):
+    k1, k2 = jax.random.split(key)
+    return {"q": jax.random.randint(k1, (k // 2, n), 0, 256, jnp.uint8),
+            "scale": jax.random.uniform(k2, (k // QK, n), jnp.float32,
+                                        0.001, 0.02)}
+
+
+def slope(fn, iters=500):
+    """Per-iteration time as the slope between iters/4 and iters."""
+    def loop_for(n_it):
+        @jax.jit
+        def loop(x):
+            def body(i, carry):
+                x, acc = carry
+                y = fn(x)
+                return (x + y * jnp.asarray(1e-30, x.dtype), acc + y)
+            return jax.lax.fori_loop(0, n_it, body, (x, jnp.float32(0)))
+        return loop
+    xs = [jnp.ones((1, H), jnp.bfloat16) * (1 + 1e-3 * i)
+          for i in range(8)]
+    xs = jax.block_until_ready(xs)
+    pts, xi = [], 0
+    for n_it in (iters // 4, iters):
+        loop = loop_for(n_it)
+        float(loop(xs[0])[1])
+        best = 1e9
+        for _ in range(3):
+            xi += 1
+            t0 = time.perf_counter()
+            float(loop(xs[xi % len(xs)])[1])
+            best = min(best, time.perf_counter() - t0)
+        pts.append((n_it, best))
+    (a1, b1), (a2, b2) = pts
+    sl = (b2 - b1) / (a2 - a1)
+    return sl if sl > 0 else b2 / a2
+
+
+def main():
+    key = jax.random.PRNGKey(0)
+    ks = jax.random.split(key, 4)
+    qkv = mk_q4(ks[0], H, QKV_N)
+    o = mk_q4(ks[1], H, H)
+    gate_up = mk_q4(ks[2], H, 2 * INTER)
+    down = mk_q4(ks[3], INTER, H)
+
+    t_qkv = slope(lambda x: _linear(qkv, x).sum())
+    t_gu = slope(lambda x: _linear(gate_up, x).sum())
+    t_o = slope(lambda x: _linear(o, x).sum())
+    t_down = slope(lambda x: _linear(
+        down, jnp.broadcast_to(x[:, :1], (1, INTER)).astype(x.dtype)
+        * jnp.float32(1e-6).astype(x.dtype)).sum())
+
+    def alt(x):
+        return _linear(qkv, x).sum() + _linear(gate_up, x).sum()
+    t_alt = slope(alt)
+
+    def chain(x):
+        y = _linear(qkv, x)                       # (1, 12288)
+        a = y[:, :H] * jnp.float32(1e-6).astype(y.dtype)
+        z = _linear(o, a)
+        h2 = x + z
+        gu = _linear(gate_up, h2)
+        act = (gu[:, :INTER] * gu[:, INTER:]).astype(x.dtype)
+        return _linear(down, act).sum()
+    t_chain = slope(chain)
+
+    print({
+        "qkv_us": round(t_qkv * 1e6, 1),
+        "gate_up_us": round(t_gu * 1e6, 1),
+        "o_us": round(t_o * 1e6, 1),
+        "down_us": round(t_down * 1e6, 1),
+        "alt_us": round(t_alt * 1e6, 1),
+        "alt_vs_sum": round(t_alt / (t_qkv + t_gu), 3),
+        "chain_us": round(t_chain * 1e6, 1),
+        "chain_vs_sum": round(
+            t_chain / (t_qkv + t_gu + t_o + t_down), 3),
+        "sum4_us": round((t_qkv + t_gu + t_o + t_down) * 1e6, 1),
+    })
+
+
+if __name__ == "__main__":
+    main()
