@@ -689,6 +689,7 @@ def bench_paged_decode_step(batch: int = 8, ctx_len: int = 256,
                       "kv_pool_gb": round(pool_gb, 2),
                       "num_pages": num_pages,
                       "decode_mode": "shared_scan_readonly_pool",
+                      "attn_kernel": "page_major",
                       "backend": jax.default_backend()}}
 
 

@@ -74,11 +74,10 @@ def _synthetic_cifar(n: int, classes: int, seed: int,
 def nearest_prototype_accuracy(images: np.ndarray, labels: np.ndarray,
                                classes: int = 10) -> float:
     """Top-1 of the nearest-prototype classifier (the Bayes anchor the
-    convergence bench reports; labels 1-based)."""
-    pf = _protos(classes).reshape(classes, -1)
-    x = images.reshape(len(images), -1)
-    d = (pf * pf).sum(1)[None, :] - 2.0 * (x @ pf.T)
-    return float((d.argmin(1) == (labels - 1).astype(np.int64)).mean())
+    convergence bench reports; labels 1-based). Shares the mnist
+    implementation — the math must not diverge between the two benches."""
+    from bigdl_tpu.feature.mnist import _nearest_prototype_accuracy
+    return _nearest_prototype_accuracy(_protos(classes), images, labels)
 
 
 def load_cifar(folder: Optional[str] = None, train: bool = True,

@@ -88,15 +88,22 @@ def _synthetic_digits(n: int, seed: int,
     return imgs.astype(np.float32), (labels + 1).astype(np.float32)  # 1-based
 
 
+def _nearest_prototype_accuracy(protos: np.ndarray, images: np.ndarray,
+                                labels: np.ndarray) -> float:
+    """Shared nearest-prototype top-1 (labels 1-based) — single source
+    for the mnist AND cifar Bayes anchors."""
+    pf = protos.reshape(len(protos), -1)
+    x = images.reshape(len(images), -1)
+    d = (pf * pf).sum(1)[None, :] - 2.0 * (x @ pf.T)
+    return float((d.argmin(1) == (labels - 1).astype(np.int64)).mean())
+
+
 def nearest_prototype_accuracy(images: np.ndarray,
                                labels: np.ndarray) -> float:
     """Top-1 of the nearest-prototype classifier on a synthetic draw —
     the Bayes reference the convergence bench reports next to the
     trained model's accuracy (labels 1-based)."""
-    pf = _protos().reshape(10, -1)
-    x = images.reshape(len(images), -1)
-    d = (pf * pf).sum(1)[None, :] - 2.0 * (x @ pf.T)
-    return float((d.argmin(1) == (labels - 1).astype(np.int64)).mean())
+    return _nearest_prototype_accuracy(_protos(), images, labels)
 
 
 def load_mnist(folder: Optional[str] = None, train: bool = True,

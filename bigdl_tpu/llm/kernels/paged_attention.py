@@ -132,11 +132,124 @@ def _paged_decode_kernel_stats(len_ref, bt_ref, q_ref, k_hbm, v_hbm,
                          m_out=mo_ref, l_out=lo_ref)
 
 
+def _paged_decode_kernel_pm(len_ref, bt_ref, q_ref, k_hbm, v_hbm, o_ref,
+                            kbuf, vbuf, sem, acc_ref, m_ref, l_ref,
+                            *, page: int, ppb: int, pages_max: int,
+                            hkv: int, scale: float,
+                            window: Optional[int] = None,
+                            m_out=None, l_out=None):
+    """PAGE-MAJOR variant: one (batch row b, page block blk) step copies
+    each page ACROSS ALL KV HEADS in a single contiguous DMA.
+
+    The head-minor kernel above issues ``2·ppb`` DMAs of one head-page
+    (page·D·2 bytes ≈ 4 KB) per grid cell over a (B, Hkv, nblk) grid —
+    at 7B decode that is ~16k 4 KB copies per layer, and the measured
+    cost is DMA-issue-bound: attention was 27.8 ms of the 55 ms paged
+    step (tools/exp_paged_gap.py) vs ~17 ms for the dense cache path.
+    Here the grid is (B, nblk) and each cell copies ``2·ppb`` blocks of
+    ``(Hkv, page, D)`` (≈128 KB contiguous at 7B) — 32× fewer, 32×
+    larger DMAs — then statically loops the Hkv heads in-register.
+    Measured effect on the full 7B b8/ctx256 serving decode step:
+    54.2 → 37.0 ms (147.7 → 216.3 tok/s), taking the paged path ~21%
+    PAST the dense fused-scan step (~44.7 ms) — the page pool's DMA
+    pattern is now cheaper than XLA's dense cache attention.
+
+    len_ref: (B,) lengths; bt_ref: (B·pages_max,) flat tables; q_ref
+    (1, hkv, gp, D) VMEM; k/v_hbm (P, Hkv, page, D) in ANY space;
+    o_ref (1, hkv, gp, D); kbuf/vbuf (ppb, Hkv, page, D) VMEM scratch;
+    acc (hkv·gp, D) f32; m/l (hkv·gp, LANE) f32 running stats."""
+    b = pl.program_id(0)
+    blk = pl.program_id(1)
+    nblk = pl.num_programs(1)
+
+    @pl.when(blk == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, -1e30)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    seq = len_ref[b]
+    base_tok = blk * (ppb * page)
+
+    @pl.when(base_tok < seq)
+    def _compute():
+        copies = []
+        for i in range(ppb):                    # static unroll
+            pid = bt_ref[b * pages_max + blk * ppb + i]
+            ck = pltpu.make_async_copy(k_hbm.at[pid], kbuf.at[i], sem)
+            cv = pltpu.make_async_copy(v_hbm.at[pid], vbuf.at[i], sem)
+            ck.start()
+            cv.start()
+            copies += [ck, cv]
+        for c in copies:
+            c.wait()
+        gp, d = q_ref.shape[2], q_ref.shape[3]
+        pos = base_tok + jax.lax.broadcasted_iota(
+            jnp.int32, (gp, ppb * page), 1)
+        valid = pos < seq
+        if window is not None:
+            valid &= pos >= seq - window
+        for h in range(hkv):                    # static unroll over heads
+            q = q_ref[0, h].astype(jnp.float32)               # (gp, D)
+            k = kbuf[:, h].reshape(ppb * page, d).astype(jnp.float32)
+            v = vbuf[:, h].reshape(ppb * page, d).astype(jnp.float32)
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale   # (gp, LANE)
+            s = jnp.where(valid, s, -1e30)
+            # static-slice loads/stores on the scratch refs per head
+            # (functional .at[].set on a value lowers to scatter, which
+            # Mosaic does not implement)
+            r0 = h * gp
+            m_prev = m_ref[r0:r0 + gp]
+            l_prev = l_ref[r0:r0 + gp]
+            m_cur = jnp.max(s, axis=1, keepdims=True)
+            m_new = jnp.maximum(m_prev, jnp.broadcast_to(m_cur,
+                                                         m_prev.shape))
+            alpha = jnp.exp(m_prev[:, :1] - m_new[:, :1])
+            p_ = jnp.exp(s - m_new[:, :1])
+            l_new = (alpha * l_prev[:, :1]
+                     + jnp.sum(p_, axis=1, keepdims=True))
+            acc_ref[r0:r0 + gp] = (
+                acc_ref[r0:r0 + gp] * alpha + jax.lax.dot_general(
+                    p_, v, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32))
+            m_ref[r0:r0 + gp] = m_new
+            l_ref[r0:r0 + gp] = jnp.broadcast_to(l_new, l_prev.shape)
+
+    @pl.when(blk == nblk - 1)
+    def _finish():
+        gp, d = q_ref.shape[2], q_ref.shape[3]
+        if m_out is None:
+            o_ref[0] = (acc_ref[...]
+                        / jnp.maximum(l_ref[:, :1], 1e-30)).reshape(
+                            hkv, gp, d).astype(o_ref.dtype)
+        else:
+            o_ref[0] = acc_ref[...].reshape(hkv, gp, d).astype(o_ref.dtype)
+            m_out[0] = m_ref[...].reshape(hkv, gp, LANE)
+            l_out[0] = l_ref[...].reshape(hkv, gp, LANE)
+
+
+def _paged_decode_kernel_pm_stats(len_ref, bt_ref, q_ref, k_hbm, v_hbm,
+                                  o_ref, mo_ref, lo_ref, kbuf, vbuf, sem,
+                                  acc_ref, m_ref, l_ref, *, page: int,
+                                  ppb: int, pages_max: int, hkv: int,
+                                  scale: float,
+                                  window: Optional[int] = None):
+    _paged_decode_kernel_pm(len_ref, bt_ref, q_ref, k_hbm, v_hbm, o_ref,
+                            kbuf, vbuf, sem, acc_ref, m_ref, l_ref,
+                            page=page, ppb=ppb, pages_max=pages_max,
+                            hkv=hkv, scale=scale, window=window,
+                            m_out=mo_ref, l_out=lo_ref)
+
+
 @functools.partial(jax.jit, static_argnames=("page_size", "interpret",
-                                             "sliding_window"))
+                                             "sliding_window",
+                                             "page_major"))
 def paged_attention_decode(q, k_pages, v_pages, block_tables, lengths,
                            page_size: int = 16, interpret: bool = False,
-                           sliding_window: Optional[int] = None):
+                           sliding_window: Optional[int] = None,
+                           page_major: bool = True):
     """Decode-step attention over a paged KV cache.
 
     q: (B, Hq, D) current-token queries; k_pages/v_pages:
@@ -177,6 +290,42 @@ def paged_attention_decode(q, k_pages, v_pages, block_tables, lengths,
         v_pages = jnp.pad(v_pages, ((0, 0), (0, 0), (0, 0), (0, dp - d)))
         d = dp
 
+    if page_major:
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(b, nblk),
+            in_specs=[
+                pl.BlockSpec((1, hkv, gp, d), lambda b_, k_, *_:
+                             (b_, 0, 0, 0)),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+            ],
+            out_specs=pl.BlockSpec((1, hkv, gp, d),
+                                   lambda b_, k_, *_: (b_, 0, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((ppb, hkv, page, d), k_pages.dtype),
+                pltpu.VMEM((ppb, hkv, page, d), v_pages.dtype),
+                pltpu.SemaphoreType.DMA,
+                pltpu.VMEM((hkv * gp, d), jnp.float32),
+                pltpu.VMEM((hkv * gp, LANE), jnp.float32),
+                pltpu.VMEM((hkv * gp, LANE), jnp.float32),
+            ],
+        )
+        out = pl.pallas_call(
+            functools.partial(_paged_decode_kernel_pm, page=page_size,
+                              ppb=ppb, pages_max=pages_max, hkv=hkv,
+                              scale=scale, window=sliding_window),
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((b, hkv, gp, d), jnp.float32),
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "arbitrary")),
+            interpret=interpret,
+        )(lengths.astype(jnp.int32),
+          block_tables.reshape(-1).astype(jnp.int32), qg, k_pages,
+          v_pages)
+        return (out[:, :, :g, :d_orig].reshape(b, hq, d_orig)
+                .astype(q.dtype))
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(b, hkv, nblk),
@@ -212,11 +361,13 @@ def paged_attention_decode(q, k_pages, v_pages, block_tables, lengths,
 
 
 @functools.partial(jax.jit, static_argnames=("page_size", "interpret",
-                                             "sliding_window"))
+                                             "sliding_window",
+                                             "page_major"))
 def paged_attention_decode_stats(q, k_pages, v_pages, block_tables,
                                  lengths, page_size: int = 16,
                                  interpret: bool = False,
-                                 sliding_window: Optional[int] = None):
+                                 sliding_window: Optional[int] = None,
+                                 page_major: bool = True):
     """Like :func:`paged_attention_decode` but over the first ``lengths``
     tokens WITHOUT normalizing, returning the flash-style partial state
     ``(acc (B, Hq, D) f32 unnormalized, m (B, Hq) f32, l (B, Hq) f32)``
@@ -246,6 +397,53 @@ def paged_attention_decode_stats(q, k_pages, v_pages, block_tables,
         k_pages = jnp.pad(k_pages, ((0, 0), (0, 0), (0, 0), (0, dp - d)))
         v_pages = jnp.pad(v_pages, ((0, 0), (0, 0), (0, 0), (0, dp - d)))
         d = dp
+
+    if page_major:
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(b, nblk),
+            in_specs=[
+                pl.BlockSpec((1, hkv, gp, d), lambda b_, k_, *_:
+                             (b_, 0, 0, 0)),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, hkv, gp, d),
+                             lambda b_, k_, *_: (b_, 0, 0, 0)),
+                pl.BlockSpec((1, hkv, gp, LANE),
+                             lambda b_, k_, *_: (b_, 0, 0, 0)),
+                pl.BlockSpec((1, hkv, gp, LANE),
+                             lambda b_, k_, *_: (b_, 0, 0, 0)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((ppb, hkv, page, d), k_pages.dtype),
+                pltpu.VMEM((ppb, hkv, page, d), v_pages.dtype),
+                pltpu.SemaphoreType.DMA,
+                pltpu.VMEM((hkv * gp, d), jnp.float32),
+                pltpu.VMEM((hkv * gp, LANE), jnp.float32),
+                pltpu.VMEM((hkv * gp, LANE), jnp.float32),
+            ],
+        )
+        acc, m, l = pl.pallas_call(
+            functools.partial(_paged_decode_kernel_pm_stats,
+                              page=page_size, ppb=ppb,
+                              pages_max=pages_max, hkv=hkv, scale=scale,
+                              window=sliding_window),
+            grid_spec=grid_spec,
+            out_shape=[
+                jax.ShapeDtypeStruct((b, hkv, gp, d), jnp.float32),
+                jax.ShapeDtypeStruct((b, hkv, gp, LANE), jnp.float32),
+                jax.ShapeDtypeStruct((b, hkv, gp, LANE), jnp.float32)],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "arbitrary")),
+            interpret=interpret,
+        )(lengths.astype(jnp.int32),
+          block_tables.reshape(-1).astype(jnp.int32), qg, k_pages,
+          v_pages)
+        return (acc[:, :, :g, :d_orig].reshape(b, hq, d_orig),
+                m[:, :, :g, 0].reshape(b, hq),
+                l[:, :, :g, 0].reshape(b, hq))
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,

@@ -1,0 +1,94 @@
+"""Shared generation facade for the simple model families.
+
+GPT-NeoX, Bloom and StarCoder drive the exact same loop: jitted
+prefill step + one-jit greedy ``decode_scan`` with a donated cache and
+EOS-chunked early exit. One base class keeps the four families'
+decode-loop semantics in lockstep (review r5: the copy-pasted facades
+could silently diverge on a one-file fix). Llama keeps its richer
+facade (sampling knobs, ring prefill, TP shard) in llama.py.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.llm.models.llama import decode_scan
+
+
+class CausalLMFacade:
+    """Greedy generation driver over a family's ``forward``/``init_cache``.
+
+    Subclasses set ``_forward`` and ``_init_cache`` (module functions)
+    as class attributes via ``staticmethod``."""
+
+    _forward = None
+    _init_cache = None
+
+    def __init__(self, cfg, params: Dict[str, Any],
+                 max_cache_len: int = 512, cache_dtype=jnp.bfloat16):
+        self.config = cfg
+        self.params = params
+        self.cache_dtype = cache_dtype
+        self.max_cache_len = min(max_cache_len,
+                                 cfg.max_position_embeddings)
+        fwd = type(self)._forward
+        self._step = jax.jit(functools.partial(fwd, cfg=cfg))
+        self._decode_scan = jax.jit(
+            functools.partial(decode_scan, cfg=cfg, forward_fn=fwd),
+            static_argnames=("num_tokens", "do_sample", "top_k",
+                             "eos_token_id"),
+            donate_argnames=("cache",))
+
+    @classmethod
+    def from_config(cls, cfg, seed: int = 0,
+                    load_in_low_bit: Optional[str] = None,
+                    max_cache_len: int = 512):
+        params = cls._init_params(cfg, seed)
+        if load_in_low_bit:
+            params = cls._quantize_params(params, load_in_low_bit)
+        return cls(cfg, params, max_cache_len)
+
+    def __call__(self, tokens, cache=None, positions=None):
+        b, t = tokens.shape
+        if cache is None:
+            cache = type(self)._init_cache(self.config, b,
+                                           self.max_cache_len,
+                                           dtype=self.cache_dtype)
+        if positions is None:
+            base = jnp.asarray(cache["pos"])
+            positions = base + jnp.broadcast_to(jnp.arange(t), (b, t))
+        return self._step(self.params, tokens=jnp.asarray(tokens),
+                          cache=cache, positions=positions)
+
+    def generate(self, input_ids, max_new_tokens: int = 32,
+                 eos_token_id: Optional[int] = None,
+                 decode_chunk: int = 32):
+        """Greedy decode via the one-jit scan loop (llama.decode_scan)."""
+        tokens = jnp.asarray(np.asarray(input_ids), jnp.int32)
+        b, t0 = tokens.shape
+        if t0 + max_new_tokens > self.max_cache_len:
+            raise ValueError(f"sequence {t0}+{max_new_tokens} exceeds "
+                             f"cache {self.max_cache_len}")
+        logits, cache = self(tokens)
+        key = jax.random.PRNGKey(0)
+        last = logits[:, -1]
+        pieces = [np.asarray(tokens)]
+        remaining = max_new_tokens
+        chunk = max_new_tokens if eos_token_id is None else decode_chunk
+        finished = jnp.zeros((b,), bool)
+        while remaining > 0:
+            n = min(chunk, remaining)
+            toks, cache, last, key, finished = self._decode_scan(
+                self.params, cache, last, key, jnp.float32(1.0), finished,
+                num_tokens=n, eos_token_id=eos_token_id)
+            pieces.append(np.asarray(toks))
+            remaining -= n
+            if (eos_token_id is not None
+                    and np.asarray(finished).all()):
+                break
+        return np.concatenate(pieces, axis=1)

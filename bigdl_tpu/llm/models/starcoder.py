@@ -20,8 +20,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from bigdl_tpu.llm.models._facade import CausalLMFacade
 from bigdl_tpu.llm.models.gptneox import _layer_norm, _linear_b
-from bigdl_tpu.llm.models.llama import _attention, decode_scan
+from bigdl_tpu.llm.models.llama import _attention
 
 
 @dataclasses.dataclass
@@ -185,68 +186,13 @@ def forward(params: Dict[str, Any], cfg: StarCoderConfig,
         "k": k_new, "v": v_new, "pos": start + tokens.shape[1]}
 
 
-class StarCoderForCausalLM:
-    """Generation facade — same driver contract as LlamaForCausalLM."""
+class StarCoderForCausalLM(CausalLMFacade):
+    """Generation facade — shared driver (see models._facade)."""
 
-    def __init__(self, cfg: StarCoderConfig, params: Dict[str, Any],
-                 max_cache_len: int = 512, cache_dtype=jnp.bfloat16):
-        self.config = cfg
-        self.params = params
-        self.cache_dtype = cache_dtype
-        self.max_cache_len = min(max_cache_len, cfg.max_position_embeddings)
-        self._step = jax.jit(functools.partial(forward, cfg=cfg))
-        self._decode_scan = jax.jit(
-            functools.partial(decode_scan, cfg=cfg, forward_fn=forward),
-            static_argnames=("num_tokens", "do_sample", "top_k",
-                             "eos_token_id"),
-            donate_argnames=("cache",))
-
-    @classmethod
-    def from_config(cls, cfg: StarCoderConfig, seed: int = 0,
-                    load_in_low_bit: Optional[str] = None,
-                    max_cache_len: int = 512) -> "StarCoderForCausalLM":
-        params = init_params(cfg, seed)
-        if load_in_low_bit:
-            params = quantize_params(params, load_in_low_bit)
-        return cls(cfg, params, max_cache_len)
-
-    def __call__(self, tokens, cache=None, positions=None):
-        b, t = tokens.shape
-        if cache is None:
-            cache = init_cache(self.config, b, self.max_cache_len,
-                               dtype=self.cache_dtype)
-        if positions is None:
-            base = jnp.asarray(cache["pos"])
-            positions = base + jnp.broadcast_to(jnp.arange(t), (b, t))
-        return self._step(self.params, tokens=jnp.asarray(tokens),
-                          cache=cache, positions=positions)
-
-    def generate(self, input_ids, max_new_tokens: int = 32,
-                 eos_token_id: Optional[int] = None,
-                 decode_chunk: int = 32):
-        tokens = jnp.asarray(np.asarray(input_ids), jnp.int32)
-        b, t0 = tokens.shape
-        if t0 + max_new_tokens > self.max_cache_len:
-            raise ValueError(f"sequence {t0}+{max_new_tokens} exceeds "
-                             f"cache {self.max_cache_len}")
-        logits, cache = self(tokens)
-        key = jax.random.PRNGKey(0)
-        last = logits[:, -1]
-        pieces = [np.asarray(tokens)]
-        remaining = max_new_tokens
-        chunk = max_new_tokens if eos_token_id is None else decode_chunk
-        finished = jnp.zeros((b,), bool)
-        while remaining > 0:
-            n = min(chunk, remaining)
-            toks, cache, last, key, finished = self._decode_scan(
-                self.params, cache, last, key, jnp.float32(1.0), finished,
-                num_tokens=n, eos_token_id=eos_token_id)
-            pieces.append(np.asarray(toks))
-            remaining -= n
-            if (eos_token_id is not None
-                    and np.asarray(finished).all()):
-                break
-        return np.concatenate(pieces, axis=1)
+    _forward = staticmethod(forward)
+    _init_cache = staticmethod(init_cache)
+    _init_params = staticmethod(init_params)
+    _quantize_params = staticmethod(quantize_params)
 
 
 # ---------------------------------------------------------------------------

@@ -15,6 +15,14 @@ from bigdl_tpu.optim import (Adam, Evaluator, Optimizer, Top1Accuracy,
                              Trigger)
 
 
+import functools
+
+
+@functools.lru_cache(maxsize=None)
+def _train_top1_cached(lr: float, epochs: int = 3) -> float:
+    return _train_top1(lr, epochs)
+
+
 def _train_top1(lr: float, epochs: int = 3) -> float:
     xtr, ytr = load_mnist(train=True, synthetic_size=2048, hard=True)
     xte, yte = load_mnist(train=False, synthetic_size=1024, hard=True)
@@ -48,14 +56,14 @@ class TestConvergenceFalsifiable:
         """lr=0 (the deliberately broken optimizer) must land near
         chance — the band [0.90, 0.99) catches it. This is the evidence
         that the benchmark metric CAN fail."""
-        acc = _train_top1(lr=0.0, epochs=1)
+        acc = _train_top1_cached(lr=0.0, epochs=1)
         assert acc < 0.35, f"lr=0 control scored {acc}: metric cannot fail"
 
     def test_healthy_short_run_beats_control(self):
         """A real (short) run clears the control by a wide margin on the
         same hard set — the band's lower edge is reachable."""
         acc = _train_top1(lr=1e-3, epochs=4)
-        lamed = _train_top1(lr=0.0, epochs=1)
+        lamed = _train_top1_cached(lr=0.0, epochs=1)
         # 2048 samples x 4 epochs reaches ~0.7 on the hard set (the full
         # bench runs 8192 x 12); the test only pins healthy >> lamed
         assert acc > 0.6, f"healthy short run only reached {acc}"

@@ -53,14 +53,17 @@ class TestPagedAttention:
                                        _naive(q, kp, vp, bt, lens, bi),
                                        rtol=2e-5, atol=2e-5)
 
+    @pytest.mark.parametrize("page_major", [False, True])
     @pytest.mark.parametrize("Hq,Hkv", [(8, 8), (8, 2)])
-    def test_kernel_interpret_matches_reference(self, Hq, Hkv):
+    def test_kernel_interpret_matches_reference(self, Hq, Hkv,
+                                                page_major):
         rs = np.random.RandomState(1)
         q, kp, vp, bt, lens = _setup(rs, 2, Hq, Hkv, 128, 16, 48, 16)
         args = (jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
                 jnp.asarray(bt), jnp.asarray(lens))
         ker = np.asarray(paged_attention_decode(*args, page_size=16,
-                                                interpret=True))
+                                                interpret=True,
+                                                page_major=page_major))
         ref = np.asarray(paged_attention_reference(*args))
         np.testing.assert_allclose(ker, ref, rtol=2e-3, atol=2e-3)
 

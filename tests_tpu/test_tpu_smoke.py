@@ -123,6 +123,40 @@ class TestPagedAttentionOnChip:
             q, kp, vp, bt, lens), np.float32)
         assert np.abs(ker - ref).max() < 0.05
 
+    @pytest.mark.parametrize("B,Hq,Hkv,maxp", [(4, 32, 32, 32),
+                                               (8, 32, 8, 16)])
+    def test_stats_kernel_merge_parity(self, B, Hq, Hkv, maxp):
+        """Round-5 serving decode structure on HARDWARE: stats kernel +
+        self-token merge == write-then-attend reference at production
+        shapes (what paged_decode_step runs inside its layer scan)."""
+        from bigdl_tpu.llm.kernels.paged_attention import (
+            merge_attention_partial, paged_attention_reference,
+            paged_attention_stats)
+        rs = np.random.RandomState(1)
+        D, page, P = 128, 16, max(256, B * maxp + 1)
+        q = jnp.asarray(rs.randn(B, Hq, D), jnp.bfloat16)
+        kp = jnp.asarray(rs.randn(P, Hkv, page, D) * 0.5, jnp.bfloat16)
+        vp = jnp.asarray(rs.randn(P, Hkv, page, D) * 0.5, jnp.bfloat16)
+        bt = jnp.asarray(rs.permutation(P)[:B * maxp].reshape(B, maxp),
+                         jnp.int32)
+        lens = np.asarray(rs.randint(1, maxp * page - 1, (B,)), np.int32)
+        k_new = jnp.asarray(rs.randn(B, Hkv, D) * 0.5, jnp.bfloat16)
+        v_new = jnp.asarray(rs.randn(B, Hkv, D) * 0.5, jnp.bfloat16)
+        acc, m, l = paged_attention_stats(q, kp, vp, bt,
+                                          jnp.asarray(lens),
+                                          page_size=page)
+        got = np.asarray(merge_attention_partial(
+            acc, m, l, q, k_new, v_new), np.float32)
+        kp2, vp2 = np.asarray(kp, np.float32), np.asarray(vp, np.float32)
+        for bi in range(B):
+            pid = int(bt[bi, lens[bi] // page])
+            kp2[pid, :, lens[bi] % page] = np.asarray(k_new, np.float32)[bi]
+            vp2[pid, :, lens[bi] % page] = np.asarray(v_new, np.float32)[bi]
+        want = np.asarray(paged_attention_reference(
+            q.astype(jnp.float32), jnp.asarray(kp2), jnp.asarray(vp2),
+            bt, jnp.asarray(lens + 1)), np.float32)
+        assert np.abs(got - want).max() < 0.05
+
     def test_paged_server_greedy_parity_on_chip(self):
         """A paged LLMServer on hardware reproduces generate() exactly."""
         import dataclasses
