@@ -227,11 +227,8 @@ def load_hf_bloom_safetensors(path: str, cfg: Optional[BloomConfig] = None,
     """HF BloomForCausalLM checkpoint → our stacked layout. HF fuses qkv
     as ``self_attention.query_key_value`` with per-head [q; k; v]
     interleaving — split back into separate projections here."""
-    import glob as _glob
     import json as _json
     import os as _os
-
-    from safetensors import safe_open
 
     from bigdl_tpu.llm.kernels import quantize_tpu
 
@@ -242,21 +239,9 @@ def load_hf_bloom_safetensors(path: str, cfg: Optional[BloomConfig] = None,
             raw = _json.load(f)
         cfg = BloomConfig.from_hf(type("HFConfig", (), raw)())
 
-    key_map: Dict[str, str] = {}
-    for fname in sorted(_glob.glob(_os.path.join(path, "*.safetensors"))):
-        with safe_open(fname, framework="numpy") as f:
-            for k in f.keys():
-                key_map[k] = fname
-    handles: Dict[str, Any] = {}
-
-    def get(name):
-        # bloom checkpoints may or may not carry the "transformer." prefix
-        if name not in key_map and "transformer." + name in key_map:
-            name = "transformer." + name
-        fname = key_map[name]
-        if fname not in handles:
-            handles[fname] = safe_open(fname, framework="numpy")
-        return np.asarray(handles[fname].get_tensor(name), np.float32)
+    from bigdl_tpu.llm.transformers.st_reader import SafetensorsReader
+    reader = SafetensorsReader(path)   # handles the optional
+    get = reader.get                   # "transformer." name prefix
 
     L = cfg.num_hidden_layers
     nh, hd, h = cfg.num_attention_heads, cfg.head_dim, cfg.hidden_size

@@ -292,11 +292,8 @@ def load_hf_gptneox_safetensors(path: str,
     """HF GPTNeoXForCausalLM checkpoint → our stacked layout. The HF
     layer fuses qkv as ``query_key_value`` with per-head interleaving
     [q1 k1 v1 q2 k2 v2 ...]; we split it back into separate projections."""
-    import glob as _glob
     import json as _json
     import os as _os
-
-    from safetensors import safe_open
 
     from bigdl_tpu.llm.kernels import quantize_tpu
 
@@ -309,18 +306,8 @@ def load_hf_gptneox_safetensors(path: str,
 
     # lazy per-tensor reads (same stream-per-layer pattern as the llama
     # loader): only one layer's tensors are resident at a time
-    key_map: Dict[str, str] = {}
-    for fname in sorted(_glob.glob(_os.path.join(path, "*.safetensors"))):
-        with safe_open(fname, framework="numpy") as f:
-            for k in f.keys():
-                key_map[k] = fname
-    handles: Dict[str, Any] = {}
-
-    def get(name):
-        fname = key_map[name]
-        if fname not in handles:
-            handles[fname] = safe_open(fname, framework="numpy")
-        return np.asarray(handles[fname].get_tensor(name), np.float32)
+    from bigdl_tpu.llm.transformers.st_reader import SafetensorsReader
+    get = SafetensorsReader(path).get
 
     L = cfg.num_hidden_layers
     nh, hd, h = cfg.num_attention_heads, cfg.head_dim, cfg.hidden_size

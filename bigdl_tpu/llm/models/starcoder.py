@@ -206,11 +206,8 @@ def load_hf_starcoder_safetensors(path: str,
     """HF GPTBigCodeForCausalLM checkpoint → our stacked layout. HF's
     ``attn.c_attn`` is a plain concat [q (h); k (kv); v (kv)] along the
     output dim (nn.Linear, NOT gpt2's transposed Conv1D)."""
-    import glob as _glob
     import json as _json
     import os as _os
-
-    from safetensors import safe_open
 
     from bigdl_tpu.llm.kernels import quantize_tpu
 
@@ -221,20 +218,9 @@ def load_hf_starcoder_safetensors(path: str,
             raw = _json.load(f)
         cfg = StarCoderConfig.from_hf(type("HFConfig", (), raw)())
 
-    key_map: Dict[str, str] = {}
-    for fname in sorted(_glob.glob(_os.path.join(path, "*.safetensors"))):
-        with safe_open(fname, framework="numpy") as f:
-            for k in f.keys():
-                key_map[k] = fname
-    handles: Dict[str, Any] = {}
-
-    def get(name):
-        if name not in key_map and "transformer." + name in key_map:
-            name = "transformer." + name
-        fname = key_map[name]
-        if fname not in handles:
-            handles[fname] = safe_open(fname, framework="numpy")
-        return np.asarray(handles[fname].get_tensor(name), np.float32)
+    from bigdl_tpu.llm.transformers.st_reader import SafetensorsReader
+    reader = SafetensorsReader(path)   # handles the optional
+    get = reader.get                   # "transformer." name prefix
 
     L = cfg.num_hidden_layers
     h = cfg.hidden_size

@@ -30,23 +30,6 @@ from bigdl_tpu.llm.models.llama import (
 # direct safetensors loading (no torch)
 # ---------------------------------------------------------------------------
 
-def _st_key_map(path: str) -> Dict[str, str]:
-    """HF tensor name -> containing safetensors file (handles both the
-    single-file and the sharded index.json layouts)."""
-    index = os.path.join(path, "model.safetensors.index.json")
-    if os.path.exists(index):
-        with open(index) as f:
-            weight_map = json.load(f)["weight_map"]
-        return {k: os.path.join(path, v) for k, v in weight_map.items()}
-    from safetensors import safe_open
-    out = {}
-    for fname in sorted(glob.glob(os.path.join(path, "*.safetensors"))):
-        with safe_open(fname, framework="numpy") as f:
-            for k in f.keys():
-                out[k] = fname
-    return out
-
-
 def _read_hf_config(path: str) -> LlamaConfig:
     """config.json → LlamaConfig (attribute-shim over the raw dict)."""
     with open(os.path.join(path, "config.json")) as f:
@@ -75,16 +58,10 @@ def load_hf_llama_safetensors(path: str, cfg: Optional[LlamaConfig] = None,
     dtype = dtype or jnp.bfloat16
     if cfg is None:
         cfg = _read_hf_config(path)
-    key_map = _st_key_map(path)
-    from safetensors import safe_open
-
-    handles: Dict[str, Any] = {}
-
-    def get(name: str) -> np.ndarray:
-        fname = key_map[name]
-        if fname not in handles:
-            handles[fname] = safe_open(fname, framework="numpy")
-        return handles[fname].get_tensor(name)
+    from bigdl_tpu.llm.transformers.st_reader import SafetensorsReader
+    reader = SafetensorsReader(path, prefix_fallbacks=("",))
+    key_map = reader.key_map
+    get = reader.get
 
     hf_linear = {
         "q_proj": "model.layers.{}.self_attn.q_proj.weight",

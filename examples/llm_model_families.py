@@ -1,0 +1,51 @@
+"""All five ggml model families generating under INT4 (ref: the
+reference ships per-family demos under P:llm/ggml/model/ — llama,
+gptneox, bloom, starcoder, chatglm; SURVEY.md §2.8 row 65). With a
+``model_path`` the family is dispatched from config.json's model_type
+through AutoModelForCausalLM; without one, demo-sized random weights
+exercise each architecture's distinct machinery (ALiBi, MQA, parallel
+residual, interleaved partial rotary)."""
+
+import numpy as np
+
+
+def main(smoke: bool = False, model_path: str = None):
+    if model_path:
+        from bigdl_tpu.llm.transformers import AutoModelForCausalLM
+        model = AutoModelForCausalLM.from_pretrained(model_path,
+                                                     load_in_4bit=True)
+        out = model.generate(np.array([[1, 2, 3, 4]], np.int32),
+                             max_new_tokens=8)
+        print(type(model).__name__, out[0].tolist())
+        return
+
+    import dataclasses
+    from bigdl_tpu.llm.models import (
+        BloomConfig, BloomForCausalLM, GptNeoXConfig, GptNeoXForCausalLM,
+        LlamaConfig, LlamaForCausalLM, StarCoderConfig,
+        StarCoderForCausalLM)
+
+    ids = np.array([[1, 2, 3, 4]], np.int32)
+    demos = [
+        ("llama", LlamaForCausalLM, LlamaConfig.tiny()),
+        ("chatglm/glm (interleaved partial rotary)", LlamaForCausalLM,
+         LlamaConfig.tiny_glm()),
+        ("gptneox (parallel residual)", GptNeoXForCausalLM,
+         GptNeoXConfig.tiny()),
+        ("bloom (ALiBi)", BloomForCausalLM,
+         dataclasses.replace(BloomConfig.tiny(), hidden_size=256,
+                             num_attention_heads=2)),
+        ("starcoder (MQA)", StarCoderForCausalLM,
+         dataclasses.replace(StarCoderConfig.tiny(), hidden_size=256,
+                             intermediate_size=256,
+                             num_attention_heads=2)),
+    ]
+    for name, cls, cfg in demos:
+        model = cls.from_config(cfg, seed=0, load_in_low_bit="sym_int4",
+                                max_cache_len=32)
+        out = model.generate(ids, max_new_tokens=4)
+        print(f"{name}: {out[0].tolist()}")
+
+
+if __name__ == "__main__":
+    main()

@@ -21,7 +21,7 @@ def _load(name):
 @pytest.mark.parametrize("name", [
     "lenet_mnist", "llama_int4_generate", "chronos_forecast",
     "fgboost_federated", "maskrcnn_inference", "orca_estimators",
-    "llm_http_worker", "automl_ray_pool"])
+    "llm_http_worker", "automl_ray_pool", "llm_model_families"])
 def test_example_smoke(name):
     mod = _load(name)
     mod.main(smoke=True)

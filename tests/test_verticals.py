@@ -161,6 +161,38 @@ class TestNano:
         best, name = InferenceOptimizer.get_best_model(report)
         assert best(x).shape == (2, 3)
 
+    def test_save_load_optimized_pipeline(self, tmp_path):
+        """Nano's deployable-artifact story (ref: P:nano
+        InferenceOptimizer.save/load): an optimized pipeline round-trips
+        through disk — module + quantization state + the serialized
+        compiled executable — and predicts identically."""
+        from bigdl_tpu.nano import InferenceOptimizer
+
+        model = _mlp(in_dim=32)
+        x = np.random.RandomState(0).rand(4, 32).astype(np.float32)
+        pipe = InferenceOptimizer.quantize(model, "bf16")
+        want = pipe(x)                     # traces; records example shape
+        path = str(tmp_path / "nano_art")
+        InferenceOptimizer.save(pipe, path)
+        loaded = InferenceOptimizer.load(path)
+        np.testing.assert_allclose(loaded(x), want, atol=1e-2)
+        # the AOT artifact is wired when EITHER artifact round-trips
+        import os
+        assert os.path.exists(path + "/nano_meta.json")
+        assert (loaded._aot is not None
+                or not (os.path.exists(path + "/compiled.xla")
+                        or os.path.exists(path + "/compiled.hlo")))
+        # shape/dtype outside the compiled signature fall back to the
+        # retracing jit path and must still be correct — and must NOT
+        # poison the AOT gate for subsequent matching calls
+        x8 = np.random.RandomState(1).rand(8, 32).astype(np.float32)
+        y8 = loaded(x8)
+        assert y8.shape == (8, 3)
+        np.testing.assert_allclose(loaded(x8), y8, atol=1e-6)
+        np.testing.assert_allclose(loaded(x), want, atol=1e-2)
+        xi = x.astype(np.float64)
+        assert loaded(xi).shape == (4, 3)   # dtype gate: jit fallback
+
     def test_trainer_fit(self):
         from bigdl_tpu.nano import Trainer
 
