@@ -505,20 +505,47 @@ def bench_llama_int4_decode(model_size: str = "7b", batch: int = 1,
     last = logits[:, -1]
     temp = jnp.float32(1.0)
 
-    def window(n, cache, last, key):
-        """One decode_scan window; returns wall time closed by host fetch."""
-        t0 = time.perf_counter()
-        toks, cache, last, key, _ = model._decode_scan(
-            model.params, cache, last, key, temp, num_tokens=n,
-            do_sample=True, top_k=0, eos_token_id=None)
-        int(np.asarray(toks)[0, -1])  # host fetch closes the window
-        return time.perf_counter() - t0, cache, last, key
+    if model.paged_decode:
+        # the product-default decode path (round 5): dense prefill
+        # bridged into the paged token loop — attention reads live
+        # pages, not the max_cache window
+        from bigdl_tpu.llm.models.llama import pageify_cache
+        kp, vp, bt = pageify_cache(cache, page=model.page_size)
+        state = [kp, vp, cache["pos"], last, key]
+        del cache
+
+        def window(n):
+            kp, vp, pos, last, key = state
+            t0 = time.perf_counter()
+            toks, kp, vp, pos, last, key, _ = model._decode_scan_paged(
+                model.params, kp, vp, bt, pos, last, key, temp,
+                page=model.page_size, num_tokens=n, do_sample=True,
+                top_k=0, eos_token_id=None)
+            int(np.asarray(toks)[0, -1])  # host fetch closes the window
+            state[:] = [kp, vp, pos, last, key]
+            return time.perf_counter() - t0
+
+        decode_mode = "paged_scan"
+    else:
+        state = [cache, last, key]
+
+        def window(n):
+            cache, last, key = state
+            t0 = time.perf_counter()
+            toks, cache, last, key, _ = model._decode_scan(
+                model.params, cache, last, key, temp, num_tokens=n,
+                do_sample=True, top_k=0, eos_token_id=None)
+            int(np.asarray(toks)[0, -1])
+            state[:] = [cache, last, key]
+            return time.perf_counter() - t0
+
+        decode_mode = "fused_scan"
 
     # compile both window sizes before timing
     for n in (n_small, decode_tokens):
-        _, cache, last, key = window(n, cache, last, key)
-    t_small, cache, last, key = window(n_small, cache, last, key)
-    t_big, cache, last, key = window(decode_tokens, cache, last, key)
+        window(n)
+    t_small = window(n_small)
+    t_big = window(decode_tokens)
 
     per_tok = (t_big - t_small) / (decode_tokens - n_small)
     if per_tok <= 0:  # noisy tenancy: fall back to the big-window mean
@@ -544,7 +571,7 @@ def bench_llama_int4_decode(model_size: str = "7b", batch: int = 1,
             "prefill_marginal_tokens_per_s": (round(marginal, 1)
                                               if marginal else None),
             "prefill_s": round(prefill_s, 3),
-            "decode_mode": "fused_scan",
+            "decode_mode": decode_mode,
             "matmuls_per_layer": 4,     # qkv, o, gate_up, down (fused)
             "layer_scan_unroll": 1,     # rolled scan measured fastest
             # measured in-context matmul-only floor on v5e: 28.6 ms/tok

@@ -167,6 +167,39 @@ class TestLlama:
         np.testing.assert_array_equal(out1, out2)
         np.testing.assert_array_equal(out1[:, :3], ids)
 
+    def test_paged_decode_matches_dense_decode(self):
+        """The round-5 default token loop (decode_scan_paged: dense
+        prefill pageified into a pool, attention over live pages only)
+        must be BIT-IDENTICAL to the dense ring-cache loop on greedy,
+        sampled (shared rng stream) and EOS-chunked paths — for plain,
+        GLM-rotary, sliding-window and MoE configs."""
+        import dataclasses
+        from bigdl_tpu.llm.models.llama import init_params
+        for cfg in (LlamaConfig.tiny(), LlamaConfig.tiny_glm(),
+                    dataclasses.replace(LlamaConfig.tiny(),
+                                        sliding_window=24),
+                    LlamaConfig.tiny_moe()):
+            params = init_params(cfg, seed=0)
+            dense = LlamaForCausalLM(cfg, params, max_cache_len=64,
+                                     paged_decode=False)
+            paged = LlamaForCausalLM(cfg, params, max_cache_len=64,
+                                     paged_decode=True)
+            ids = np.array([[3, 1, 4, 1, 5], [9, 2, 6, 5, 3]], np.int32)
+            np.testing.assert_array_equal(
+                dense.generate(ids, max_new_tokens=10),
+                paged.generate(ids, max_new_tokens=10))
+            np.testing.assert_array_equal(
+                dense.generate(ids, max_new_tokens=6, do_sample=True,
+                               top_k=5, seed=3),
+                paged.generate(ids, max_new_tokens=6, do_sample=True,
+                               top_k=5, seed=3))
+            eos = int(dense.generate(ids, max_new_tokens=4)[0, -1])
+            np.testing.assert_array_equal(
+                dense.generate(ids, max_new_tokens=12, eos_token_id=eos,
+                               decode_chunk=4),
+                paged.generate(ids, max_new_tokens=12, eos_token_id=eos,
+                               decode_chunk=4))
+
     def test_quantized_generate_close_to_dense(self):
         cfg = LlamaConfig.tiny()
         dense = LlamaForCausalLM.from_config(cfg, seed=0, max_cache_len=32)
