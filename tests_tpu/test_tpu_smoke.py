@@ -157,21 +157,70 @@ class TestPagedAttentionOnChip:
             bt, jnp.asarray(lens + 1)), np.float32)
         assert np.abs(got - want).max() < 0.05
 
+
+
+def _tiny_serving_model():
+    """Shared tiny-Llama serving fixture: (model, prompt ids, greedy
+    baseline) — one definition so every on-chip serving test pins the
+    SAME shape and baseline."""
+    import dataclasses
+    from bigdl_tpu.llm.models.llama import LlamaConfig, LlamaForCausalLM
+    cfg = dataclasses.replace(
+        LlamaConfig.tiny(), hidden_size=256, intermediate_size=512,
+        num_attention_heads=4, num_key_value_heads=2)
+    model = LlamaForCausalLM.from_config(cfg, seed=0, max_cache_len=64)
+    ids = np.array([3, 1, 4, 1, 5], np.int32)
+    want = model.generate(ids[None], max_new_tokens=6)[0, 5:]
+    return model, ids, want
+
+
+class TestPagedServingOnChip:
     def test_paged_server_greedy_parity_on_chip(self):
         """A paged LLMServer on hardware reproduces generate() exactly."""
-        import dataclasses
-        from bigdl_tpu.llm.models.llama import (LlamaConfig,
-                                                LlamaForCausalLM)
         from bigdl_tpu.llm.serving import LLMServer
-        cfg = dataclasses.replace(
-            LlamaConfig.tiny(), hidden_size=256, intermediate_size=512,
-            num_attention_heads=4, num_key_value_heads=2)
-        model = LlamaForCausalLM.from_config(cfg, seed=0, max_cache_len=64)
-        ids = np.array([3, 1, 4, 1, 5], np.int32)
-        want = model.generate(ids[None], max_new_tokens=6)[0, 5:]
+        model, ids, want = _tiny_serving_model()
         srv = LLMServer(model, max_batch=2, max_seq_len=32).start()
         try:
             got = srv.submit(ids, max_new_tokens=6).get(timeout=300)
         finally:
             srv.stop()
         np.testing.assert_array_equal(np.asarray(got), want)
+
+    def test_paged_server_parity_under_concurrent_load_on_chip(self):
+        """The r4 buffer-lifetime race scenario ON HARDWARE with the r5
+        scanned decode: 4 hammer threads of real device traffic while
+        fresh servers serve greedy requests — every result must match
+        generate() (r4's CPU repro was 14/30 mismatches pre-barrier;
+        this pins 0/N on the real runtime too)."""
+        import threading
+        import time
+        from bigdl_tpu.llm.serving import LLMServer
+        model, ids, want = _tiny_serving_model()
+        stop = threading.Event()
+
+        def hammer():
+            a = jax.random.normal(jax.random.PRNGKey(1), (256, 256))
+            f = jax.jit(lambda x: jnp.tanh(x @ x) + 1e-6)
+            while not stop.is_set():
+                a = f(a).block_until_ready()
+
+        threads = [threading.Thread(target=hammer, daemon=True)
+                   for _ in range(4)]
+        for t in threads:
+            t.start()
+        try:
+            for it in range(6):
+                srv = LLMServer(model, max_batch=2,
+                                max_seq_len=32).start()
+                try:
+                    time.sleep((it % 4) * 0.001)
+                    got = np.asarray(
+                        srv.submit(ids, max_new_tokens=6).get(300))
+                finally:
+                    srv.stop()
+                np.testing.assert_array_equal(got, want,
+                                              err_msg=f"iteration {it}")
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=10)
