@@ -262,6 +262,54 @@ def forward(params: Dict[str, Any], cfg: GptNeoXConfig,
         "k": k_new, "v": v_new, "pos": start + tokens.shape[1]}
 
 
+def paged_decode_step(params, cfg, k_pages, v_pages, bt, lens, toks,
+                      *, page: int):
+    """GPT-NeoX paged-KV decode step — the family's layer math (LN with
+    bias, biased linears, partial rotary, PARALLEL residual) in the
+    same structure as serving.paged_decode_step: rolled layer scan,
+    read-only pools (stats kernel + flash merge of the current token),
+    one post-scan scatter into the donated pools. Lets the paged
+    continuous-batching LLMServer serve the NeoX family."""
+    from bigdl_tpu.llm.serving import paged_attend, scatter_new_kv
+    b = toks.shape[0]
+    L = cfg.num_hidden_layers
+    nh, hd = cfg.num_attention_heads, cfg.head_dim
+    x = params["embed_in"][toks][:, None]                     # (B, 1, H)
+    positions = lens[:, None].astype(jnp.int32)
+    attend = paged_attend(k_pages, v_pages, bt, lens, page=page)
+
+    def layer_step(carry, inputs):
+        x, = carry
+        lp, l = inputs
+        h1 = _layer_norm(x, lp["input_layernorm"], cfg.layer_norm_eps)
+        q = _linear_b(lp["q_proj"], h1).reshape(b, 1, nh, hd)
+        k = _linear_b(lp["k_proj"], h1).reshape(b, 1, nh, hd)
+        v = _linear_b(lp["v_proj"], h1).reshape(b, 1, nh, hd)
+        q = _partial_rope(q, positions, cfg)
+        k = _partial_rope(k, positions, cfg)
+        attn = attend(l, q, k, v).astype(x.dtype)
+        attn = _linear_b(lp["o_proj"], attn.reshape(b, 1, -1))
+        h2_in = x if cfg.use_parallel_residual else x + attn
+        h2 = _layer_norm(h2_in, lp["post_attention_layernorm"],
+                         cfg.layer_norm_eps)
+        mlp = _linear_b(lp["fc_out"], jax.nn.gelu(
+            _linear_b(lp["fc_in"], h2).astype(jnp.float32),
+            approximate=False).astype(x.dtype))
+        if cfg.use_parallel_residual:
+            x = x + attn + mlp
+        else:
+            x = h2_in + mlp
+        return (x,), (k[:, 0], v[:, 0])
+
+    (x,), (k_new, v_new) = jax.lax.scan(
+        layer_step, (x,), (params["layers"], jnp.arange(L)))
+    x = _layer_norm(x, params["final_norm"], cfg.layer_norm_eps)
+    logits = _linear(params["embed_out"], x)
+    k_pages, v_pages = scatter_new_kv(k_pages, v_pages, bt, lens,
+                                      k_new, v_new, page=page)
+    return logits[:, 0].astype(jnp.float32), k_pages, v_pages
+
+
 class GptNeoXForCausalLM(CausalLMFacade):
     """Generation facade — shared driver (see models._facade)."""
 

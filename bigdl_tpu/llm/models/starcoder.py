@@ -186,6 +186,49 @@ def forward(params: Dict[str, Any], cfg: StarCoderConfig,
         "k": k_new, "v": v_new, "pos": start + tokens.shape[1]}
 
 
+def paged_decode_step(params, cfg, k_pages, v_pages, bt, lens, toks,
+                      *, page: int):
+    """StarCoder paged-KV decode step — learned position embeddings,
+    MQA (the paged stats kernel's GQA grouping handles Hkv=1), LN with
+    bias, sequential residual, tied head; same structure as
+    serving.paged_decode_step (rolled scan, read-only pools, one
+    post-scan scatter). Lets the paged LLMServer serve GPTBigCode."""
+    from bigdl_tpu.llm.serving import paged_attend, scatter_new_kv
+    b = toks.shape[0]
+    L = cfg.num_hidden_layers
+    nh, hd = cfg.num_attention_heads, cfg.head_dim
+    kvh = cfg.num_key_value_heads
+    positions = lens[:, None].astype(jnp.int32)
+    x = (params["wte"][toks][:, None]
+         + params["wpe"][positions].astype(params["wte"].dtype))
+    attend = paged_attend(k_pages, v_pages, bt, lens, page=page)
+
+    def layer_step(carry, inputs):
+        x, = carry
+        lp, l = inputs
+        h1 = _layer_norm(x, lp["input_layernorm"], cfg.layer_norm_epsilon)
+        q = _linear_b(lp["q_proj"], h1).reshape(b, 1, nh, hd)
+        k = _linear_b(lp["k_proj"], h1).reshape(b, 1, kvh, hd)
+        v = _linear_b(lp["v_proj"], h1).reshape(b, 1, kvh, hd)
+        attn = attend(l, q, k, v).astype(x.dtype)
+        x = x + _linear_b(lp["o_proj"], attn.reshape(b, 1, -1))
+        h2 = _layer_norm(x, lp["post_attention_layernorm"],
+                         cfg.layer_norm_epsilon)
+        mlp = _linear_b(lp["fc_out"], jax.nn.gelu(
+            _linear_b(lp["fc_in"], h2).astype(jnp.float32),
+            approximate=True).astype(x.dtype))
+        x = x + mlp
+        return (x,), (k[:, 0], v[:, 0])
+
+    (x,), (k_new, v_new) = jax.lax.scan(
+        layer_step, (x,), (params["layers"], jnp.arange(L)))
+    x = _layer_norm(x, params["ln_f"], cfg.layer_norm_epsilon)
+    logits = x @ params["wte"].T.astype(x.dtype)
+    k_pages, v_pages = scatter_new_kv(k_pages, v_pages, bt, lens,
+                                      k_new, v_new, page=page)
+    return logits[:, 0].astype(jnp.float32), k_pages, v_pages
+
+
 class StarCoderForCausalLM(CausalLMFacade):
     """Generation facade — shared driver (see models._facade)."""
 

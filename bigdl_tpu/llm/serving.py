@@ -60,6 +60,56 @@ def _sync_barrier(*arrays):
 _PAGED_STEP_CACHE: Dict[tuple, Any] = {}
 
 
+def paged_attend(k_pages, v_pages, bt, lens, *, page: int,
+                 sliding_window: Optional[int] = None):
+    """Shared paged-attention closure for every family's decode step.
+
+    Owns the divergence-prone conventions in ONE place (review r5):
+    the pools are viewed as one flat ``(L·P, H, page, D)`` page array
+    (a ``pool[l]`` slice would copy 2·pool_bytes/L per layer), block
+    tables are offset by ``l·P`` inside the layer scan (layer ``l``'s
+    trash page is ``l·P``), the kernel sees lengths EXCLUDING the
+    current token with the window shrunk by one, and the token's own
+    K/V is folded in with the flash combine. Returns
+    ``attend(l, q, k, v) -> (B, Hq, D)`` for head-shaped ``(B, 1, H*,
+    D)`` current-token projections."""
+    from bigdl_tpu.llm.kernels.paged_attention import (
+        merge_attention_partial, paged_attention_stats)
+    L_times_P = k_pages.shape[0] * k_pages.shape[1]
+    num_pages = k_pages.shape[1]
+    kp_flat = k_pages.reshape((L_times_P,) + k_pages.shape[2:])
+    vp_flat = v_pages.reshape((L_times_P,) + v_pages.shape[2:])
+    win_excl = (None if sliding_window is None
+                else max(sliding_window - 1, 0))
+
+    def attend(l, q, k, v):
+        acc, m, lsum = paged_attention_stats(
+            q[:, 0], kp_flat, vp_flat, bt + l * num_pages, lens,
+            page_size=page, sliding_window=win_excl)
+        return merge_attention_partial(acc, m, lsum, q[:, 0], k[:, 0],
+                                       v[:, 0])
+
+    return attend
+
+
+def scatter_new_kv(k_pages, v_pages, bt, lens, k_new, v_new, *,
+                   page: int):
+    """ONE vectorized scatter of every layer's new-token K/V into the
+    (donated) pools — shared by every family's decode step. ``k_new``/
+    ``v_new`` are the layer-scan ys ``(L, B, Hkv, D)``; pools are
+    ``(L, P, Hkv, page, D)`` (advanced indices on P/page with slices
+    between put the broadcast (B,) dim first)."""
+    b = lens.shape[0]
+    pidx = lens // page
+    slot = lens % page
+    phys = bt[jnp.arange(b), pidx]                            # (B,)
+    k_pages = k_pages.at[:, phys, :, slot].set(
+        k_new.transpose(1, 0, 2, 3).astype(k_pages.dtype))
+    v_pages = v_pages.at[:, phys, :, slot].set(
+        v_new.transpose(1, 0, 2, 3).astype(v_pages.dtype))
+    return k_pages, v_pages
+
+
 def paged_decode_step(params, cfg, k_pages, v_pages, bt, lens, toks,
                       *, page: int):
     """One paged-KV decode step: next-token logits for every row plus
@@ -90,23 +140,15 @@ def paged_decode_step(params, cfg, k_pages, v_pages, bt, lens, toks,
     ``(logits (B, V) f32, k_pages, v_pages)``. Callers jit this with
     ``donate_argnums`` on the pools.
     """
-    from bigdl_tpu.llm.kernels.paged_attention import (
-        merge_attention_partial, paged_attention_stats)
     from bigdl_tpu.llm.models.llama import (_linear, _moe_ffn,
                                             attention_qkv, mlp, rms_norm,
                                             rope_cfg)
     b = toks.shape[0]
     L = cfg.num_hidden_layers
-    num_pages = k_pages.shape[1]
-    kp_flat = k_pages.reshape((L * num_pages,) + k_pages.shape[2:])
-    vp_flat = v_pages.reshape((L * num_pages,) + v_pages.shape[2:])
     x = params["embed_tokens"][toks][:, None]                 # (B, 1, H)
     positions = lens[:, None].astype(jnp.int32)
-    # the kernel sees lengths EXCLUDING the current token; shrinking the
-    # window by one keeps the union's window semantics exact (the self
-    # token, always in-window, arrives via the merge)
-    win = cfg.sliding_window
-    win_excl = None if win is None else max(win - 1, 0)
+    attend = paged_attend(k_pages, v_pages, bt, lens, page=page,
+                          sliding_window=cfg.sliding_window)
 
     def layer_step(carry, inputs):
         x, = carry
@@ -115,11 +157,7 @@ def paged_decode_step(params, cfg, k_pages, v_pages, bt, lens, toks,
         q, k, v = attention_qkv(lp, h, cfg)
         q = rope_cfg(q, positions, cfg)
         k = rope_cfg(k, positions, cfg)
-        acc, m, lsum = paged_attention_stats(
-            q[:, 0], kp_flat, vp_flat, bt + l * num_pages, lens,
-            page_size=page, sliding_window=win_excl)
-        attn = merge_attention_partial(acc, m, lsum, q[:, 0], k[:, 0],
-                                       v[:, 0]).astype(x.dtype)
+        attn = attend(l, q, k, v).astype(x.dtype)
         x = x + _linear(lp["o_proj"], attn.reshape(b, 1, -1))
         h2 = rms_norm(x, lp["post_attention_layernorm"], cfg.rms_norm_eps)
         if cfg.num_experts:
@@ -136,15 +174,8 @@ def paged_decode_step(params, cfg, k_pages, v_pages, bt, lens, toks,
         logits = x @ params["embed_tokens"].T.astype(x.dtype)
     else:
         logits = _linear(head, x)
-    # one scatter for all layers: pools (L, P, H, page, D), advanced
-    # indices on P/page (slices between) put the broadcast (B,) first
-    pidx = lens // page
-    slot = lens % page
-    phys = bt[jnp.arange(b), pidx]                            # (B,)
-    k_pages = k_pages.at[:, phys, :, slot].set(
-        k_new.transpose(1, 0, 2, 3).astype(k_pages.dtype))
-    v_pages = v_pages.at[:, phys, :, slot].set(
-        v_new.transpose(1, 0, 2, 3).astype(v_pages.dtype))
+    k_pages, v_pages = scatter_new_kv(k_pages, v_pages, bt, lens,
+                                      k_new, v_new, page=page)
     return logits[:, 0].astype(jnp.float32), k_pages, v_pages
 
 
@@ -194,10 +225,39 @@ class LLMServer:
     def __init__(self, model, max_batch: int = 4, max_seq_len: int = 256,
                  eos_token_id: Optional[int] = None, paged: bool = True,
                  page_size: int = 16, num_pages: Optional[int] = None):
+        import inspect
+
         from bigdl_tpu.llm.models.llama import forward, init_cache
 
         self.model = model
         self.cfg = model.config
+        # family dispatch: Llama-stack models (incl. Mistral/Qwen2/GLM/
+        # MoE) use the llama functions; CausalLMFacade families expose
+        # _forward/_init_cache and their module's paged_decode_step
+        # (gptneox, starcoder — bloom's ALiBi has no paged kernel hook
+        # yet, so it stays generate()-only)
+        fam_forward = getattr(type(model), "_forward", None)
+        if fam_forward is None:
+            self._fam_forward, self._fam_init_cache = forward, init_cache
+            self._fam_paged_step = paged_decode_step
+            self._family = "llama"
+        else:
+            self._fam_forward = fam_forward
+            self._fam_init_cache = type(model)._init_cache
+            fam_mod = inspect.getmodule(fam_forward)
+            self._fam_paged_step = getattr(fam_mod, "paged_decode_step",
+                                           None)
+            self._family = fam_mod.__name__.rsplit(".", 1)[-1]
+            if paged and self._fam_paged_step is None:
+                raise NotImplementedError(
+                    f"{type(model).__name__} has no paged decode step "
+                    "(ALiBi needs a kernel bias hook); use "
+                    "generate() or another family")
+            if not paged:
+                raise NotImplementedError(
+                    "the slot-static (paged=False) engine is Llama-stack "
+                    "only; non-llama families serve through the paged "
+                    "path")
         self.max_batch = max_batch
         self.max_seq_len = (min(max_seq_len, model.max_cache_len)
                             if not paged else
@@ -212,7 +272,8 @@ class LLMServer:
                                jnp.float32)
         self._stop = threading.Event()
         self._lock = threading.Lock()
-        self._fwd = jax.jit(functools.partial(forward, cfg=self.cfg))
+        self._fwd = jax.jit(functools.partial(self._fam_forward,
+                                              cfg=self.cfg))
         self._thread: Optional[threading.Thread] = None
         self.steps = 0
 
@@ -359,7 +420,7 @@ class LLMServer:
         and the closures bake every cfg field, the page size and the
         cache dtype — so all of them key the entry."""
         import dataclasses
-        return (dataclasses.astuple(self.cfg), self._page,
+        return (self._family, dataclasses.astuple(self.cfg), self._page,
                 str(jnp.dtype(self.model.cache_dtype)))
 
     def _build_paged_prefill(self, bucket: int):
@@ -368,21 +429,22 @@ class LLMServer:
         exactly ``bucket`` tokens (small, request-local), then scatter
         the resulting K/V into the page pool at this request's physical
         pages. Pad pages beyond ceil(len/page) land in trash page 0."""
-        from bigdl_tpu.llm.models.llama import forward, init_cache
         cfg = self.cfg
         page = self._page
         hkv, hd = cfg.num_key_value_heads, cfg.head_dim
         nl = cfg.num_hidden_layers
 
         cache_dtype = self.model.cache_dtype
+        fam_forward, fam_init_cache = self._fam_forward, self._fam_init_cache
 
         def build(params, k_pages, v_pages, toks, length, page_ids):
             # the temp cache must match the pool dtype: a bf16 default
             # would round f32-cache models' prompt KV before it reaches
             # the f32 pool, diverging served tokens from generate()
-            cache = init_cache(cfg, 1, bucket, dtype=cache_dtype)
+            cache = fam_init_cache(cfg, 1, bucket, dtype=cache_dtype)
             positions = jnp.arange(bucket)[None, :]
-            logits, cache2 = forward(params, cfg, toks, cache, positions)
+            logits, cache2 = fam_forward(params, cfg, toks, cache,
+                                         positions)
             ks, vs = cache2["k"][:, 0], cache2["v"][:, 0]  # (L,bucket,H,D)
 
             def pageify(a):
@@ -428,14 +490,15 @@ class LLMServer:
         self._remaining[i] = req.max_new_tokens
 
     def _build_paged_decode(self):
-        """One decode step over the page pool — the shared
-        :func:`paged_decode_step` jitted with donated pools."""
+        """One decode step over the page pool — the family's
+        ``paged_decode_step`` jitted with donated pools."""
         cfg = self.cfg
         page = self._page
+        fam_step = self._fam_paged_step
 
         def step(params, k_pages, v_pages, bt, lens, toks):
-            return paged_decode_step(params, cfg, k_pages, v_pages, bt,
-                                     lens, toks[:, 0], page=page)
+            return fam_step(params, cfg, k_pages, v_pages, bt,
+                            lens, toks[:, 0], page=page)
 
         return jax.jit(step, donate_argnums=(1, 2))
 

@@ -135,6 +135,55 @@ class TestStarCoder:
         assert out.shape == (1, 9)
 
 
+class TestFamilyServing:
+    """Round-5 tail: the paged continuous-batching LLMServer dispatches
+    per family — GPT-NeoX and StarCoder get their own paged decode
+    steps (same read-only-pool scan structure); Bloom is rejected with
+    a clear error (ALiBi has no paged-kernel bias hook yet)."""
+
+    @pytest.mark.parametrize("family", ["gptneox", "gptneox-seq",
+                                        "starcoder"])
+    def test_paged_server_greedy_parity(self, family):
+        import dataclasses
+        from bigdl_tpu.llm.serving import LLMServer
+        if family.startswith("gptneox"):
+            from bigdl_tpu.llm.models import (GptNeoXConfig as C,
+                                              GptNeoXForCausalLM as M)
+            cfg = C.tiny()
+            if family == "gptneox-seq":
+                # sequential-residual NeoX (early StableLM lineage):
+                # pins the use_parallel_residual=False paged branch
+                cfg = dataclasses.replace(cfg,
+                                          use_parallel_residual=False)
+        else:
+            from bigdl_tpu.llm.models import (StarCoderConfig as C,
+                                              StarCoderForCausalLM as M)
+            cfg = C.tiny()
+        model = M.from_config(cfg, seed=0, max_cache_len=64)
+        prompt = [7, 3, 11, 2]
+        want = model.generate(np.asarray([prompt], np.int32),
+                              max_new_tokens=8)[0, len(prompt):]
+        srv = LLMServer(model, max_batch=2, max_seq_len=32).start()
+        try:
+            got = srv.submit(prompt, max_new_tokens=8).get(180)
+            # a second, different-length request through the same server
+            got2 = srv.submit([5, 9], max_new_tokens=4).get(180)
+        finally:
+            srv.stop()
+        assert list(got) == list(map(int, want))
+        want2 = model.generate(np.asarray([[5, 9]], np.int32),
+                               max_new_tokens=4)[0, 2:]
+        assert list(got2) == list(map(int, want2))
+
+    def test_bloom_serving_rejected_with_clear_error(self):
+        from bigdl_tpu.llm.models import BloomConfig, BloomForCausalLM
+        from bigdl_tpu.llm.serving import LLMServer
+        model = BloomForCausalLM.from_config(BloomConfig.tiny(), seed=0,
+                                             max_cache_len=32)
+        with pytest.raises(NotImplementedError, match="paged decode"):
+            LLMServer(model)
+
+
 class TestChatGLM:
     def test_matches_hf_glm_numerics(self, tmp_path):
         """GLM-4 (HF ``glm``) is the transformers-native ChatGLM lineage:
