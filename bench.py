@@ -841,24 +841,47 @@ def _compact_northstar(out: dict) -> dict:
                               "on the line above"}}
 
 
+def _telemetry_block() -> dict:
+    """Snapshot of the observability registry + span distributions after
+    the benches ran (the convergence benches drive the instrumented
+    BaseOptimizer loop, so step-time histograms and loss/grad-norm
+    gauges land here; see tools/telemetry_report.py)."""
+    from bigdl_tpu import observability as obs
+    from tools.telemetry_report import (summarize_registry,
+                                        summarize_trace)
+    return {
+        "metrics": summarize_registry(),
+        "spans": summarize_trace(
+            {"traceEvents": obs.TRACE.spans()})["spans"],
+    }
+
+
 def _default_run(quick: bool) -> dict:
     """The driver-captured output: resnet headline + llama decode +
     kernel micro-bench folded into one JSON object."""
+    from bigdl_tpu import observability as obs
     if quick:
-        out = bench_resnet50_train(batch_size=4, warmup=1, iters=5,
-                                   image=64, depth=18, classes=100,
-                                   smoke=True, format="NCHW",
-                                   remat=False)
+        with obs.span("bench/resnet"):
+            out = bench_resnet50_train(batch_size=4, warmup=1, iters=5,
+                                       image=64, depth=18, classes=100,
+                                       smoke=True, format="NCHW",
+                                       remat=False)
         try:
-            out["extra"]["llama_int4_decode"] = bench_llama_int4_decode(
-                model_size="tiny", smoke=True)
+            with obs.span("bench/llama_int4_decode"):
+                out["extra"]["llama_int4_decode"] = \
+                    bench_llama_int4_decode(model_size="tiny", smoke=True)
         except Exception as e:  # never lose the headline to a side metric
             out["extra"]["llama_int4_decode"] = {"error": repr(e)}
         try:
-            out["extra"]["paged_decode"] = bench_paged_decode_step(
-                model_size="tiny", batch=2, ctx_len=32)
+            with obs.span("bench/paged_decode"):
+                out["extra"]["paged_decode"] = bench_paged_decode_step(
+                    model_size="tiny", batch=2, ctx_len=32)
         except Exception as e:
             out["extra"]["paged_decode"] = {"error": repr(e)}
+        try:
+            out["extra"]["telemetry"] = _telemetry_block()
+        except Exception as e:
+            out["extra"]["telemetry"] = {"error": repr(e)}
         return out
     out = bench_resnet50_train()
     try:
@@ -894,6 +917,10 @@ def _default_run(quick: bool) -> dict:
         out["extra"]["cifar_convergence"] = bench_cifar_convergence()
     except Exception as e:
         out["extra"]["cifar_convergence"] = {"error": repr(e)}
+    try:
+        out["extra"]["telemetry"] = _telemetry_block()
+    except Exception as e:
+        out["extra"]["telemetry"] = {"error": repr(e)}
     return out
 
 

@@ -37,6 +37,41 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from bigdl_tpu import observability as obs
+
+
+def _llm_instruments():
+    """Engine metrics (declared only when observability is on): the
+    per-phase signals the Ragged-Paged-Attention line of work says you
+    need to diagnose serving — prefill vs decode throughput and KV-pool
+    occupancy, not end-of-run aggregates."""
+    return {
+        "prefill_tokens": obs.counter(
+            "bigdl_llm_prefill_tokens_total",
+            "Prompt tokens prefilled into the KV cache"),
+        "prefill_seconds": obs.histogram(
+            "bigdl_llm_prefill_seconds",
+            "Wall time of one request prefill (compile excluded after "
+            "first hit per length bucket)"),
+        "decode_tokens": obs.counter(
+            "bigdl_llm_decode_tokens_total",
+            "Tokens decoded across all slots"),
+        "decode_seconds": obs.histogram(
+            "bigdl_llm_decode_step_seconds",
+            "Wall time of one engine decode step (all active slots)"),
+        "requests": obs.counter(
+            "bigdl_llm_requests_total",
+            "Requests finished by the engine", labelnames=("reason",)),
+        "active": obs.gauge(
+            "bigdl_llm_active_slots", "Slots currently decoding"),
+        "kv_pages": obs.gauge(
+            "bigdl_llm_kv_pages_in_use",
+            "Physical KV pages owned by live requests"),
+        "kv_occupancy": obs.gauge(
+            "bigdl_llm_kv_pool_occupancy",
+            "Fraction of the KV page pool in use (0..1)"),
+    }
+
 
 def _sync_barrier(*arrays):
     """Bound the in-flight computations producing ``arrays``.
@@ -276,6 +311,7 @@ class LLMServer:
                                               cfg=self.cfg))
         self._thread: Optional[threading.Thread] = None
         self.steps = 0
+        self._ins = None     # declared lazily: see _instruments()
 
         if paged:
             from bigdl_tpu.llm.kernels.paged_attention import LANE
@@ -368,9 +404,37 @@ class LLMServer:
                     return
                 self._budget_avail -= budget
                 self._slot_budget[i] = budget
-                self._prefill_paged(i, req)
-            else:
-                self._prefill_slot(i, req)
+            t0 = time.perf_counter()
+            with obs.span("llm/prefill", slot=i,
+                          tokens=len(req.prompt_ids)):
+                (self._prefill_paged if self.paged
+                 else self._prefill_slot)(i, req)
+            self._record_prefill(len(req.prompt_ids),
+                                 time.perf_counter() - t0)
+
+    def _instruments(self):
+        """None when observability is off; declared on first use so
+        ``obs.enable()`` starts recording on a LIVE server (the runtime-
+        override contract), and a disabled run declares nothing."""
+        if not obs.enabled():
+            return None
+        if self._ins is None:
+            self._ins = _llm_instruments()
+        return self._ins
+
+    def _record_kv_gauges(self, ins):
+        if self.paged:
+            ins["kv_pages"].set(self.pages_in_use)
+            # page 0 is the reserved trash page, never allocatable
+            ins["kv_occupancy"].set(
+                self.pages_in_use / max(self._num_pages - 1, 1))
+
+    def _record_prefill(self, n_tokens: int, seconds: float):
+        ins = self._instruments()
+        if ins is not None:
+            ins["prefill_tokens"].inc(n_tokens)
+            ins["prefill_seconds"].observe(seconds)
+            self._record_kv_gauges(ins)
 
     def _prefill_slot(self, i: int, req: Request):
         """Run the prompt through the model writing kv at slot i only.
@@ -502,10 +566,29 @@ class LLMServer:
 
         return jax.jit(step, donate_argnums=(1, 2))
 
+    def _record_decode(self, n_active: int, seconds: float,
+                       finished: int):
+        ins = self._instruments()
+        if ins is None:
+            return
+        ins["decode_tokens"].inc(n_active)
+        ins["decode_seconds"].observe(seconds)
+        # the duration is already measured, so the span is appended
+        # directly rather than re-bracketing the step with a context
+        # manager
+        obs.tracing.add_complete(
+            "llm/decode_step", time.time() - seconds, seconds,
+            active=n_active, step=self.steps)
+        ins["active"].set(n_active - finished)
+        if finished:
+            ins["requests"].labels(reason="done").inc(finished)
+        self._record_kv_gauges(ins)
+
     def _step_paged(self) -> bool:
         active = [i for i, r in enumerate(self._slots) if r is not None]
         if not active:
             return False
+        t_step = time.perf_counter()
         page = self._page
         # the page for position lens[i] must exist before the step
         for i in active:
@@ -544,6 +627,9 @@ class LLMServer:
                 self._lens[i] = 0     # a stale id could alias a reissued
                 # page and the inactive row's dummy write would clobber it
         self.steps += 1
+        self._record_decode(
+            len(active), time.perf_counter() - t_step,
+            finished=sum(1 for i in active if self._slots[i] is None))
         return True
 
     def _step(self):
@@ -553,6 +639,7 @@ class LLMServer:
         active = [i for i, r in enumerate(self._slots) if r is not None]
         if not active:
             return False
+        t_step = time.perf_counter()
         nxt = np.asarray(jnp.argmax(self._last, axis=-1), np.int32)
         toks = jnp.asarray(nxt[:, None])
         positions = jnp.asarray(self._pos[:, None])
@@ -577,6 +664,9 @@ class LLMServer:
                 self._pos[i] = 0
         self._last = logits
         self.steps += 1
+        self._record_decode(
+            len(active), time.perf_counter() - t_step,
+            finished=sum(1 for i in active if self._slots[i] is None))
         return True
 
     def _decode_scatter(self, toks, positions):

@@ -67,6 +67,16 @@ class LLMWorker:
                         "queue_length": worker.server._queue.qsize(),
                         "steps": worker.server.steps,
                         "speed": round(worker._tokens_out / dt, 2)})
+                elif self.path == "/metrics":
+                    # same Prometheus surface as the cluster-serving
+                    # frontend: prefill/decode tokens, KV occupancy, …
+                    from bigdl_tpu import observability as obs
+                    body = obs.render().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", obs.CONTENT_TYPE)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
                 else:
                     self._json(404, {"error": "unknown path"})
 

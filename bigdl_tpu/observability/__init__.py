@@ -1,0 +1,85 @@
+"""Unified telemetry for bigdl_tpu (ISSUE 1 tentpole).
+
+One process-wide surface tying training throughput, serving latency and
+LLM decode performance together:
+
+- :mod:`~bigdl_tpu.observability.metrics` — thread-safe Counter / Gauge /
+  Histogram registry + Prometheus text exposition (``render()``; served
+  by the HTTP front-ends at ``GET /metrics``);
+- :mod:`~bigdl_tpu.observability.tracing` — ``with span("train/step",
+  step=i):`` nestable trace spans → ring buffer → Chrome-trace/Perfetto
+  JSON (``export_chrome_trace``), with optional passthrough to
+  ``jax.profiler`` annotations;
+- instrumentation hooks live in the hot paths themselves (optimizer
+  loop, serving front-ends, LLM engine, collectives) and all write here.
+
+Naming convention: every metric is prefixed ``bigdl_`` (see
+docs/OBSERVABILITY.md for the catalog). Overhead contract: everything is
+host-side python over clocks the loops already read; the
+``bigdl.observability.enabled`` config key (env
+``BIGDL_TPU_OBSERVABILITY_ENABLED``) or :func:`disable` turns every
+mutator and ``span`` into a no-op that records nothing.
+"""
+
+from __future__ import annotations
+
+from bigdl_tpu.observability import _state
+from bigdl_tpu.observability.metrics import (
+    CONTENT_TYPE, Counter, DEFAULT_BUCKETS, Gauge, Histogram,
+    MetricRegistry, parse_prometheus, render_prometheus)
+from bigdl_tpu.observability import tracing
+from bigdl_tpu.observability.tracing import (
+    TRACE, TraceBuffer, add_complete, configure, export_chrome_trace,
+    span)
+
+#: The process-global registry every built-in hook writes to.
+REGISTRY = MetricRegistry()
+
+
+def enabled() -> bool:
+    return _state.enabled
+
+
+def enable():
+    _state.enabled = True
+
+
+def disable():
+    """No-op mode: every inc/set/observe/span becomes a cheap early
+    return; nothing is recorded anywhere."""
+    _state.enabled = False
+
+
+def counter(name: str, help: str = "", labelnames=()):
+    return REGISTRY.counter(name, help, labelnames)
+
+
+def gauge(name: str, help: str = "", labelnames=()):
+    return REGISTRY.gauge(name, help, labelnames)
+
+
+def histogram(name: str, help: str = "", labelnames=(),
+              buckets=DEFAULT_BUCKETS):
+    return REGISTRY.histogram(name, help, labelnames, buckets)
+
+
+def render() -> str:
+    """Prometheus text exposition of the global registry."""
+    return render_prometheus(REGISTRY)
+
+
+def reset():
+    """Clear the global registry AND the trace ring. Test isolation
+    only: instruments held by live modules detach from the registry."""
+    REGISTRY.clear()
+    TRACE.clear()
+
+
+__all__ = [
+    "CONTENT_TYPE", "Counter", "Gauge", "Histogram", "MetricRegistry",
+    "REGISTRY",
+    "TRACE", "TraceBuffer", "DEFAULT_BUCKETS", "add_complete",
+    "configure", "counter", "disable", "enable", "enabled",
+    "export_chrome_trace", "gauge", "histogram", "parse_prometheus",
+    "render", "render_prometheus", "reset", "span", "tracing",
+]

@@ -1,0 +1,211 @@
+"""Trace spans → in-memory ring buffer → Chrome-trace/Perfetto JSON.
+
+``with span("train/step", step=i):`` brackets a host-side phase; completed
+spans land in a fixed-capacity ring buffer (old entries fall off — a
+long-running server never grows without bound) and can be exported as
+Chrome trace-event JSON (``chrome://tracing`` / https://ui.perfetto.dev
+both load it directly).
+
+Span records are "X" (complete) events: name, ``ts``/``dur`` in
+microseconds, ``pid``/``tid``, free-form ``args``. Nesting is tracked per
+thread with a thread-local stack — the exported depth is what the trace
+viewers use to stack the flame graph, and ``parent`` in args keeps the
+relationship greppable in the raw JSON.
+
+Optional JAX profiler passthrough: ``configure(jax_passthrough=True)``
+additionally enters ``jax.profiler.StepTraceAnnotation`` for spans that
+carry a ``step`` arg and ``jax.profiler.TraceAnnotation`` otherwise, so
+the same ``span(...)`` sites label XLA's own device profile when one is
+being captured. Off by default (it is not free) and silently skipped
+when the profiler is unavailable.
+
+Disabled mode (:func:`bigdl_tpu.observability.enabled` False): ``span``
+yields immediately — no clock reads, no buffer writes, no allocations
+beyond its own generator frame.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any, Dict, Iterator, List, Optional
+
+from bigdl_tpu.observability import _state
+
+
+def _default_capacity() -> int:
+    try:
+        from bigdl_tpu.utils.conf import conf
+        return conf.get_int("bigdl.observability.trace.capacity", 65536)
+    except Exception:
+        return 65536
+
+
+class TraceBuffer:
+    """Fixed-capacity ring of completed span records (dicts in
+    trace-event form). Thread-safe; ``capacity`` bounds host memory."""
+
+    def __init__(self, capacity: Optional[int] = None):
+        self.capacity = capacity if capacity is not None \
+            else _default_capacity()
+        self._lock = threading.Lock()
+        self._buf: List[Dict[str, Any]] = []
+        self._head = 0          # insertion point once the ring is full
+        self.dropped = 0
+
+    def append(self, rec: Dict[str, Any]):
+        with self._lock:
+            if self.capacity <= 0:     # capacity 0 = tracing off
+                self.dropped += 1
+                return
+            if len(self._buf) < self.capacity:
+                self._buf.append(rec)
+            else:
+                self._buf[self._head] = rec
+                self._head = (self._head + 1) % self.capacity
+                self.dropped += 1
+
+    def spans(self) -> List[Dict[str, Any]]:
+        """Records in arrival order."""
+        with self._lock:
+            return self._buf[self._head:] + self._buf[:self._head]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._buf)
+
+    def clear(self):
+        with self._lock:
+            self._buf = []
+            self._head = 0
+            self.dropped = 0
+
+    def set_capacity(self, capacity: int):
+        """Resize in place (the module-level ``TRACE`` is imported by
+        value all over; rebinding it would strand those references).
+        Keeps the newest ``capacity`` spans."""
+        with self._lock:
+            ordered = self._buf[self._head:] + self._buf[:self._head]
+            self.capacity = int(capacity)
+            self._buf = ordered[-self.capacity:] if self.capacity > 0 \
+                else []
+            self._head = 0
+
+    def export_chrome_trace(self, path: Optional[str] = None) -> str:
+        """Chrome trace-event JSON. Returns the JSON string; writes it to
+        ``path`` when given (parent dirs created)."""
+        doc = {"traceEvents": self.spans(), "displayTimeUnit": "ms"}
+        text = json.dumps(doc)
+        if path:
+            d = os.path.dirname(os.path.abspath(path))
+            os.makedirs(d, exist_ok=True)
+            with open(path, "w") as f:
+                f.write(text)
+        return text
+
+
+TRACE = TraceBuffer()
+
+_tls = threading.local()
+_jax_passthrough = False
+
+
+def configure(jax_passthrough: Optional[bool] = None,
+              capacity: Optional[int] = None):
+    """Adjust tracing runtime knobs. ``capacity`` resizes the ring
+    buffer in place (newest spans kept)."""
+    global _jax_passthrough
+    if jax_passthrough is not None:
+        _jax_passthrough = bool(jax_passthrough)
+    if capacity is not None:
+        TRACE.set_capacity(capacity)
+
+
+def _stack() -> List[str]:
+    s = getattr(_tls, "stack", None)
+    if s is None:
+        s = _tls.stack = []
+    return s
+
+
+def _jax_annotation(name: str, args: Dict[str, Any]):
+    try:
+        from jax import profiler as jprof
+        if "step" in args and hasattr(jprof, "StepTraceAnnotation"):
+            return jprof.StepTraceAnnotation(name,
+                                             step_num=int(args["step"]))
+        if hasattr(jprof, "TraceAnnotation"):
+            return jprof.TraceAnnotation(name)
+    except Exception:
+        pass
+    return None
+
+
+@contextmanager
+def span(name: str, **args: Any) -> Iterator[None]:
+    """Record a host-side phase. Nestable; thread-aware; a no-op when
+    observability is disabled."""
+    if not _state.enabled:
+        yield
+        return
+    stack = _stack()
+    parent = stack[-1] if stack else None
+    stack.append(name)
+    ann = _jax_annotation(name, args) if _jax_passthrough else None
+    if ann is not None:
+        try:
+            ann.__enter__()
+        except Exception:
+            # a profiler-state hiccup must not crash the instrumented
+            # loop or leak the stack entry we just pushed
+            ann = None
+    t0 = time.perf_counter()
+    wall0 = time.time()
+    try:
+        yield
+    finally:
+        dur = time.perf_counter() - t0
+        if ann is not None:
+            try:
+                ann.__exit__(None, None, None)
+            except Exception:
+                pass
+        stack.pop()
+        rec_args = {k: v for k, v in args.items()}
+        if parent is not None:
+            rec_args["parent"] = parent
+        TRACE.append({
+            "name": name,
+            "ph": "X",
+            "ts": wall0 * 1e6,            # trace-event ts is microseconds
+            "dur": dur * 1e6,
+            "pid": os.getpid(),
+            "tid": threading.get_ident(),
+            "args": rec_args,
+        })
+
+
+def add_complete(name: str, start_wall: float, dur_s: float,
+                 **args: Any):
+    """Record an already-measured phase as a complete ("X") event — for
+    call sites that timed the work themselves and must not re-bracket it
+    (owns the record schema so hand-built dicts don't drift from
+    ``span``'s). ``start_wall`` is epoch seconds; no-op when disabled."""
+    if not _state.enabled:
+        return
+    TRACE.append({
+        "name": name,
+        "ph": "X",
+        "ts": start_wall * 1e6,
+        "dur": dur_s * 1e6,
+        "pid": os.getpid(),
+        "tid": threading.get_ident(),
+        "args": dict(args),
+    })
+
+
+def export_chrome_trace(path: Optional[str] = None) -> str:
+    return TRACE.export_chrome_trace(path)

@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from bigdl_tpu import observability as obs
 from bigdl_tpu.feature.dataset import (
     AbstractDataSet, LocalDataSet, MiniBatch, SampleToMiniBatch)
 from bigdl_tpu.nn.module import Criterion, Module
@@ -38,6 +39,45 @@ from bigdl_tpu.optim.validation import ValidationMethod
 from bigdl_tpu.utils.engine import Engine
 
 logger = logging.getLogger("bigdl_tpu.optim")
+
+
+def _grad_norm(grads):
+    return jnp.sqrt(sum(
+        jnp.sum(g.astype(jnp.float32) ** 2)
+        for g in jax.tree_util.tree_leaves(grads)))
+
+
+def _train_instruments():
+    """Declare (or fetch) the training metrics — called only when
+    observability is enabled, so disabled runs leave the registry
+    untouched."""
+    return {
+        "step": obs.histogram(
+            "bigdl_train_step_seconds",
+            "Wall time of one optimizer iteration (data wait + step "
+            "dispatch; the loop is pipelined, so this bounds dispatch, "
+            "not device occupancy)"),
+        "data_wait": obs.counter(
+            "bigdl_train_data_wait_seconds_total",
+            "Cumulative host time spent staging input batches"),
+        "compute": obs.counter(
+            "bigdl_train_compute_seconds_total",
+            "Cumulative host time spent dispatching the compiled step"),
+        "examples": obs.counter(
+            "bigdl_train_examples_total",
+            "Training examples consumed"),
+        "steps": obs.counter(
+            "bigdl_train_steps_total", "Optimizer steps taken"),
+        "loss": obs.gauge("bigdl_train_loss", "Last drained train loss"),
+        "lr": obs.gauge("bigdl_train_learning_rate",
+                        "Learning rate at the last drained step"),
+        "grad_norm": obs.gauge(
+            "bigdl_train_grad_norm",
+            "Global gradient L2 norm at the last drained step"),
+        "throughput": obs.gauge(
+            "bigdl_train_throughput_examples_per_sec",
+            "Throughput of the last completed epoch"),
+    }
 
 
 def _to_device(tree, sharding=None):
@@ -155,6 +195,9 @@ class BaseOptimizer:
     def _build_step(self):
         model, criterion, optim = self.model, self.criterion, self.optim_method
         clip_l2, clip_const = self._clip_l2, self._clip_const
+        # telemetry gate is baked at compile time: a disabled run's step
+        # computes nothing extra and returns an empty telemetry pytree
+        want_gnorm = self._step_obs_gate = obs.enabled()
 
         def train_step(params, states, opt_state, x, t, lr, rng):
             def loss_fn(p):
@@ -163,18 +206,17 @@ class BaseOptimizer:
 
             (loss, new_states), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params)
+            tele = {"grad_norm": _grad_norm(grads)} if want_gnorm else {}
             if clip_const is not None:
                 lo, hi = clip_const
                 grads = jax.tree_util.tree_map(
                     lambda g: jnp.clip(g, lo, hi), grads)
             if clip_l2 is not None:
-                gnorm = jnp.sqrt(sum(
-                    jnp.sum(g.astype(jnp.float32) ** 2)
-                    for g in jax.tree_util.tree_leaves(grads)))
+                gnorm = _grad_norm(grads)
                 scale = jnp.minimum(1.0, clip_l2 / (gnorm + 1e-12))
                 grads = jax.tree_util.tree_map(lambda g: g * scale, grads)
             new_params, new_opt = optim.step(params, grads, opt_state, lr)
-            return new_params, new_states, new_opt, loss
+            return new_params, new_states, new_opt, loss, tele
 
         return jax.jit(train_step, donate_argnums=(0, 1, 2))
 
@@ -257,6 +299,12 @@ class BaseOptimizer:
         else:
             opt_state = self._replicate(
                 self.optim_method.init_state(self.model.parameters_dict()))
+        if self._step_fn is not None and \
+                getattr(self, "_step_obs_gate", None) != obs.enabled():
+            # the telemetry gate is baked into the compiled step: a
+            # toggle between runs must recompile, or a disabled run keeps
+            # computing grad-norm (and an enabled one never gets it)
+            self._step_fn = None
         if self._step_fn is None:
             self._step_fn = self._build_step()
         step = self._step_fn
@@ -267,41 +315,61 @@ class BaseOptimizer:
         state = self.state
         end_uses_loss = getattr(self.end_trigger, "uses_loss", False)
         self._pending_loss = None
+        # observability is sampled once per run: the hot loop sees a bool
+        # and (when off) touches neither the registry nor the trace ring
+        self._obs = obs.enabled()
+        ins = _train_instruments() if self._obs else None
+        self._obs_ins = ins
 
         while not self.end_trigger(state):
             records = 0
             t_epoch = time.time()
             ended_mid_epoch = False
-            for mb in batcher(self.dataset.data(train=True)):
-                t0 = time.time()
-                x, t = self._place_batch(mb.get_input(), mb.get_target())
-                self.metrics.add("data", time.time() - t0)
-                lr = self.optim_method.current_lr()
-                key, sub = jax.random.split(key)
-                t0 = time.time()
-                params, states, opt_state, loss = step(
-                    params, states, opt_state, x, t, lr, sub)
-                self.metrics.add("compute", time.time() - t0)
-                # loss is materialized one step late so the host can
-                # dispatch iteration N+1 while the device still runs N
-                self._drain_loss()
-                self._pending_loss = (loss, state["neval"], lr)
-                records += mb.size()
-                state["record_count"] += mb.size()
-                self.optim_method.host_state["eval_counter"] += 1
-                state["neval"] += 1
-                state["iteration_done"] += 1
-                self._after_iteration(params, states, opt_state, state)
-                if end_uses_loss:
-                    self._drain_loss()
-                if self.end_trigger(state):
-                    ended_mid_epoch = True
-                    break
+            with obs.span("train/epoch", epoch=state["epoch"]):
+                for mb in batcher(self.dataset.data(train=True)):
+                    with obs.span("train/step", step=state["neval"]):
+                        t0 = time.time()
+                        x, t = self._place_batch(mb.get_input(),
+                                                 mb.get_target())
+                        t_data = time.time() - t0
+                        self.metrics.add("data", t_data)
+                        lr = self.optim_method.current_lr()
+                        key, sub = jax.random.split(key)
+                        t0 = time.time()
+                        params, states, opt_state, loss, tele = step(
+                            params, states, opt_state, x, t, lr, sub)
+                        t_compute = time.time() - t0
+                        self.metrics.add("compute", t_compute)
+                        # loss is materialized one step late so the host
+                        # can dispatch iteration N+1 while the device
+                        # still runs N
+                        self._drain_loss()
+                        self._pending_loss = (loss, tele, state["neval"],
+                                              lr)
+                        records += mb.size()
+                        state["record_count"] += mb.size()
+                        if ins is not None:
+                            ins["step"].observe(t_data + t_compute)
+                            ins["data_wait"].inc(t_data)
+                            ins["compute"].inc(t_compute)
+                            ins["examples"].inc(mb.size())
+                            ins["steps"].inc()
+                    self.optim_method.host_state["eval_counter"] += 1
+                    state["neval"] += 1
+                    state["iteration_done"] += 1
+                    self._after_iteration(params, states, opt_state, state)
+                    if end_uses_loss:
+                        self._drain_loss()
+                    if self.end_trigger(state):
+                        ended_mid_epoch = True
+                        break
             self._drain_loss()
             thr = records / max(time.time() - t_epoch, 1e-9)
             logger.info(
                 "Epoch %d done: loss=%.6f throughput=%.1f records/s (%s)",
                 state["epoch"], state["loss"], thr, self.metrics.summary())
+            if ins is not None:
+                ins["throughput"].set(thr)
             if self._train_summary is not None:
                 self._train_summary.add_scalar(
                     "Throughput", thr, state["neval"])
@@ -334,8 +402,18 @@ class BaseOptimizer:
     def _drain_loss(self):
         pending = getattr(self, "_pending_loss", None)
         if pending is not None:
-            dev_loss, neval, lr = pending
+            dev_loss, tele, neval, lr = pending
             self.state["loss"] = float(dev_loss)
+            ins = getattr(self, "_obs_ins", None)
+            if ins is not None:
+                # the loss fetch above is the loop's existing host sync
+                # point; telemetry piggybacks on it (the grad-norm value
+                # materialized alongside the loss, this is a fetch of a
+                # ready buffer, not a new synchronization)
+                ins["loss"].set(self.state["loss"])
+                ins["lr"].set(float(lr))
+                if "grad_norm" in tele:
+                    ins["grad_norm"].set(float(tele["grad_norm"]))
             if self._train_summary is not None:
                 self._train_summary.add_scalar(
                     "Loss", self.state["loss"], neval)
@@ -477,6 +555,7 @@ class DistriOptimizer(BaseOptimizer):
                                    self.optim_method)
         clip_l2, clip_const = self._clip_l2, self._clip_const
         mode, axis = self._grad_compression, self.data_axis
+        want_gnorm = self._step_obs_gate = obs.enabled()
 
         def local_step(params, states, opt_state, x, t, lr, rng):
             def loss_fn(p):
@@ -495,24 +574,26 @@ class DistriOptimizer(BaseOptimizer):
             new_states = jax.tree_util.tree_map(
                 lambda s: lax.pmean(s, axis)
                 if jnp.issubdtype(s.dtype, jnp.floating) else s, new_states)
+            # telemetry reads the REDUCED gradient: the global norm, same
+            # value every replica (so the replicated out_spec is sound)
+            tele = {"grad_norm": _grad_norm(grads)} if want_gnorm else {}
             # clip AFTER the reduce: global-gradient clipping semantics
             if clip_const is not None:
                 lo, hi = clip_const
                 grads = jax.tree_util.tree_map(
                     lambda g: jnp.clip(g, lo, hi), grads)
             if clip_l2 is not None:
-                gnorm = jnp.sqrt(sum(
-                    jnp.sum(g.astype(jnp.float32) ** 2)
-                    for g in jax.tree_util.tree_leaves(grads)))
+                gnorm = _grad_norm(grads)
                 scale = jnp.minimum(1.0, clip_l2 / (gnorm + 1e-12))
                 grads = jax.tree_util.tree_map(lambda g: g * scale, grads)
             new_params, new_opt = optim.step(params, grads, opt_state, lr)
-            return new_params, new_states, new_opt, loss
+            return new_params, new_states, new_opt, loss, tele
 
+        from bigdl_tpu.utils.jax_compat import shard_map
         rep, sh = P(), P(self.data_axis)
-        smap = jax.shard_map(local_step, mesh=self.mesh,
-                             in_specs=(rep, rep, rep, sh, sh, rep, rep),
-                             out_specs=(rep, rep, rep, rep))
+        smap = shard_map(local_step, mesh=self.mesh,
+                         in_specs=(rep, rep, rep, sh, sh, rep, rep),
+                         out_specs=(rep, rep, rep, rep, rep))
         return jax.jit(smap, donate_argnums=(0, 1, 2))
 
     def _replicate(self, tree):

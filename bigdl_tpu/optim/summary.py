@@ -4,6 +4,11 @@ ValidationSummary.scala — hand-rolled TensorBoard event files).
 Here: torch.utils.tensorboard if importable (tensorboard wheels present),
 else a JSONL scalar log with the same read-back API (``read_scalar``),
 which is what the reference's summary reader offers.
+
+Every scalar is ALSO routed through the observability registry (one
+gauge per tag, labeled ``app``/``kind``), so the JSONL file, TensorBoard
+and the Prometheus ``/metrics`` surface all see the same stream (ISSUE 1
+satellite).
 """
 
 from __future__ import annotations
@@ -13,11 +18,20 @@ import os
 import time
 from typing import List, Tuple
 
+from bigdl_tpu import observability as obs
+
 
 class Summary:
-    def __init__(self, log_dir: str, app_name: str, kind: str):
+    def __init__(self, log_dir: str, app_name: str, kind: str,
+                 flush_every: int = 64):
         self.dir = os.path.join(log_dir, app_name, kind)
         os.makedirs(self.dir, exist_ok=True)
+        self.app_name = app_name
+        self.kind = kind
+        # flush at a coarse cadence, not per scalar: per-iteration
+        # flushed writes serialize the hot loop on filesystem latency
+        self.flush_every = max(int(flush_every), 1)
+        self._pending = 0
         self._tb = None
         try:
             from torch.utils.tensorboard import SummaryWriter
@@ -25,17 +39,25 @@ class Summary:
         except Exception:
             pass
         self._jsonl = open(os.path.join(self.dir, "scalars.jsonl"), "a")
+        self._gauge = None   # declared on first enabled add_scalar, so
+        # a runtime obs.enable() picks up a live summary
 
     def add_scalar(self, tag: str, value: float, step: int):
         if self._tb is not None:
             self._tb.add_scalar(tag, value, step)
+        if obs.enabled():
+            if self._gauge is None:
+                self._gauge = obs.gauge(
+                    "bigdl_summary_scalar",
+                    "Last value of each Train/ValidationSummary scalar "
+                    "tag", labelnames=("app", "kind", "tag"))
+            self._gauge.labels(app=self.app_name, kind=self.kind,
+                               tag=tag).set(float(value))
         self._jsonl.write(json.dumps(
             {"tag": tag, "value": float(value), "step": int(step),
              "wall": time.time()}) + "\n")
-        # flush at a coarse cadence, not per scalar: per-iteration flushed
-        # writes serialize the hot loop on filesystem latency
-        self._pending = getattr(self, "_pending", 0) + 1
-        if self._pending >= 64:
+        self._pending += 1
+        if self._pending >= self.flush_every:
             self._jsonl.flush()
             self._pending = 0
 
@@ -62,10 +84,14 @@ class Summary:
 
 
 class TrainSummary(Summary):
-    def __init__(self, log_dir: str, app_name: str):
-        super().__init__(log_dir, app_name, "train")
+    def __init__(self, log_dir: str, app_name: str,
+                 flush_every: int = 64):
+        super().__init__(log_dir, app_name, "train",
+                         flush_every=flush_every)
 
 
 class ValidationSummary(Summary):
-    def __init__(self, log_dir: str, app_name: str):
-        super().__init__(log_dir, app_name, "validation")
+    def __init__(self, log_dir: str, app_name: str,
+                 flush_every: int = 64):
+        super().__init__(log_dir, app_name, "validation",
+                         flush_every=flush_every)

@@ -20,6 +20,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+from bigdl_tpu.utils import jax_compat
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 NEG_INF = -1e30
@@ -31,7 +33,12 @@ def _varying(x, like):
     scan carry whose other leg went through a collective. Uses ``lax.pcast``
     (a pure type cast, no data dependence on ``like``'s values, so a
     poisoned inf/NaN in ``like`` cannot corrupt ``x``)."""
-    vma = tuple(jax.typeof(like).vma - jax.typeof(x).vma)
+    typeof = getattr(jax, "typeof", None)
+    if typeof is None or not hasattr(lax, "pcast"):
+        # pre-VMA jax (0.4.x): shard_map has no varying-axes typing, the
+        # cast is meaningless and the carry legs unify as-is
+        return x
+    vma = tuple(typeof(like).vma - typeof(x).vma)
     if not vma:
         return x
     return lax.pcast(x, vma, to="varying")
@@ -93,7 +100,7 @@ def ring_self_attention(q, k, v, axis_name: str = "seq",
                         scale: Optional[float] = None):
     """Per-device body: call inside ``shard_map`` with seq sharded on
     ``axis_name``. q/k/v: (B, S_local, H, D) local chunks."""
-    n = lax.axis_size(axis_name)
+    n = jax_compat.axis_size(axis_name)
     my = lax.axis_index(axis_name)
     b, s_local, h, d = q.shape
     hkv = k.shape[2]
@@ -131,7 +138,7 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "seq",
                    batch_axis: Optional[str] = "data"):
     """Global entry: q/k/v are (B, S, H, D) arrays; S is sharded over
     ``axis`` (and optionally B over ``batch_axis``) by this wrapper."""
-    from jax import shard_map
+    from bigdl_tpu.utils.jax_compat import shard_map
 
     baxis = batch_axis if (batch_axis and batch_axis in mesh.axis_names) \
         else None

@@ -20,6 +20,7 @@ from typing import Dict, Optional
 
 import numpy as np
 
+from bigdl_tpu import observability as obs
 from bigdl_tpu.ppml.protocol import dumps as wire_dumps
 from bigdl_tpu.ppml.protocol import loads as wire_loads
 
@@ -137,6 +138,30 @@ class ClusterServing:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.served = 0
+        self._ins = None
+
+    def _instruments(self):
+        """Declared on first use (not at construction) so a runtime
+        ``obs.enable()`` starts recording on a live job."""
+        if not obs.enabled():
+            return None
+        if self._ins is None:
+            self._ins = {
+                "served": obs.counter(
+                    "bigdl_cluster_serving_records_total",
+                    "Records answered by the ClusterServing batch loop"),
+                "batches": obs.counter(
+                    "bigdl_cluster_serving_batches_total",
+                    "Inference batches executed"),
+                "batch_size": obs.histogram(
+                    "bigdl_cluster_serving_batch_size",
+                    "Records packed per inference batch",
+                    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256)),
+                "infer": obs.histogram(
+                    "bigdl_cluster_serving_infer_seconds",
+                    "Wall time of one InferenceModel.predict call"),
+            }
+        return self._ins
 
     def _collect_batch(self):
         recs = []
@@ -158,7 +183,15 @@ class ClusterServing:
             return 0
         key = next(iter(recs[0]["data"]))
         x = np.concatenate([r["data"][key] for r in recs], axis=0)
-        y = self.model.predict(x)
+        t0 = time.time()
+        with obs.span("serving/batch", records=len(recs)):
+            y = self.model.predict(x)
+        ins = self._instruments()
+        if ins is not None:
+            ins["infer"].observe(time.time() - t0)
+            ins["batches"].inc()
+            ins["batch_size"].observe(len(recs))
+            ins["served"].inc(len(recs))
         off = 0
         for r in recs:
             n = r["data"][key].shape[0]

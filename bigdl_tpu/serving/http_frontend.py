@@ -8,7 +8,14 @@ Endpoints (mirroring the reference's REST surface):
 - ``POST /predict``  body {"uri"?: str, "inputs": {name: nested list}}
   → blocks until the serving job publishes the result →
   {"uri": ..., "result": nested list}
-- ``GET /metrics``  → {"served": N, "pending": M}
+- ``GET /metrics``  → Prometheus text exposition (v0.0.4) of the
+  process-wide observability registry: request-latency histogram
+  (``bigdl_serving_request_seconds``), served/error counters, queue
+  depth gauge — plus whatever else this process instruments (training,
+  LLM engine, collectives).
+- ``GET /metrics.json``  → the legacy two-field JSON blob
+  {"served": N, "pending": M} (the pre-ISSUE-1 ``/metrics`` body, kept
+  for old dashboards).
 
 One dispatcher thread owns the OutputQueue: concurrent handlers must
 not each poll the shared stream (they would steal each other's
@@ -19,12 +26,35 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional
 
 import numpy as np
 
+from bigdl_tpu import observability as obs
 from bigdl_tpu.serving.cluster_serving import InputQueue, OutputQueue
+
+
+def _frontend_instruments():
+    return {
+        "latency": obs.histogram(
+            "bigdl_serving_request_seconds",
+            "End-to-end /predict latency (submit to result)"),
+        "requests": obs.counter(
+            "bigdl_serving_requests_total",
+            "HTTP requests by endpoint outcome",
+            labelnames=("endpoint", "status")),
+        "served": obs.counter(
+            "bigdl_serving_served_total",
+            "Predict requests answered with a result"),
+        "errors": obs.counter(
+            "bigdl_serving_errors_total",
+            "Predict requests failing (bad request or timeout)"),
+        "queue_depth": obs.gauge(
+            "bigdl_serving_queue_depth",
+            "Requests submitted and still awaiting a result"),
+    }
 
 
 class ServingFrontend:
@@ -41,6 +71,7 @@ class ServingFrontend:
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self.served = 0
+        self._ins = None
 
         frontend = self
 
@@ -48,16 +79,28 @@ class ServingFrontend:
             def log_message(self, *a):       # quiet
                 pass
 
-            def _json(self, code: int, obj):
-                body = json.dumps(obj).encode()
+            def _text(self, code: int, text: str, content_type: str):
+                body = text.encode()
                 self.send_response(code)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _json(self, code: int, obj):
+                self._text(code, json.dumps(obj), "application/json")
+
             def do_GET(self):
+                ins = frontend._instruments()
                 if self.path == "/metrics":
+                    # refresh the gauge at scrape time so the exposition
+                    # reflects now, not the last request
+                    with frontend._lock:
+                        pending = len(frontend._events)
+                    if ins is not None:
+                        ins["queue_depth"].set(pending)
+                    self._text(200, obs.render(), obs.CONTENT_TYPE)
+                elif self.path == "/metrics.json":
                     with frontend._lock:
                         pending = len(frontend._events)
                     self._json(200, {"served": frontend.served,
@@ -66,30 +109,59 @@ class ServingFrontend:
                     self._json(404, {"error": "unknown path"})
 
             def do_POST(self):
+                ins = frontend._instruments()
                 if self.path != "/predict":
                     self._json(404, {"error": "unknown path"})
                     return
+                t_req = time.perf_counter()
                 try:
                     n = int(self.headers.get("Content-Length", 0))
                     req = json.loads(self.rfile.read(n))
                     inputs = {k: np.asarray(v, np.float32)
                               for k, v in req["inputs"].items()}
                 except Exception as e:      # noqa: BLE001 — client error
+                    if ins is not None:
+                        ins["errors"].inc()
+                        ins["requests"].labels(endpoint="/predict",
+                                               status="bad_request").inc()
                     self._json(400, {"error": f"bad request: {e}"})
                     return
-                uri = frontend._submit(req.get("uri"), inputs)
-                result = frontend._wait(uri)
+                with obs.span("serving/predict"):
+                    uri = frontend._submit(req.get("uri"), inputs)
+                    result = frontend._wait(uri)
+                latency = time.perf_counter() - t_req
+                if ins is not None:
+                    ins["latency"].observe(latency)
+                    with frontend._lock:
+                        ins["queue_depth"].set(len(frontend._events))
                 if result is None:
+                    if ins is not None:
+                        ins["errors"].inc()
+                        ins["requests"].labels(endpoint="/predict",
+                                               status="timeout").inc()
                     self._json(504, {"uri": uri,
                                      "error": "result timeout"})
                     return
                 frontend.served += 1
+                if ins is not None:
+                    ins["served"].inc()
+                    ins["requests"].labels(endpoint="/predict",
+                                           status="ok").inc()
                 self._json(200, {"uri": uri, "result": result.tolist()})
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self.address = self._httpd.server_address
 
     # -- plumbing ------------------------------------------------------------
+    def _instruments(self):
+        """Declared on first use (not at construction) so a runtime
+        ``obs.enable()`` starts recording on a live frontend."""
+        if not obs.enabled():
+            return None
+        if self._ins is None:
+            self._ins = _frontend_instruments()
+        return self._ins
+
     def _submit(self, uri: Optional[str], inputs) -> str:
         with self._lock:
             uri = self._in.enqueue(uri, **inputs)

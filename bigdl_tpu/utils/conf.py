@@ -40,6 +40,8 @@ _DEFAULTS: Dict[str, str] = {
     "bigdl.log.level": "INFO",
     "bigdl.optimizer.max.retry": "0",   # iteration-retry attempts
     "bigdl.checkpoint.overwrite": "true",
+    "bigdl.observability.enabled": "true",    # metrics + trace spans
+    "bigdl.observability.trace.capacity": "65536",  # span ring entries
 }
 
 
@@ -76,12 +78,24 @@ class BigDLConf:
     def set(self, key: str, value: Any) -> "BigDLConf":
         with self._lock:
             self._set_layer[key] = str(value)
+        self._apply_dynamic(key)
         return self
 
     def unset(self, key: str) -> "BigDLConf":
         with self._lock:
             self._set_layer.pop(key, None)
+        self._apply_dynamic(key)
         return self
+
+    def _apply_dynamic(self, key: str):
+        """Keys consumed at import time by other modules get pushed to
+        them on change, so programmatic set() works after import."""
+        if key.startswith("bigdl.observability."):
+            try:
+                from bigdl_tpu.observability import _state
+                _state.refresh(key)
+            except Exception:
+                pass
 
     # -- resolution ----------------------------------------------------------
     def get(self, key: str, default: Optional[str] = None) -> Optional[str]:

@@ -1,0 +1,25 @@
+"""Version-tolerant aliases for jax API that moved across releases.
+
+The codebase targets the modern spelling (``jax.shard_map``,
+``lax.axis_size``); this image ships the 0.4.x line where shard_map
+still lives under ``jax.experimental`` and ``axis_size`` doesn't exist.
+Import from here instead of hard-coding either location.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+shard_map = getattr(jax, "shard_map", None)
+if shard_map is None:
+    from jax.experimental.shard_map import shard_map  # noqa: F401
+
+
+def axis_size(axis_name: str):
+    """Size of a bound mesh axis. The psum-of-unit fallback folds to the
+    same compile-time constant on versions without ``lax.axis_size``."""
+    fn = getattr(lax, "axis_size", None)
+    if fn is not None:
+        return fn(axis_name)
+    return lax.psum(1, axis_name)

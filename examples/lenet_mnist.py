@@ -3,12 +3,17 @@
 Trains on real MNIST when the IDX files are present (see
 bigdl_tpu.feature.mnist), synthetic digits otherwise. Keras-style API
 over the SPMD optimizer.
+
+``--trace-out PATH`` dumps the run's trace spans (per-step/per-epoch
+timing from the instrumented optimizer loop) as Chrome-trace JSON —
+open it at https://ui.perfetto.dev or chrome://tracing, or summarize it
+with ``python tools/telemetry_report.py PATH``.
 """
 
 import numpy as np
 
 
-def main(smoke: bool = False):
+def main(smoke: bool = False, trace_out: str = None):
     import bigdl_tpu.keras as K
     from bigdl_tpu.nn.module import set_seed
 
@@ -33,8 +38,22 @@ def main(smoke: bool = False):
     m.fit(x, y, batch_size=64, nb_epoch=epochs)
     results = m.evaluate(x, y, batch_size=256)
     print("train-set metrics:", results)
+    if trace_out:
+        from bigdl_tpu import observability as obs
+        obs.export_chrome_trace(trace_out)
+        print(f"trace written to {trace_out} "
+              f"({len(obs.TRACE)} spans; load in Perfetto or run "
+              f"tools/telemetry_report.py on it)")
     return results
 
 
 if __name__ == "__main__":
-    main()
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny subset, one epoch")
+    ap.add_argument("--trace-out", default=None, metavar="PATH",
+                    help="write Chrome-trace JSON of the training run")
+    args = ap.parse_args()
+    main(smoke=args.smoke, trace_out=args.trace_out)

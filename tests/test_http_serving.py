@@ -30,6 +30,16 @@ def _get(addr, path):
     return r.status, body
 
 
+def _get_text(addr, path):
+    conn = http.client.HTTPConnection(*addr, timeout=30)
+    conn.request("GET", path)
+    r = conn.getresponse()
+    body = r.read().decode()
+    ctype = r.getheader("Content-Type", "")
+    conn.close()
+    return r.status, body, ctype
+
+
 class TestServingFrontend:
     def test_predict_roundtrip(self):
         from bigdl_tpu.serving.cluster_serving import ClusterServing
@@ -50,8 +60,16 @@ class TestServingFrontend:
             want = im.predict(x)
             np.testing.assert_allclose(np.asarray(out["result"]),
                                        np.asarray(want), rtol=1e-5)
-            code, metrics = _get(fe.address, "/metrics")
+            # legacy JSON blob moved to /metrics.json (ISSUE 1 satellite)
+            code, metrics = _get(fe.address, "/metrics.json")
             assert code == 200 and metrics["served"] == 1
+            # /metrics is now Prometheus text exposition
+            code, text, ctype = _get_text(fe.address, "/metrics")
+            assert code == 200 and ctype.startswith("text/plain")
+            from bigdl_tpu.observability import parse_prometheus
+            parsed = parse_prometheus(text)
+            assert parsed["bigdl_serving_served_total"][()] >= 1
+            assert parsed["bigdl_serving_request_seconds_count"][()] >= 1
         finally:
             fe.stop()
             job.stop()

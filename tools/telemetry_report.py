@@ -1,0 +1,182 @@
+#!/usr/bin/env python
+"""Telemetry report — summarize observability artifacts into tables.
+
+Reads any mix of:
+- a ``scalars.jsonl`` written by ``optim.summary.Summary`` (tag/value/
+  step/wall records) → per-tag count/min/mean/last plus step-interval
+  percentiles from the wall clocks;
+- a Chrome-trace JSON exported by ``observability.export_chrome_trace``
+  → per-span-name duration percentiles (p50/p90/p99);
+- (library use) the live metric registry → the same summary ``bench.py``
+  appends to its output record.
+
+CLI:
+    python tools/telemetry_report.py run/log/train/scalars.jsonl
+    python tools/telemetry_report.py trace.json
+    python tools/telemetry_report.py --json trace.json   # machine output
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import sys
+from typing import Dict, List, Optional
+
+
+def _pct(sorted_vals: List[float], q: float) -> Optional[float]:
+    if not sorted_vals:
+        return None
+    idx = min(int(math.ceil(q * len(sorted_vals))) - 1,
+              len(sorted_vals) - 1)
+    return sorted_vals[max(idx, 0)]
+
+
+def _dist(vals: List[float]) -> Dict[str, float]:
+    s = sorted(vals)
+    return {"count": len(s),
+            "mean": sum(s) / len(s),
+            "p50": _pct(s, 0.50),
+            "p90": _pct(s, 0.90),
+            "p99": _pct(s, 0.99),
+            "max": s[-1]}
+
+
+def summarize_scalars(path: str) -> dict:
+    """Per-tag stats from a Summary JSONL scalar log; ``step_seconds``
+    holds the wall-clock interval distribution between consecutive
+    records of the most frequent tag (≈ step time for a Loss stream)."""
+    tags: Dict[str, List[dict]] = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            tags.setdefault(rec["tag"], []).append(rec)
+    out: Dict[str, dict] = {"tags": {}}
+    for tag, recs in tags.items():
+        vals = [r["value"] for r in recs]
+        out["tags"][tag] = {
+            "count": len(recs), "min": min(vals),
+            "mean": sum(vals) / len(vals), "last": vals[-1]}
+    if tags:
+        main_tag = max(tags, key=lambda t: len(tags[t]))
+        walls = [r["wall"] for r in tags[main_tag]]
+        deltas = [b - a for a, b in zip(walls, walls[1:]) if b >= a]
+        if deltas:
+            out["step_seconds"] = dict(_dist(deltas), tag=main_tag)
+    return out
+
+
+def summarize_trace(path_or_doc) -> dict:
+    """Per-span-name duration distributions from Chrome-trace JSON."""
+    if isinstance(path_or_doc, dict):
+        doc = path_or_doc
+    else:
+        with open(path_or_doc) as f:
+            doc = json.load(f)
+    events = doc.get("traceEvents", doc if isinstance(doc, list) else [])
+    names: Dict[str, List[float]] = {}
+    for ev in events:
+        if ev.get("ph") != "X" or "dur" not in ev:
+            continue
+        names.setdefault(ev["name"], []).append(ev["dur"] / 1e6)
+    return {"spans": {name: _dist(d) for name, d in sorted(names.items())}}
+
+
+def summarize_registry(registry=None) -> dict:
+    """Compact snapshot of the live metric registry (every counter/gauge
+    value, histogram count/mean/p50/p99) — the block ``bench.py`` embeds
+    in its output record."""
+    from bigdl_tpu import observability as obs
+    from bigdl_tpu.observability.metrics import _HistogramChild
+    registry = registry or obs.REGISTRY
+    out: Dict[str, object] = {}
+    for m in registry.collect():
+        series = {}
+        for key, child in sorted(m.children()):
+            label = ",".join(f"{n}={v}" for n, v in zip(m.labelnames, key))
+            if isinstance(child, _HistogramChild):
+                _, total, count = child.snapshot()
+                series[label or "_"] = {
+                    "count": count,
+                    "mean": (total / count) if count else None,
+                    "p50": child.percentile(0.5),
+                    "p99": child.percentile(0.99)}
+            else:
+                series[label or "_"] = child.value
+        if series:
+            out[m.name] = series if m.labelnames else series["_"]
+    return out
+
+
+def _fmt(v) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.6g}"
+    return str(v)
+
+
+def _print_table(title: str, header: List[str], rows: List[List]):
+    rows = [[_fmt(c) for c in r] for r in rows]
+    widths = [max(len(h), *(len(r[i]) for r in rows)) if rows else len(h)
+              for i, h in enumerate(header)]
+    print(f"\n== {title} ==")
+    print("  ".join(h.ljust(w) for h, w in zip(header, widths)))
+    for r in rows:
+        print("  ".join(c.ljust(w) for c, w in zip(r, widths)))
+
+
+def report(path: str, as_json: bool = False) -> dict:
+    if path.endswith(".jsonl"):
+        summary = {"kind": "scalars", "path": path,
+                   **summarize_scalars(path)}
+    else:
+        summary = {"kind": "trace", "path": path,
+                   **summarize_trace(path)}
+    if as_json:
+        print(json.dumps(summary))
+        return summary
+    if summary["kind"] == "scalars":
+        _print_table(
+            f"scalars: {path}",
+            ["tag", "count", "min", "mean", "last"],
+            [[t, d["count"], d["min"], d["mean"], d["last"]]
+             for t, d in sorted(summary["tags"].items())])
+        st = summary.get("step_seconds")
+        if st:
+            _print_table(
+                f"step time (wall deltas of '{st['tag']}')",
+                ["count", "mean_s", "p50_s", "p90_s", "p99_s", "max_s"],
+                [[st["count"], st["mean"], st["p50"], st["p90"],
+                  st["p99"], st["max"]]])
+    else:
+        _print_table(
+            f"trace spans: {path}",
+            ["span", "count", "mean_s", "p50_s", "p90_s", "p99_s",
+             "max_s"],
+            [[name, d["count"], d["mean"], d["p50"], d["p90"], d["p99"],
+              d["max"]]
+             for name, d in summary["spans"].items()])
+    return summary
+
+
+def main(argv: List[str]) -> int:
+    as_json = "--json" in argv
+    paths = [a for a in argv if not a.startswith("--")]
+    if not paths:
+        print(__doc__)
+        return 2
+    for p in paths:
+        if not os.path.exists(p):
+            print(f"no such file: {p}", file=sys.stderr)
+            return 1
+        report(p, as_json=as_json)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
