@@ -845,15 +845,27 @@ def _telemetry_block() -> dict:
     """Snapshot of the observability registry + span distributions after
     the benches ran (the convergence benches drive the instrumented
     BaseOptimizer loop, so step-time histograms and loss/grad-norm
-    gauges land here; see tools/telemetry_report.py)."""
+    gauges land here; see tools/telemetry_report.py). Also folds in one
+    seeded chaos smoke run (tools/chaos_check.py): injected faults must
+    recover to the clean run's final loss, and its reliability counters
+    land in the same registry snapshot."""
     from bigdl_tpu import observability as obs
     from tools.telemetry_report import (summarize_registry,
                                         summarize_trace)
-    return {
+    # snapshot the bench telemetry FIRST: the chaos smoke trains its own
+    # tiny model through the instrumented loop and must not pollute the
+    # step-time/loss numbers this block reports for the benches
+    out = {
         "metrics": summarize_registry(),
         "spans": summarize_trace(
             {"traceEvents": obs.TRACE.spans()})["spans"],
     }
+    try:
+        from tools.chaos_check import run_chaos
+        out["chaos_smoke"] = run_chaos(seed=0, events=3, smoke=True)
+    except Exception as e:  # never lose the telemetry to the chaos run
+        out["chaos_smoke"] = {"error": repr(e)}
+    return out
 
 
 def _default_run(quick: bool) -> dict:

@@ -20,6 +20,11 @@ capabilities (see SURVEY.md):
   replaced by Pallas INT4/INT8 kernels).
 - ``bigdl_tpu.parallel``— mesh / collectives / ring-attention building blocks
   (no reference equivalent: BigDL is DP-only; see SURVEY.md §2.5).
+- ``bigdl_tpu.observability`` — metric registry (Prometheus exposition)
+  + trace spans (Chrome-trace export); see docs/OBSERVABILITY.md.
+- ``bigdl_tpu.reliability`` — fault-injection sites + retry/deadline/
+  breaker/health policies behind the SoCC'19 survive-failures claim;
+  see docs/RELIABILITY.md.
 """
 
 from bigdl_tpu.version import __version__

@@ -38,6 +38,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from bigdl_tpu import observability as obs
+from bigdl_tpu import reliability
 
 
 def _llm_instruments():
@@ -222,11 +223,17 @@ class Request:
         self.prompt_ids = np.asarray(prompt_ids, np.int32).ravel()
         self.max_new_tokens = max_new_tokens
         self.tokens: List[int] = []
+        self.error: Optional[str] = None
         self.done = threading.Event()
 
     def get(self, timeout: Optional[float] = None) -> List[int]:
         if not self.done.wait(timeout):
             raise TimeoutError(f"request {self.id} still running")
+        if self.error is not None:
+            # the engine failed this request (e.g. its prefill raised):
+            # surface it instead of returning an empty "success"
+            raise RuntimeError(
+                f"request {self.id} failed: {self.error}")
         return list(self.tokens)
 
 
@@ -259,7 +266,8 @@ class LLMServer:
 
     def __init__(self, model, max_batch: int = 4, max_seq_len: int = 256,
                  eos_token_id: Optional[int] = None, paged: bool = True,
-                 page_size: int = 16, num_pages: Optional[int] = None):
+                 page_size: int = 16, num_pages: Optional[int] = None,
+                 max_queue: int = 0):
         import inspect
 
         from bigdl_tpu.llm.models.llama import forward, init_cache
@@ -300,7 +308,13 @@ class LLMServer:
                                 self.cfg.max_position_embeddings))
         self.eos_token_id = eos_token_id
         self.paged = paged
-        self._queue: "queue.Queue[Request]" = queue.Queue()
+        # bounded admission (ISSUE 2): max_queue > 0 caps WAITING
+        # requests; submit on a full queue raises OverloadError (the
+        # worker's 503 + Retry-After shed) instead of growing forever
+        self.max_queue = max_queue
+        self._queue: "queue.Queue[Request]" = queue.Queue(
+            maxsize=max_queue)
+        self._draining = threading.Event()
         self._slots: List[Optional[Request]] = [None] * max_batch
         self._remaining = np.zeros(max_batch, np.int64)
         self._last = jnp.zeros((max_batch, self.cfg.vocab_size),
@@ -353,6 +367,7 @@ class LLMServer:
 
     # -- client API ----------------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens: int = 32) -> Request:
+        reliability.inject("llm.submit")
         req = Request(prompt_ids, max_new_tokens)
         if len(req.prompt_ids) + max_new_tokens > self.max_seq_len:
             raise ValueError("prompt + max_new_tokens exceeds max_seq_len")
@@ -363,7 +378,17 @@ class LLMServer:
                 raise ValueError(
                     f"request needs {budget} pages but the pool holds "
                     f"{self._num_pages - 1}; it could never be admitted")
-        self._queue.put(req)
+        if self._draining.is_set():
+            reliability.count_shed("llm_server")
+            raise reliability.OverloadError(
+                "server is draining: not accepting new requests")
+        try:
+            self._queue.put_nowait(req)
+        except queue.Full:
+            reliability.count_shed("llm_server")
+            raise reliability.OverloadError(
+                f"request queue full ({self.max_queue} waiting); "
+                "retry later") from None
         return req
 
     def start(self) -> "LLMServer":
@@ -371,7 +396,22 @@ class LLMServer:
         self._thread.start()
         return self
 
-    def stop(self):
+    def stop(self, drain: bool = True, timeout: float = 30.0):
+        """Graceful drain (default): reject new submits, finish every
+        accepted request (queued AND in-slot), then stop the engine
+        thread. ``drain=False`` is the old immediate stop — accepted
+        requests never complete."""
+        self._draining.set()
+        if drain and self._thread is not None and self._thread.is_alive():
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                with self._lock:
+                    idle = (self._queue.empty()
+                            and getattr(self, "_pending_head", None) is None
+                            and all(r is None for r in self._slots))
+                if idle:
+                    break
+                time.sleep(0.005)
         self._stop.set()
         if self._thread:
             self._thread.join(timeout=30)
@@ -405,10 +445,21 @@ class LLMServer:
                 self._budget_avail -= budget
                 self._slot_budget[i] = budget
             t0 = time.perf_counter()
-            with obs.span("llm/prefill", slot=i,
-                          tokens=len(req.prompt_ids)):
-                (self._prefill_paged if self.paged
-                 else self._prefill_slot)(i, req)
+            try:
+                with obs.span("llm/prefill", slot=i,
+                              tokens=len(req.prompt_ids)):
+                    (self._prefill_paged if self.paged
+                     else self._prefill_slot)(i, req)
+            except BaseException as e:
+                # a failing prefill must not leak its admission budget
+                # (the resilient _loop would otherwise shrink the pool
+                # forever) nor leave the client blocked until timeout
+                if self.paged:
+                    self._budget_avail += int(self._slot_budget[i])
+                    self._slot_budget[i] = 0
+                req.error = f"{type(e).__name__}: {e}"
+                req.done.set()
+                raise
             self._record_prefill(len(req.prompt_ids),
                                  time.perf_counter() - t0)
 
@@ -530,19 +581,24 @@ class LLMServer:
         page = self._page
         npages = -(-t // page)
         ids = [self._free.pop() for _ in range(npages)]
-        bucket = max(page, 1 << (t - 1).bit_length())   # pow2, >= page
-        key = self._step_cache_key() + ("prefill", bucket)
-        fn = _PAGED_STEP_CACHE.get(key)
-        if fn is None:
-            fn = _PAGED_STEP_CACHE[key] = self._build_paged_prefill(bucket)
-        toks = np.zeros((1, bucket), np.int32)
-        toks[0, :t] = req.prompt_ids
-        pids = np.zeros(bucket // page, np.int32)
-        pids[:npages] = ids
-        self._k_pages, self._v_pages, last = fn(
-            self.model.params, self._k_pages, self._v_pages,
-            jnp.asarray(toks), jnp.asarray(t, jnp.int32),
-            jnp.asarray(pids))
+        try:
+            bucket = max(page, 1 << (t - 1).bit_length())  # pow2, >= page
+            key = self._step_cache_key() + ("prefill", bucket)
+            fn = _PAGED_STEP_CACHE.get(key)
+            if fn is None:
+                fn = _PAGED_STEP_CACHE[key] = \
+                    self._build_paged_prefill(bucket)
+            toks = np.zeros((1, bucket), np.int32)
+            toks[0, :t] = req.prompt_ids
+            pids = np.zeros(bucket // page, np.int32)
+            pids[:npages] = ids
+            self._k_pages, self._v_pages, last = fn(
+                self.model.params, self._k_pages, self._v_pages,
+                jnp.asarray(toks), jnp.asarray(t, jnp.int32),
+                jnp.asarray(pids))
+        except BaseException:
+            self._free.extend(ids)   # physical pages must not leak
+            raise
         self._last = self._last.at[i].set(last)
         # same async-dispatch buffer-lifetime barrier as _prefill_slot
         _sync_barrier(self._k_pages, self._v_pages, self._last)
@@ -632,10 +688,8 @@ class LLMServer:
             finished=sum(1 for i in active if self._slots[i] is None))
         return True
 
-    def _step(self):
-        """Decode one token for every active slot."""
-        if self.paged:
-            return self._step_paged()
+    def _step_slotted(self):
+        """One decode step of the slot-static (paged=False) engine."""
         active = [i for i, r in enumerate(self._slots) if r is not None]
         if not active:
             return False
@@ -737,10 +791,33 @@ class LLMServer:
         del old
         return logits, None
 
+    def _step(self):
+        """Decode one token for every active slot."""
+        reliability.inject("llm.step")
+        if self.paged:
+            return self._step_paged()
+        return self._step_slotted()
+
     def _loop(self):
+        backoff = reliability.RetryPolicy(max_attempts=1 << 30,
+                                          base_delay=0.005, max_delay=0.5)
+        delays = None
         while not self._stop.is_set():
-            with self._lock:
-                self._admit()
-                busy = self._step()
+            try:
+                with self._lock:
+                    self._admit()
+                    busy = self._step()
+            except Exception as e:  # noqa: BLE001 — the engine thread
+                # must survive a failing step (injected or real): count,
+                # back off, keep decoding the surviving slots
+                from bigdl_tpu.reliability.policies import _count
+                _count("bigdl_reliability_retries_total",
+                       "Retries performed under a RetryPolicy",
+                       component="llm_server")
+                if delays is None:
+                    delays = backoff.delays()
+                time.sleep(next(delays, 0.5))
+                continue
+            delays = None   # healthy pass resets the backoff
             if not busy:
                 time.sleep(0.002)

@@ -12,6 +12,13 @@ Endpoints:
   (the FastChat worker streams exactly such JSON deltas)
 - ``GET  /worker_get_status``      {"model": ..., "queue_length": ...,
   "speed": tokens/s since start}
+- ``GET  /healthz``                200/503 + engine-thread liveness and
+  the reliability health-check registry (ISSUE 2)
+
+Backpressure (ISSUE 2): when the engine's bounded queue rejects a
+submit (``OverloadError``) the worker sheds with **503 + Retry-After**
+instead of queueing unboundedly; per-request deadlines propagate via
+``X-BigDL-Deadline-Ms`` and cap the blocking wait.
 
 Token-level API by design: tokenization happens client-side (the
 environment ships no tokenizer assets; the reference worker accepts text
@@ -26,6 +33,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 import numpy as np
+
+from bigdl_tpu import reliability
 
 
 class LLMWorker:
@@ -45,10 +54,12 @@ class LLMWorker:
             def log_message(self, *a):
                 pass
 
-            def _json(self, code: int, obj):
+            def _json(self, code: int, obj, headers=()):
                 body = json.dumps(obj).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
+                for k, v in headers:
+                    self.send_header(k, v)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
@@ -58,6 +69,34 @@ class LLMWorker:
                 req = json.loads(self.rfile.read(n))
                 ids = np.asarray(req["prompt_ids"], np.int32)
                 return ids, int(req.get("max_new_tokens", 32))
+
+            def _submit(self, ids, mnt):
+                """submit with the 422/503/500 split: invalid requests
+                are the client's fault, overload is shed with
+                Retry-After, and any other failure (including an
+                injected one — InjectedFault is deliberately NOT
+                special-cased, per the faults.py contract) answers 500
+                instead of killing the handler's connection."""
+                try:
+                    return worker.server.submit(ids, max_new_tokens=mnt)
+                except reliability.OverloadError as e:
+                    self._json(503, {"error": str(e)},
+                               headers=(("Retry-After", "1"),))
+                    return None
+                except ValueError as e:
+                    self._json(422, {"error": str(e)})
+                    return None
+                except Exception as e:  # noqa: BLE001 — real or injected
+                    self._json(500, {"error": f"submit failed: {e}"})
+                    return None
+
+            def _wait_timeout(self) -> float:
+                deadline = reliability.Deadline.from_header(
+                    self.headers.get(reliability.DEADLINE_HEADER))
+                if deadline is None:
+                    return worker.request_timeout
+                return max(min(worker.request_timeout,
+                               deadline.remaining()), 0.0)
 
             def do_GET(self):
                 if self.path == "/worker_get_status":
@@ -77,6 +116,20 @@ class LLMWorker:
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
                     self.wfile.write(body)
+                elif self.path == "/healthz":
+                    ok, report = reliability.health_report()
+                    engine = worker.server._thread
+                    alive = engine is not None and engine.is_alive()
+                    draining = worker.server._draining.is_set() \
+                        if hasattr(worker.server, "_draining") else False
+                    healthy = ok and alive and not draining
+                    self._json(200 if healthy else 503, {
+                        "status": ("ok" if healthy else
+                                   "draining" if draining else
+                                   "unhealthy"),
+                        "engine_alive": alive,
+                        "queue_length": worker.server._queue.qsize(),
+                        "checks": report})
                 else:
                     self._json(404, {"error": "unknown path"})
 
@@ -87,15 +140,16 @@ class LLMWorker:
                     except Exception as e:  # noqa: BLE001
                         self._json(400, {"error": f"bad request: {e}"})
                         return
-                    try:
-                        req = worker.server.submit(ids, max_new_tokens=mnt)
-                    except ValueError as e:
-                        self._json(422, {"error": str(e)})
+                    req = self._submit(ids, mnt)
+                    if req is None:
                         return
                     try:
-                        toks = req.get(timeout=worker.request_timeout)
+                        toks = req.get(timeout=self._wait_timeout())
                     except TimeoutError:
                         self._json(504, {"error": "generation timed out"})
+                        return
+                    except RuntimeError as e:   # engine failed the req
+                        self._json(500, {"error": str(e)})
                         return
                     worker._tokens_out += len(toks)
                     eos = worker.server.eos_token_id
@@ -109,10 +163,8 @@ class LLMWorker:
                     except Exception as e:  # noqa: BLE001
                         self._json(400, {"error": f"bad request: {e}"})
                         return
-                    try:
-                        req = worker.server.submit(ids, max_new_tokens=mnt)
-                    except ValueError as e:
-                        self._json(422, {"error": str(e)})
+                    req = self._submit(ids, mnt)
+                    if req is None:
                         return
                     self.send_response(200)
                     self.send_header("Content-Type",
@@ -129,7 +181,7 @@ class LLMWorker:
 
                     seen = 0
                     done = False
-                    deadline = time.time() + worker.request_timeout
+                    deadline = time.time() + self._wait_timeout()
                     while time.time() < deadline:
                         done = req.done.wait(0.02)
                         cur = list(req.tokens)

@@ -382,9 +382,6 @@ class Module:
         import pickle
         if not overwrite and os.path.exists(path):
             raise IOError(f"{path} exists and overwrite=False")
-        if os.path.isfile(path):
-            os.remove(path)   # overwrite a legacy single-file checkpoint
-        self.save_weights(path)
         params, states = self.parameters_dict(), self.states_dict()
         try:
             # strip weights from the pickled structure: arrays live only
@@ -393,11 +390,18 @@ class Module:
                 lambda a: np.zeros((0,), np.asarray(a).dtype), params))
             self.load_states_dict(jax.tree_util.tree_map(
                 lambda a: np.zeros((0,), np.asarray(a).dtype), states))
-            with open(os.path.join(path, "structure.pkl"), "wb") as f:
-                pickle.dump(self, f)
+            structure = pickle.dumps(self)
         finally:
             self.load_parameters_dict(params)
             self.load_states_dict(states)
+        # ONE atomic save: weights, manifest and the structure sidecar
+        # all publish together (a crash mid-save can't leave a dir that
+        # load_weights accepts but load_module chokes on)
+        from bigdl_tpu.utils.checkpoint import save_checkpoint
+        save_checkpoint(path,
+                        {"params": params, "states": states},
+                        metadata={"class": type(self).__name__},
+                        extra_files={"structure.pkl": structure})
         return self
 
     @staticmethod

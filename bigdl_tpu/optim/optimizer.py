@@ -20,6 +20,8 @@ from __future__ import annotations
 import logging
 import os
 import pickle
+import signal
+import threading
 import time
 from functools import partial
 from typing import Optional, Sequence
@@ -29,6 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from bigdl_tpu import observability as obs
+from bigdl_tpu import reliability
 from bigdl_tpu.feature.dataset import (
     AbstractDataSet, LocalDataSet, MiniBatch, SampleToMiniBatch)
 from bigdl_tpu.nn.module import Criterion, Module
@@ -247,37 +250,131 @@ class BaseOptimizer:
                 self.optim_method.get_state())
             self._initial_snapshot = (init_params, init_states,
                                       init_train_state, init_host_state)
-        while True:
-            try:
-                return self._optimize_once()
-            except KeyboardInterrupt:
-                raise
-            except Exception as e:  # noqa: BLE001 — the retry contract
-                attempt += 1
-                if attempt > retries:
-                    raise
-                logger.warning(
-                    "training iteration failed (%s: %s); retry %d/%d "
-                    "from the last checkpoint", type(e).__name__, e,
-                    attempt, retries)
-                self._restore_latest_checkpoint()
+        rel_on = reliability.enabled()
+        if rel_on:
+            # preemption recovery: a fresh run against a checkpoint dir
+            # that already holds valid state (a previous process was
+            # SIGTERMed) resumes exactly at the saved iteration
+            self._maybe_auto_resume()
+        policy = reliability.RetryPolicy() if rel_on else None
+        backoff = policy.delays() if rel_on else iter(())
+        # past the schedule, keep sleeping at the cap — a long retry
+        # budget must never degenerate into a zero-backoff hammer
+        backoff_floor = policy.max_delay if rel_on else 0.0
+        restore_handlers = self._install_preemption_handlers() \
+            if rel_on else None
+        try:
+            while True:
+                try:
+                    return self._optimize_once()
+                except (KeyboardInterrupt,
+                        reliability.TrainingPreempted):
+                    raise    # preemption is not a failure: no retry
+                except Exception as e:  # noqa: BLE001 — retry contract
+                    attempt += 1
+                    if attempt > retries:
+                        raise
+                    logger.warning(
+                        "training iteration failed (%s: %s); retry %d/%d "
+                        "from the last checkpoint", type(e).__name__, e,
+                        attempt, retries)
+                    from bigdl_tpu.reliability.policies import _count
+                    _count("bigdl_reliability_retries_total",
+                           "Retries performed under a RetryPolicy",
+                           component="optimizer")
+                    time.sleep(next(backoff, backoff_floor))
+                    self._restore_latest_checkpoint()
+        finally:
+            if restore_handlers is not None:
+                restore_handlers()
+
+    # -- preemption safety (ISSUE 2) -----------------------------------------
+    def _install_preemption_handlers(self):
+        """SIGTERM/SIGINT → checkpoint-then-exit (the dominant TPU-VM
+        failure mode is preemption with a grace window). Installed only
+        on the main thread (signal.signal is illegal elsewhere), only
+        when a checkpoint path is configured, and always restored after
+        optimize() — callers' handlers are never clobbered for good.
+        Returns the restore callable, or None when not installed."""
+        if not self._checkpoint_path:
+            return None
+        if threading.current_thread() is not threading.main_thread():
+            return None
+        self._preempt_requested = False
+        optimizer = self
+
+        def on_signal(signum, frame):
+            if optimizer._preempt_requested:
+                # second signal: the user/platform insists — don't stay
+                # stuck behind a hung step waiting for the iteration
+                # boundary; restore the interruptibility contract
+                raise KeyboardInterrupt
+            # only a flag: the training loop checkpoints at the next
+            # iteration boundary (handlers must not run jax code)
+            optimizer._preempt_requested = True
+            optimizer._preempt_signum = signum
+
+        prev = {}
+        try:
+            for sig in (signal.SIGTERM, signal.SIGINT):
+                prev[sig] = signal.signal(sig, on_signal)
+        except (ValueError, OSError):   # exotic embedding: keep going
+            for sig, h in prev.items():
+                signal.signal(sig, h)
+            return None
+
+        def restore():
+            for sig, h in prev.items():
+                signal.signal(sig, h)
+
+        return restore
+
+    def _check_preemption(self, params, states, opt_state, state):
+        if not getattr(self, "_preempt_requested", False):
+            return
+        self._preempt_requested = False
+        self._drain_loss()
+        if self._checkpoint_path:
+            self._save_checkpoint(params, states, opt_state, state)
+        from bigdl_tpu.reliability.policies import _count
+        _count("bigdl_reliability_preemptions_total",
+               "SIGTERM/SIGINT preemptions that checkpointed and exited")
+        signum = getattr(self, "_preempt_signum", signal.SIGTERM)
+        logger.warning(
+            "preemption signal %s: checkpoint saved at iteration %d; "
+            "exiting (a fresh optimize() resumes here)", signum,
+            state["neval"])
+        raise reliability.TrainingPreempted(
+            f"preempted at iteration {state['neval']} "
+            f"(checkpoint: {self._checkpoint_path})")
+
+    def _maybe_auto_resume(self):
+        """On a FRESH optimizer (no iterations done) pointed at a
+        checkpoint dir holding valid state, resume at the exact saved
+        iteration — the second half of the preemption round-trip."""
+        from bigdl_tpu.utils import checkpoint as ckpt
+        if not self._checkpoint_path or self.state.get("iteration_done"):
+            return
+        if not os.path.isdir(self._checkpoint_path):
+            return
+        tag = ckpt.latest(self._checkpoint_path, prefix="optim.",
+                          paired_prefix="model.")
+        if tag is None:
+            return
+        logger.info("auto-resuming from checkpoint %s @ %s",
+                    self._checkpoint_path, tag)
+        self.resume_from_checkpoint(self._checkpoint_path, tag)
 
     def _restore_latest_checkpoint(self):
-        """Reference recovery semantics: resume from the newest persisted
-        checkpoint if set_checkpoint was configured; else restart from
-        the live module's current weights (the initial state)."""
-        if self._checkpoint_path:
-            tags = []
-            for name in os.listdir(self._checkpoint_path):
-                if name.startswith("optim."):
-                    tag = name[len("optim."):]
-                    try:
-                        ep, ne = tag.split(".")
-                        tags.append((int(ep), int(ne), tag))
-                    except ValueError:
-                        continue
-            if tags:
-                tag = max(tags)[2]
+        """Reference recovery semantics: resume from the newest VALID
+        persisted checkpoint if set_checkpoint was configured (corrupt
+        or incomplete candidates are quarantined and skipped); else
+        restart from the live module's initial state."""
+        if self._checkpoint_path and os.path.isdir(self._checkpoint_path):
+            from bigdl_tpu.utils import checkpoint as ckpt
+            tag = ckpt.latest(self._checkpoint_path, prefix="optim.",
+                              paired_prefix="model.")
+            if tag is not None:
                 self.resume_from_checkpoint(self._checkpoint_path, tag)
                 return
         # no persisted checkpoint: true restart — initial weights AND
@@ -327,6 +424,7 @@ class BaseOptimizer:
             ended_mid_epoch = False
             with obs.span("train/epoch", epoch=state["epoch"]):
                 for mb in batcher(self.dataset.data(train=True)):
+                    reliability.inject("optimizer.step")
                     with obs.span("train/step", step=state["neval"]):
                         t0 = time.time()
                         x, t = self._place_batch(mb.get_input(),
@@ -358,6 +456,8 @@ class BaseOptimizer:
                     state["neval"] += 1
                     state["iteration_done"] += 1
                     self._after_iteration(params, states, opt_state, state)
+                    self._check_preemption(params, states, opt_state,
+                                           state)
                     if end_uses_loss:
                         self._drain_loss()
                     if self.end_trigger(state):
@@ -460,20 +560,28 @@ class BaseOptimizer:
                 sched.record_score(results[0].result)
 
     def _save_checkpoint(self, params, states, opt_state, state):
+        reliability.inject("optimizer.checkpoint")
         tag = f"{state['epoch']}.{state['neval']}"
         self.model.load_parameters_dict(
             jax.tree_util.tree_map(np.asarray, params))
         self.model.load_states_dict(
             jax.tree_util.tree_map(np.asarray, states))
+        # model first, optim second: latest() requires the valid PAIR,
+        # so a crash between the two leaves tag invisible to recovery
         self.model.save_module(
             os.path.join(self._checkpoint_path, f"model.{tag}"))
-        from bigdl_tpu.utils.checkpoint import save_checkpoint
+        from bigdl_tpu.utils.checkpoint import (prune_checkpoints,
+                                                save_checkpoint)
         save_checkpoint(
             os.path.join(self._checkpoint_path, f"optim.{tag}"),
             {"opt_state": jax.tree_util.tree_map(np.asarray, opt_state),
              "host_state": self.optim_method.get_state(),
              "train_state": dict(state)})
         logger.info("checkpoint saved: %s @ %s", self._checkpoint_path, tag)
+        from bigdl_tpu.utils.conf import conf
+        keep = conf.get_int("bigdl.checkpoint.keep", 0) or 0
+        if keep > 0:
+            prune_checkpoints(self._checkpoint_path, keep)
 
     def resume_from_checkpoint(self, path: str, tag: str):
         """Resume (ref: Optimizer resume = loadModule + OptimMethod.load)."""

@@ -1,0 +1,72 @@
+"""Reliability layer for bigdl_tpu (ISSUE 2 tentpole).
+
+BigDL's defining claim (SoCC'19) is that training and serving survive
+failures in commodity clusters. This package makes the TPU rebuild's
+failure paths designed and testable instead of incidental:
+
+- :mod:`~bigdl_tpu.reliability.faults` — named **fault-injection
+  points** (``reliability.inject("checkpoint.write")``) threaded through
+  checkpointing, the optimizer iteration, the cluster-serving backends
+  and both HTTP front-ends. Zero-cost no-ops in production (one
+  attribute check); under a seeded :class:`FaultPlan` they
+  deterministically raise, delay or corrupt.
+- :mod:`~bigdl_tpu.reliability.policies` — the primitives the real
+  paths compose: :class:`RetryPolicy` (exponential backoff + jitter +
+  budget), :class:`Deadline` (propagated per-request),
+  :class:`CircuitBreaker`, and the health-check registry behind
+  ``GET /healthz``.
+
+Every retry / shed / breaker trip / injected fault increments a
+``bigdl_reliability_*`` counter in the observability registry, so an
+operator can watch failure handling working on ``/metrics``.
+
+Master switch: ``bigdl.reliability.enabled`` (env
+``BIGDL_TPU_RELIABILITY_ENABLED``). Disabled means structurally absent:
+no plan can be armed, no signal handlers install, no health checks
+register, and checkpoint files keep the exact PR-1 layout.
+"""
+
+from __future__ import annotations
+
+from bigdl_tpu.reliability import _state
+from bigdl_tpu.reliability.faults import (
+    SITES, FaultPlan, InjectedFault, active_plan, armed_sites, inject,
+    set_plan)
+from bigdl_tpu.reliability.policies import (
+    DEADLINE_HEADER, CircuitBreaker, CircuitOpenError, Deadline,
+    DeadlineExceeded, OverloadError, RetryPolicy, TrainingPreempted,
+    health_checks, health_report, register_health, unregister_health)
+
+
+def enabled() -> bool:
+    return _state.enabled
+
+
+def enable():
+    _state.enabled = True
+
+
+def disable():
+    """Structural no-op mode: disarms any plan; subsequent set_plan /
+    register_health calls are rejected / ignored."""
+    _state.enabled = False
+    _state.plan = None
+
+
+def count_shed(component: str):
+    """Record one load-shedding rejection (503 + Retry-After)."""
+    from bigdl_tpu.reliability.policies import _count
+    _count("bigdl_reliability_shed_total",
+           "Requests rejected by admission control",
+           component=component)
+
+
+__all__ = [
+    "DEADLINE_HEADER", "SITES",
+    "CircuitBreaker", "CircuitOpenError", "Deadline", "DeadlineExceeded",
+    "FaultPlan", "InjectedFault", "OverloadError", "RetryPolicy",
+    "TrainingPreempted",
+    "active_plan", "armed_sites", "count_shed", "disable", "enable",
+    "enabled", "health_checks", "health_report", "inject",
+    "register_health", "set_plan", "unregister_health",
+]

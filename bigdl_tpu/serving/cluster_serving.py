@@ -12,15 +12,19 @@ Queue backends:
 
 from __future__ import annotations
 
+import logging
 import queue
 import threading
 import time
 import uuid
 from typing import Dict, Optional
 
+logger = logging.getLogger("bigdl_tpu.serving")
+
 import numpy as np
 
 from bigdl_tpu import observability as obs
+from bigdl_tpu import reliability
 from bigdl_tpu.ppml.protocol import dumps as wire_dumps
 from bigdl_tpu.ppml.protocol import loads as wire_loads
 
@@ -43,9 +47,11 @@ class _Backend:
 
 class _InprocBackend(_Backend):
     def push(self, stream, payload):
+        reliability.inject("serving.backend.push")
         _get_queue(stream).put(payload)
 
     def pop(self, stream, timeout):
+        reliability.inject("serving.backend.pop")
         try:
             return _get_queue(stream).get(timeout=timeout)
         except queue.Empty:
@@ -53,17 +59,64 @@ class _InprocBackend(_Backend):
 
 
 class _RedisBackend(_Backend):
-    def __init__(self, host: str, port: int):
+    """Redis list transport with ISSUE 2 fault handling: every operation
+    runs behind a :class:`~bigdl_tpu.reliability.CircuitBreaker`; a
+    connection-shaped failure drops the client, reconnects under a
+    :class:`~bigdl_tpu.reliability.RetryPolicy` (exponential backoff +
+    jitter) and replays the op. When the durable queue stays down past
+    the retry budget the breaker opens, so callers fail fast instead of
+    stacking blocked threads on a dead socket — the reference's
+    "serving rides on a durable queue" claim needs the *client* side to
+    survive the queue flapping too."""
+
+    def __init__(self, host: str, port: int,
+                 retry: Optional["reliability.RetryPolicy"] = None,
+                 breaker: Optional["reliability.CircuitBreaker"] = None):
+        self._host, self._port = host, port
+        self._retry = retry or reliability.RetryPolicy()
+        self._breaker = breaker or reliability.CircuitBreaker(
+            f"redis:{host}:{port}", failure_threshold=3,
+            reset_timeout=5.0)
+        self._r = None
+        self._connect()
+
+    def _connect(self):
         import redis  # gated: not in the image by default
 
-        self._r = redis.Redis(host=host, port=port)
+        self._r = redis.Redis(host=self._host, port=self._port)
         self._r.ping()
 
+    def reconnects(self) -> int:
+        return getattr(self, "_reconnects", 0)
+
+    def _op(self, site: str, fn):
+        """One queue operation: injection point → breaker gate → retry
+        with reconnect-on-failure. Counted so an operator can watch
+        reconnections on /metrics."""
+        def attempt():
+            reliability.inject(site)
+            if self._r is None:
+                self._connect()
+            return fn()
+
+        def on_retry(exc, n):
+            self._reconnects = getattr(self, "_reconnects", 0) + 1
+            logger.warning("redis op failed (%s); reconnecting "
+                           "(attempt %d)", exc, n)
+            self._r = None   # drop the broken client; attempt reconnects
+
+        return self._breaker.call(
+            self._retry.call, attempt, on_retry=on_retry,
+            component="redis_backend")
+
     def push(self, stream, payload):
-        self._r.rpush(stream, payload)
+        self._op("serving.backend.push",
+                 lambda: self._r.rpush(stream, payload))
 
     def pop(self, stream, timeout):
-        out = self._r.blpop([stream], timeout=max(int(timeout), 1))
+        out = self._op(
+            "serving.backend.pop",
+            lambda: self._r.blpop([stream], timeout=max(int(timeout), 1)))
         return out[1] if out else None
 
 
@@ -178,6 +231,7 @@ class ClusterServing:
         return recs
 
     def _serve_once(self) -> int:
+        reliability.inject("serving.batch")
         recs = self._collect_batch()
         if not recs:
             return 0
@@ -203,9 +257,34 @@ class ClusterServing:
         return len(recs)
 
     def start(self):
+        backoff = reliability.RetryPolicy(max_attempts=1 << 30,
+                                          base_delay=0.01, max_delay=1.0)
+
         def loop():
+            delays = None
             while not self._stop.is_set():
-                if self._serve_once() == 0:
+                try:
+                    n = self._serve_once()
+                except reliability.CircuitOpenError:
+                    # durable queue is down and the breaker is open:
+                    # fail fast, wait for the half-open probe window
+                    time.sleep(0.05)
+                    continue
+                except Exception as e:  # noqa: BLE001 — the job loop
+                    # must survive any single batch failing (injected or
+                    # real): count it, back off, keep serving
+                    from bigdl_tpu.reliability.policies import _count
+                    _count("bigdl_reliability_retries_total",
+                           "Retries performed under a RetryPolicy",
+                           component="cluster_serving")
+                    logger.warning("serving batch failed (%s: %s); "
+                                   "continuing", type(e).__name__, e)
+                    if delays is None:
+                        delays = backoff.delays()
+                    time.sleep(next(delays, 1.0))
+                    continue
+                delays = None   # healthy batch resets the backoff
+                if n == 0:
                     time.sleep(0.002)
 
         self._thread = threading.Thread(target=loop, daemon=True)

@@ -16,10 +16,18 @@ Endpoints (mirroring the reference's REST surface):
 - ``GET /metrics.json``  → the legacy two-field JSON blob
   {"served": N, "pending": M} (the pre-ISSUE-1 ``/metrics`` body, kept
   for old dashboards).
+- ``GET /healthz``  → 200/503 + the reliability health-check registry
+  report (ISSUE 2).
 
 One dispatcher thread owns the OutputQueue: concurrent handlers must
 not each poll the shared stream (they would steal each other's
 results); they wait on per-uri events instead.
+
+Admission control (ISSUE 2): at most ``max_pending`` requests may be in
+flight; the rest are **shed** with 503 + ``Retry-After`` instead of
+growing the pending map without bound. ``stop()`` drains: accepted work
+finishes (up to ``drain_timeout``), new work is shed, then the listener
+closes. Per-request deadlines propagate via ``X-BigDL-Deadline-Ms``.
 """
 
 from __future__ import annotations
@@ -33,6 +41,7 @@ from typing import Dict, Optional
 import numpy as np
 
 from bigdl_tpu import observability as obs
+from bigdl_tpu import reliability
 from bigdl_tpu.serving.cluster_serving import InputQueue, OutputQueue
 
 
@@ -61,16 +70,21 @@ class ServingFrontend:
     def __init__(self, stream_name: str = "serving_stream",
                  backend: str = "inproc", redis_host: str = "localhost",
                  redis_port: int = 6379, host: str = "127.0.0.1",
-                 port: int = 0, result_timeout: float = 30.0):
+                 port: int = 0, result_timeout: float = 30.0,
+                 max_pending: int = 256, drain_timeout: float = 10.0):
         self._in = InputQueue(stream_name, backend, redis_host, redis_port)
         self._out = OutputQueue(stream_name, backend, redis_host,
                                 redis_port)
         self.result_timeout = result_timeout
+        self.max_pending = max_pending
+        self.drain_timeout = drain_timeout
         self._results: Dict[str, np.ndarray] = {}
         self._events: Dict[str, threading.Event] = {}
         self._lock = threading.Lock()
         self._stop = threading.Event()
+        self._draining = threading.Event()
         self.served = 0
+        self.shed = 0
         self._ins = None
 
         frontend = self
@@ -105,8 +119,29 @@ class ServingFrontend:
                         pending = len(frontend._events)
                     self._json(200, {"served": frontend.served,
                                      "pending": pending})
+                elif self.path == "/healthz":
+                    ok, report = reliability.health_report()
+                    draining = frontend._draining.is_set()
+                    self._json(503 if (not ok or draining) else 200,
+                               {"status": "draining" if draining
+                                else ("ok" if ok else "unhealthy"),
+                                "checks": report})
                 else:
                     self._json(404, {"error": "unknown path"})
+
+            def _shed(self, ins, reason: str):
+                frontend.shed += 1
+                reliability.count_shed("serving_frontend")
+                if ins is not None:
+                    ins["requests"].labels(endpoint="/predict",
+                                           status="shed").inc()
+                body = json.dumps({"error": reason}).encode()
+                self.send_response(503)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Retry-After", "1")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
 
             def do_POST(self):
                 ins = frontend._instruments()
@@ -114,6 +149,16 @@ class ServingFrontend:
                     self._json(404, {"error": "unknown path"})
                     return
                 t_req = time.perf_counter()
+                try:
+                    reliability.inject("serving.frontend.request")
+                except reliability.InjectedFault:
+                    self._shed(ins, "injected fault")
+                    return
+                if frontend._draining.is_set():
+                    self._shed(ins, "draining: not accepting work")
+                    return
+                deadline = reliability.Deadline.from_header(
+                    self.headers.get(reliability.DEADLINE_HEADER))
                 try:
                     n = int(self.headers.get("Content-Length", 0))
                     req = json.loads(self.rfile.read(n))
@@ -127,8 +172,19 @@ class ServingFrontend:
                     self._json(400, {"error": f"bad request: {e}"})
                     return
                 with obs.span("serving/predict"):
-                    uri = frontend._submit(req.get("uri"), inputs)
-                    result = frontend._wait(uri)
+                    try:
+                        uri = frontend._submit(req.get("uri"), inputs)
+                        result = frontend._wait(uri, deadline=deadline)
+                    except reliability.OverloadError as e:
+                        # bounded queue: shed instead of unbounded growth
+                        self._shed(ins, str(e))
+                        return
+                    except Exception as e:  # noqa: BLE001 — backend down
+                        # (breaker open / injected): shed, don't 500-hang
+                        if ins is not None:
+                            ins["errors"].inc()
+                        self._shed(ins, f"backend unavailable: {e}")
+                        return
                 latency = time.perf_counter() - t_req
                 if ins is not None:
                     ins["latency"].observe(latency)
@@ -163,14 +219,41 @@ class ServingFrontend:
         return self._ins
 
     def _submit(self, uri: Optional[str], inputs) -> str:
+        import uuid
+        uri = uri or str(uuid.uuid4())
         with self._lock:
-            uri = self._in.enqueue(uri, **inputs)
+            # admission bound checked under the SAME lock that registers
+            # the entry: concurrent handlers cannot overshoot max_pending
+            if len(self._events) >= self.max_pending:
+                raise reliability.OverloadError(
+                    f"overloaded: {self.max_pending} requests already "
+                    "pending")
             self._events[uri] = threading.Event()
+        # enqueue OUTSIDE the lock: the redis backend may sleep through a
+        # reconnect-backoff schedule, and holding the lock then would
+        # stall the dispatcher, every other handler and /healthz.
+        # Registering the event first is safe — the dispatcher only
+        # stores results for registered waiters
+        try:
+            self._in.enqueue(uri, **inputs)
+        except BaseException:
+            with self._lock:
+                self._events.pop(uri, None)
+                self._results.pop(uri, None)
+            raise
         return uri
 
-    def _wait(self, uri: str) -> Optional[np.ndarray]:
+    def _wait(self, uri: str, deadline=None) -> Optional[np.ndarray]:
+        """Block for the result. On timeout (or propagated-deadline
+        expiry) the pending entry AND any late-stored result are evicted
+        under the lock — a timed-out request must leave no residue in
+        ``_results``/``_events`` (the ISSUE 2 leak fix, regression-tested
+        in tests/test_reliability.py)."""
+        timeout = self.result_timeout
+        if deadline is not None:
+            timeout = max(min(timeout, deadline.remaining()), 0.0)
         ev = self._events[uri]
-        if not ev.wait(self.result_timeout):
+        if not ev.wait(timeout):
             with self._lock:
                 self._events.pop(uri, None)
                 # the dispatcher may have stored the result in the window
@@ -184,7 +267,13 @@ class ServingFrontend:
 
     def _dispatch_loop(self):
         while not self._stop.is_set():
-            got = self._out.dequeue(timeout=0.1)
+            try:
+                got = self._out.dequeue(timeout=0.1)
+            except Exception:  # noqa: BLE001 — the sole dispatcher must
+                # outlive transient backend faults (injected or real);
+                # waiters time out individually, the loop keeps draining
+                time.sleep(0.01)
+                continue
             if got is None:
                 continue
             uri, result = got
@@ -207,9 +296,34 @@ class ServingFrontend:
         ]
         for t in self._threads:
             t.start()
+        self._health_name = f"serving_frontend:{self.address[1]}"
+        reliability.register_health(self._health_name, self._health)
         return self
 
-    def stop(self):
+    def _health(self):
+        with self._lock:
+            pending = len(self._events)
+        dispatcher = self._threads[0] if getattr(self, "_threads", None) \
+            else None
+        return {"ok": dispatcher is not None and dispatcher.is_alive()
+                and not self._draining.is_set(),
+                "pending": pending, "served": self.served,
+                "shed": self.shed}
+
+    def stop(self, drain: bool = True):
+        """Graceful drain (default): stop admitting, let accepted work
+        publish its results (bounded by ``drain_timeout``), then tear
+        down. ``drain=False`` is the old hard stop."""
+        self._draining.set()
+        if drain:
+            deadline = time.monotonic() + self.drain_timeout
+            while time.monotonic() < deadline:
+                with self._lock:
+                    if not self._events:
+                        break
+                time.sleep(0.01)
+        reliability.unregister_health(
+            getattr(self, "_health_name", ""))
         self._stop.set()
         self._httpd.shutdown()
         self._httpd.server_close()

@@ -18,21 +18,47 @@ portable across bigdl_tpu versions and across processes that never import
 the producing classes. ``Module.save_module`` keeps a ``structure.pkl``
 *sidecar* for same-version convenience reconstruction, but weights are
 always loadable without it via :func:`load_checkpoint`.
+
+**Crash safety (ISSUE 2).** Writes are atomic: everything lands in a
+``<path>.tmp-*`` sibling, every file is fsynced, and one ``os.rename``
+publishes the directory — a reader can never observe arrays without a
+manifest (the seed's ordering bug) or a half-written file. The manifest
+carries a per-file SHA-256 (``files`` key — extra JSON the PR-1 loader
+ignores, so the on-disk layout is unchanged); :func:`load_checkpoint`
+verifies it and raises :class:`CheckpointCorruptError` on mismatch, and
+:func:`latest` skips (and quarantines) incomplete or corrupt directories
+so recovery never resumes from garbage. Fault-injection sites:
+``checkpoint.write`` / ``.write.arrays`` (corrupt-capable) /
+``.write.manifest`` / ``.commit`` / ``checkpoint.load``.
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
+import logging
 import os
-from typing import Any, Dict, Optional, Tuple
+import shutil
+import uuid
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
+
+from bigdl_tpu import reliability
+
+logger = logging.getLogger("bigdl_tpu.checkpoint")
 
 FORMAT_NAME = "bigdl_tpu.checkpoint"
 FORMAT_VERSION = 1
 
 _ARRAYS_FILE = "arrays.safetensors"
 _MANIFEST_FILE = "manifest.json"
+_TMP_MARK = ".tmp-"
+_CORRUPT_MARK = ".corrupt-"
+
+
+class CheckpointCorruptError(ValueError):
+    """The checkpoint's bytes do not match its manifest checksums."""
 
 
 def _flatten(tree: Any, prefix: str, arrays: Dict[str, np.ndarray]) -> Any:
@@ -69,34 +95,252 @@ def _unflatten(node: Any, arrays: Dict[str, np.ndarray]) -> Any:
     raise ValueError(f"unknown node type {t!r} in checkpoint manifest")
 
 
+def _sha256(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for block in iter(lambda: f.read(1 << 20), b""):
+            h.update(block)
+    return h.hexdigest()
+
+
+def _fsync_file(path: str):
+    with open(path, "rb") as f:
+        os.fsync(f.fileno())
+
+
+def _fsync_dir(path: str):
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:       # platforms without O_RDONLY dir opens
+        return
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _corrupt_file(path: str):
+    """Flip one byte in the middle of ``path`` (the injected-corruption
+    action: a realistic torn write the checksums must catch)."""
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.seek(size // 2)
+        b = f.read(1)
+        f.seek(size // 2)
+        f.write(bytes([b[0] ^ 0xFF]) if b else b"\xff")
+
+
 def save_checkpoint(path: str, tree: Any,
-                    metadata: Optional[Dict[str, Any]] = None) -> str:
+                    metadata: Optional[Dict[str, Any]] = None,
+                    extra_files: Optional[Dict[str, bytes]] = None) -> str:
     """Persist a pytree (dicts/lists/tuples/scalars/arrays) to ``path``.
 
     jax arrays are pulled to host; bf16 round-trips via ml_dtypes.
+
+    Atomic visibility: arrays, ``extra_files`` sidecars and the manifest
+    (which carries each file's SHA-256) land in a temp sibling, are
+    fsynced, and a rename publishes the directory — a reader can never
+    observe a torn checkpoint. Fresh saves survive a crash at any point
+    (previous state or an ignorable ``.tmp-*`` orphan). Overwriting an
+    EXISTING directory has one unavoidable non-torn window (there is no
+    portable atomic directory swap): a crash between the move-aside and
+    the publish leaves that one tag absent — ``latest()`` then falls
+    back to the next-newest valid tag, so recovery degrades by one
+    checkpoint rather than loading garbage.
     """
     from safetensors.numpy import save_file
 
-    os.makedirs(path, exist_ok=True)
-    arrays: Dict[str, np.ndarray] = {}
-    structure = _flatten(tree, "", arrays)
-    manifest = {
-        "format": FORMAT_NAME,
-        "version": FORMAT_VERSION,
-        "tree": structure,
-        "metadata": metadata or {},
-    }
-    save_file(arrays, os.path.join(path, _ARRAYS_FILE))
-    with open(os.path.join(path, _MANIFEST_FILE), "w") as f:
-        json.dump(manifest, f, indent=1)
+    reliability.inject("checkpoint.write")
+    path = path.rstrip("/")
+    parent = os.path.dirname(os.path.abspath(path))
+    os.makedirs(parent, exist_ok=True)
+    tmp = f"{path}{_TMP_MARK}{os.getpid()}-{uuid.uuid4().hex[:8]}"
+    os.makedirs(tmp)
+    try:
+        arrays: Dict[str, np.ndarray] = {}
+        structure = _flatten(tree, "", arrays)
+        save_file(arrays, os.path.join(tmp, _ARRAYS_FILE))
+        # "corrupt" flips a byte AFTER the checksums are computed (below)
+        # — modelling bit-rot/torn writes the manifest doesn't reflect,
+        # which is exactly what load-time verification must catch
+        corrupt_arrays = \
+            reliability.inject("checkpoint.write.arrays") == "corrupt"
+        for name, blob in (extra_files or {}).items():
+            with open(os.path.join(tmp, name), "wb") as f:
+                f.write(blob)
+        # the seed's ordering bug lived here: arrays visible, manifest
+        # not yet — this site lets the regression test kill the writer
+        # between the two writes and assert the partial dir never loads
+        reliability.inject("checkpoint.write.manifest")
+        files = {name: {"sha256": _sha256(os.path.join(tmp, name)),
+                        "bytes": os.path.getsize(os.path.join(tmp, name))}
+                 for name in os.listdir(tmp)}
+        manifest = {
+            "format": FORMAT_NAME,
+            "version": FORMAT_VERSION,
+            "tree": structure,
+            "metadata": metadata or {},
+            "files": files,
+        }
+        with open(os.path.join(tmp, _MANIFEST_FILE), "w") as f:
+            json.dump(manifest, f, indent=1)
+            f.flush()
+            os.fsync(f.fileno())
+        for name in files:
+            _fsync_file(os.path.join(tmp, name))
+        _fsync_dir(tmp)
+        if corrupt_arrays:
+            _corrupt_file(os.path.join(tmp, _ARRAYS_FILE))
+        reliability.inject("checkpoint.commit")
+        if os.path.isdir(path):
+            # directories can't be renamed over: move the old one aside
+            # first so the destination slot is only ever empty or whole
+            aside = f"{path}{_TMP_MARK}old-{uuid.uuid4().hex[:8]}"
+            os.rename(path, aside)
+            os.rename(tmp, path)
+            shutil.rmtree(aside, ignore_errors=True)
+        else:
+            if os.path.isfile(path):
+                os.remove(path)   # legacy single-file checkpoint
+            os.rename(tmp, path)
+        _fsync_dir(parent)
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
     return path
 
 
-def load_checkpoint(path: str, to_jax: bool = True
+def verify_checkpoint(path: str) -> bool:
+    """True iff ``path`` is a complete checkpoint whose bytes match the
+    manifest checksums. Manifests without a ``files`` key (pre-ISSUE-2)
+    verify on existence only."""
+    try:
+        with open(os.path.join(path, _MANIFEST_FILE)) as f:
+            manifest = json.load(f)
+        if manifest.get("format") != FORMAT_NAME:
+            return False
+        if not os.path.exists(os.path.join(path, _ARRAYS_FILE)):
+            return False
+        for name, info in (manifest.get("files") or {}).items():
+            fp = os.path.join(path, name)
+            if not os.path.exists(fp):
+                return False
+            if info.get("sha256") and _sha256(fp) != info["sha256"]:
+                return False
+        return True
+    except (OSError, ValueError):
+        return False
+
+
+def quarantine_checkpoint(path: str) -> Optional[str]:
+    """Move a corrupt/incomplete checkpoint aside (``<path>.corrupt-N``)
+    so no future ``latest()`` scan can pick it again; returns the new
+    location (None if the move failed). Counted on /metrics. No-op when
+    the reliability layer is disabled — a disabled process must neither
+    rearrange on-disk layout nor mint reliability series (``latest()``
+    still *skips* the bad candidate either way)."""
+    if not reliability.enabled():
+        return None
+    base = path.rstrip("/")
+    for n in range(1000):
+        target = f"{base}{_CORRUPT_MARK}{n}"
+        if not os.path.exists(target):
+            try:
+                os.rename(base, target)
+            except OSError:
+                return None
+            from bigdl_tpu.reliability.policies import _count
+            _count("bigdl_reliability_checkpoints_quarantined_total",
+                   "Corrupt/incomplete checkpoints moved aside during "
+                   "recovery scans")
+            logger.warning("quarantined corrupt checkpoint %s -> %s",
+                           base, target)
+            return target
+    return None
+
+
+def _tag_sort_key(tag: str):
+    try:
+        return tuple(int(p) for p in tag.split("."))
+    except ValueError:
+        return (-1,)
+
+
+def list_checkpoint_tags(root: str, prefix: str = "optim.") -> List[str]:
+    """Tags of ``<prefix><tag>`` entries under ``root``, oldest first;
+    ``.tmp-*`` orphans and ``.corrupt-*`` quarantine dirs are ignored."""
+    if not os.path.isdir(root):
+        return []
+    tags = []
+    for name in os.listdir(root):
+        if not name.startswith(prefix) or _TMP_MARK in name \
+                or _CORRUPT_MARK in name:
+            continue
+        tag = name[len(prefix):]
+        if _tag_sort_key(tag) != (-1,):
+            tags.append(tag)
+    return sorted(tags, key=_tag_sort_key)
+
+
+def latest(root: str, prefix: str = "optim.",
+           paired_prefix: Optional[str] = None,
+           quarantine: bool = True) -> Optional[str]:
+    """Newest **valid** checkpoint tag under ``root`` — incomplete or
+    corrupt candidates are skipped (and quarantined, so the next scan
+    is cheap) instead of happily loaded, which is the whole point.
+
+    ``paired_prefix`` additionally requires a valid sibling (the
+    optimizer's ``model.<tag>`` + ``optim.<tag>`` pair: a tag with only
+    half the pair intact is not resumable)."""
+    for tag in reversed(list_checkpoint_tags(root, prefix)):
+        members = [os.path.join(root, prefix + tag)]
+        if paired_prefix is not None:
+            members.append(os.path.join(root, paired_prefix + tag))
+        bad = [m for m in members if not verify_checkpoint(m)]
+        if not bad:
+            return tag
+        if quarantine:
+            for m in bad:
+                if os.path.isdir(m):
+                    quarantine_checkpoint(m)
+    return None
+
+
+def prune_checkpoints(root: str, keep: int,
+                      prefixes=("model.", "optim.")) -> List[str]:
+    """Retention: delete all but the newest ``keep`` tags (and any
+    ``.tmp-*`` orphans left by crashed writers). ``keep <= 0`` keeps
+    everything. Returns the pruned tags."""
+    if keep <= 0:
+        return []
+    if os.path.isdir(root):
+        for name in os.listdir(root):
+            if _TMP_MARK in name:
+                shutil.rmtree(os.path.join(root, name),
+                              ignore_errors=True)
+    tags = sorted({t for p in prefixes
+                   for t in list_checkpoint_tags(root, p)},
+                  key=_tag_sort_key)
+    doomed = tags[:-keep] if len(tags) > keep else []
+    for tag in doomed:
+        for p in prefixes:
+            target = os.path.join(root, p + tag)
+            if os.path.isdir(target):
+                shutil.rmtree(target, ignore_errors=True)
+    return doomed
+
+
+def load_checkpoint(path: str, to_jax: bool = True, verify: bool = True
                     ) -> Tuple[Any, Dict[str, Any]]:
-    """Load ``(tree, metadata)`` saved by :func:`save_checkpoint`."""
+    """Load ``(tree, metadata)`` saved by :func:`save_checkpoint`.
+
+    ``verify`` (default) checks the manifest's per-file SHA-256 before
+    deserializing and raises :class:`CheckpointCorruptError` on
+    mismatch; pre-ISSUE-2 checkpoints (no ``files`` key) skip the check.
+    """
     from safetensors.numpy import load_file
 
+    reliability.inject("checkpoint.load")
     with open(os.path.join(path, _MANIFEST_FILE)) as f:
         manifest = json.load(f)
     if manifest.get("format") != FORMAT_NAME:
@@ -105,6 +349,16 @@ def load_checkpoint(path: str, to_jax: bool = True
         raise ValueError(
             f"checkpoint version {manifest['version']} is newer than this "
             f"build supports ({FORMAT_VERSION})")
+    if verify:
+        for name, info in (manifest.get("files") or {}).items():
+            fp = os.path.join(path, name)
+            if not os.path.exists(fp):
+                raise CheckpointCorruptError(
+                    f"{path}: manifest names {name} but it is missing")
+            if info.get("sha256") and _sha256(fp) != info["sha256"]:
+                raise CheckpointCorruptError(
+                    f"{path}: {name} does not match its manifest sha256 "
+                    "(torn or corrupted write)")
     arrays = load_file(os.path.join(path, _ARRAYS_FILE))
     tree = _unflatten(manifest["tree"], arrays)
     if to_jax:

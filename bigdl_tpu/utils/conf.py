@@ -42,6 +42,11 @@ _DEFAULTS: Dict[str, str] = {
     "bigdl.checkpoint.overwrite": "true",
     "bigdl.observability.enabled": "true",    # metrics + trace spans
     "bigdl.observability.trace.capacity": "65536",  # span ring entries
+    "bigdl.reliability.enabled": "true",      # fault sites + policies
+    "bigdl.reliability.retry.max.attempts": "3",   # tries, not retries
+    "bigdl.reliability.retry.base.delay": "0.05",  # seconds
+    "bigdl.reliability.retry.max.delay": "2.0",    # backoff cap
+    "bigdl.checkpoint.keep": "0",             # retention; 0 = unlimited
 }
 
 
@@ -93,6 +98,12 @@ class BigDLConf:
         if key.startswith("bigdl.observability."):
             try:
                 from bigdl_tpu.observability import _state
+                _state.refresh(key)
+            except Exception:
+                pass
+        elif key.startswith("bigdl.reliability."):
+            try:
+                from bigdl_tpu.reliability import _state
                 _state.refresh(key)
             except Exception:
                 pass

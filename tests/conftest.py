@@ -26,6 +26,15 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: excluded from the tier-1 gate (-m 'not slow')")
+    config.addinivalue_line(
+        "markers",
+        "chaos: seeded fault-injection chaos runs (always also slow: "
+        "tier-1 filters on 'not slow')")
+
+
 @pytest.fixture(scope="session")
 def devices():
     devs = jax.devices()

@@ -1,0 +1,132 @@
+"""Chaos harness (ISSUE 2 satellite): train the LeNet example under a
+randomized-but-seeded fault-injection plan and assert the final loss
+matches an uninjected run.
+
+The determinism argument: the data pipeline is unshuffled, recovery
+replays from the last epoch-boundary checkpoint with the exact batch
+order, delays change no math, and corrupt checkpoint writes are
+quarantined at restore time — so every injected schedule must converge
+to the SAME final loss as the clean run. Any divergence means a failure
+path dropped or replayed work incorrectly, which is precisely what this
+harness exists to catch.
+
+Usage:
+    python tools/chaos_check.py [--seed N] [--events K] [--full]
+
+Wired into ``bench.py``'s telemetry block as a smoke invocation and into
+pytest as ``-m chaos`` (kept out of tier-1 by the ``slow`` marker).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+from typing import Optional
+
+import numpy as np
+
+# runnable as `python tools/chaos_check.py` from the repo root: the
+# script dir is on sys.path then, the package root is not
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _train_once(n: int, epochs: int, batch: int, ckpt_dir: Optional[str],
+                max_retry: int = 0) -> float:
+    """One deterministic LeNet training run (the examples/lenet_mnist
+    model over synthetic digits, unshuffled) → final loss."""
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.feature.dataset import LocalDataSet
+    from bigdl_tpu.models.lenet import build_model
+    from bigdl_tpu.nn.module import set_seed
+    from bigdl_tpu.optim.optimizer import LocalOptimizer
+    from bigdl_tpu.optim.trigger import Trigger
+
+    set_seed(0)
+    rs = np.random.RandomState(0)
+    x = rs.rand(n, 1, 28, 28).astype(np.float32)
+    y = (rs.randint(0, 10, n) + 1).astype(np.int32)
+    model = build_model(10)
+    opt = LocalOptimizer(model, LocalDataSet(x, y, shuffle=False),
+                         nn.ClassNLLCriterion(), batch_size=batch,
+                         end_trigger=Trigger.max_epoch(epochs))
+    if ckpt_dir:
+        opt.set_checkpoint(ckpt_dir, Trigger.every_epoch())
+    if max_retry:
+        opt.set_max_retry(max_retry)
+    opt.optimize()
+    return float(opt.state["loss"])
+
+
+def run_chaos(seed: int = 0, events: int = 5, smoke: bool = True,
+              rtol: float = 1e-4) -> dict:
+    """The harness: clean run, then the same run under an armed seeded
+    plan (kill/corrupt/delay events over the training+checkpoint sites),
+    assert the final losses match. Returns the comparison record."""
+    from bigdl_tpu import reliability as rel
+
+    n, epochs, batch = (64, 3, 16) if smoke else (256, 5, 32)
+    was_enabled = rel.enabled()
+    if not was_enabled:
+        rel.enable()
+    try:
+        clean = _train_once(n, epochs, batch, ckpt_dir=None)
+
+        # the injected run: faults target the recovery-relevant sites;
+        # the retry budget outnumbers the raise events so training
+        # always completes; seeded => exactly reproducible
+        plan = rel.FaultPlan(seed=seed).randomize(
+            events, sites=("optimizer.step", "checkpoint.write",
+                           "checkpoint.write.manifest",
+                           "checkpoint.commit", "optimizer.checkpoint"))
+        with tempfile.TemporaryDirectory() as ckpt_dir:
+            rel.set_plan(plan)
+            try:
+                injected = _train_once(n, epochs, batch,
+                                       ckpt_dir=ckpt_dir,
+                                       max_retry=events + 1)
+            finally:
+                rel.set_plan(None)
+    finally:
+        if not was_enabled:
+            rel.disable()   # leave the process how we found it
+
+    match = bool(np.isclose(clean, injected, rtol=rtol, atol=1e-6))
+    out = {
+        "seed": seed,
+        "events_armed": events,
+        "events_fired": [f"{s}:{a}" for s, a in plan.fired],
+        "clean_loss": clean,
+        "injected_loss": injected,
+        "match": match,
+    }
+    if not match:
+        raise AssertionError(
+            f"chaos divergence: clean loss {clean} vs injected "
+            f"{injected} (fired: {out['events_fired']})")
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--events", type=int, default=5)
+    ap.add_argument("--full", action="store_true",
+                    help="bigger model/data than the smoke default")
+    ap.add_argument("--cpu", action="store_true",
+                    help="force the CPU backend (sitecustomize pins the "
+                         "axon TPU platform; env vars are ineffective)")
+    args = ap.parse_args()
+    if args.cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    out = run_chaos(seed=args.seed, events=args.events,
+                    smoke=not args.full)
+    print(json.dumps(out, indent=1))
+
+
+if __name__ == "__main__":
+    main()
