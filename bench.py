@@ -99,20 +99,19 @@ def _bench_train(model, make_batch, metric: str, batch_size: int,
         new_params, new_opt = optim.step(params, grads, opt_state, lr)
         return new_params, new_states, new_opt, loss
 
-    step = jax.jit(train_step, donate_argnums=(0, 1, 2))
+    # ISSUE 3: the flight-recorder wrapper records this step's compile
+    # time and cost/memory analysis under bench/<metric> in the
+    # telemetry block, so the MFU below is attributed to the executable
+    # that actually ran (the bigdl_xla_* gauges carry the same numbers)
+    from bigdl_tpu import observability as obs
+    step = obs.compiled(train_step, name=f"bench/{metric}",
+                        donate_argnums=(0, 1, 2))
     # rotate over several distinct batches so the loop is not single-batch
     # memorization (VERDICT r1 weak #10)
     batches = [make_batch() for _ in range(n_batches)]
     from bigdl_tpu.utils.engine import train_rng_key
     key = train_rng_key(0)   # hardware RBG on TPU: threefry dropout
     # masks alone cost ~40% of a BERT step (see engine.train_rng_key)
-
-    key, sub = jax.random.split(key)
-    lowered = step.lower(params, states, opt_state, *batches[0], sub)
-    compiled = lowered.compile()
-    ca = _cost_analysis(compiled)
-    flops_per_step = float(ca.get("flops") or 0) or None
-    bytes_per_step = float(ca.get("bytes accessed") or 0) or None
 
     for i in range(warmup):
         key, sub = jax.random.split(key)
@@ -139,6 +138,24 @@ def _bench_train(model, make_batch, metric: str, batch_size: int,
                                                *batches[0], sub)
         float(loss)
         sync_times.append(time.perf_counter() - s0)
+
+    # cost analysis comes from the flight recorder's ledger — i.e. from
+    # the very executable the loop above dispatched (attributed, and no
+    # duplicate compile). Manual lower+compile only as the fallback when
+    # the recorder saw nothing (observability disabled).
+    entry = {}
+    stats_fn = getattr(step, "stats", None)
+    if stats_fn is not None:
+        hist = stats_fn()["history"]
+        entry = hist[0] if hist else {}
+    flops_per_step = entry.get("flops")
+    bytes_per_step = entry.get("bytes_accessed")
+    if flops_per_step is None:
+        key, sub = jax.random.split(key)
+        ca = _cost_analysis(step.lower(params, states, opt_state,
+                                       *batches[0], sub).compile())
+        flops_per_step = float(ca.get("flops") or 0) or None
+        bytes_per_step = float(ca.get("bytes accessed") or 0) or None
 
     dev = jax.devices()[0]
     peak = _peak_flops(dev)
@@ -859,6 +876,10 @@ def _telemetry_block() -> dict:
         "metrics": summarize_registry(),
         "spans": summarize_trace(
             {"traceEvents": obs.TRACE.spans()})["spans"],
+        # ISSUE 3 flight recorder: per-jit-entry-point compile history
+        # (count, seconds, cost/memory analysis, recompile signatures)
+        # — the MFU numbers above are attributed to these executables
+        "compiles": obs.compile_stats(),
     }
     try:
         from tools.chaos_check import run_chaos
@@ -866,6 +887,28 @@ def _telemetry_block() -> dict:
     except Exception as e:  # never lose the telemetry to the chaos run
         out["chaos_smoke"] = {"error": repr(e)}
     return out
+
+
+def _regress_block() -> dict:
+    """Optional north-star regression diff (ISSUE 3 satellite): compare
+    the newest two driver-recorded BENCH_r*.json rounds and flag moves
+    past the warn threshold; one compact breadcrumb line is appended to
+    PROGRESS.jsonl. Never fails the bench."""
+    import os
+    root = os.path.dirname(os.path.abspath(__file__))
+    try:
+        from tools.bench_regress import compare_latest
+        out = compare_latest(
+            root, progress_path=os.path.join(root, "PROGRESS.jsonl"))
+        if out is None:
+            return {"note": "fewer than two BENCH_r*.json rounds"}
+        # compact: the full per-metric table is reproducible offline via
+        # tools/bench_regress.py; the record keeps only the verdict
+        return {"base": out["base"], "head": out["head"],
+                "warn_pct": out["warn_pct"],
+                "metrics": len(out["deltas"]), "warned": out["warned"]}
+    except Exception as e:
+        return {"error": repr(e)}
 
 
 def _default_run(quick: bool) -> dict:
@@ -894,6 +937,7 @@ def _default_run(quick: bool) -> dict:
             out["extra"]["telemetry"] = _telemetry_block()
         except Exception as e:
             out["extra"]["telemetry"] = {"error": repr(e)}
+        out["extra"]["regress"] = _regress_block()
         return out
     out = bench_resnet50_train()
     try:
@@ -933,6 +977,7 @@ def _default_run(quick: bool) -> dict:
         out["extra"]["telemetry"] = _telemetry_block()
     except Exception as e:
         out["extra"]["telemetry"] = {"error": repr(e)}
+    out["extra"]["regress"] = _regress_block()
     return out
 
 

@@ -39,6 +39,7 @@ import numpy as np
 
 from bigdl_tpu import observability as obs
 from bigdl_tpu import reliability
+from bigdl_tpu.observability import request_context as rc
 
 
 def _llm_instruments():
@@ -225,6 +226,12 @@ class Request:
         self.tokens: List[int] = []
         self.error: Optional[str] = None
         self.done = threading.Event()
+        # distributed tracing (ISSUE 3): the submitter's ambient context
+        # rides the handle into the engine thread (contextvars don't
+        # cross threads); None when no trace / observability disabled
+        self.trace = rc.to_wire(rc.current())
+        self.submitted_at = time.time() if self.trace else 0.0
+        self.decode_started_at = 0.0
 
     def get(self, timeout: Optional[float] = None) -> List[int]:
         if not self.done.wait(timeout):
@@ -321,8 +328,13 @@ class LLMServer:
                                jnp.float32)
         self._stop = threading.Event()
         self._lock = threading.Lock()
-        self._fwd = jax.jit(functools.partial(self._fam_forward,
-                                              cfg=self.cfg))
+        # ISSUE 3 flight recorder: every jit entry point of the engine
+        # is wrapped so compiles/recompiles (the per-length prefill
+        # buckets, a batch-width drift on the decode step) are counted,
+        # timed and HBM-attributed on /metrics
+        self._fwd = obs.compiled(
+            functools.partial(self._fam_forward, cfg=self.cfg),
+            name="llm/forward")
         self._thread: Optional[threading.Thread] = None
         self.steps = 0
         self._ins = None     # declared lazily: see _instruments()
@@ -444,10 +456,21 @@ class LLMServer:
                     return
                 self._budget_avail -= budget
                 self._slot_budget[i] = budget
+            ctx = rc.from_wire(req.trace)
+            if ctx is not None and req.submitted_at:
+                # engine-side admission wait, parented to the submitter
+                args = ({"parent_span": ctx.span_id}
+                        if ctx.span_id else {})
+                obs.add_complete(
+                    "llm/queue_wait", req.submitted_at,
+                    time.time() - req.submitted_at, trace=ctx.trace_id,
+                    stage="queue", request=req.id, **args)
             t0 = time.perf_counter()
             try:
-                with obs.span("llm/prefill", slot=i,
-                              tokens=len(req.prompt_ids)):
+                with rc.activate(ctx), \
+                        obs.span("llm/prefill", slot=i,
+                                 tokens=len(req.prompt_ids),
+                                 stage="llm_server", request=req.id):
                     (self._prefill_paged if self.paged
                      else self._prefill_slot)(i, req)
             except BaseException as e:
@@ -460,6 +483,7 @@ class LLMServer:
                 req.error = f"{type(e).__name__}: {e}"
                 req.done.set()
                 raise
+            req.decode_started_at = time.time()
             self._record_prefill(len(req.prompt_ids),
                                  time.perf_counter() - t0)
 
@@ -574,7 +598,8 @@ class LLMServer:
                                                 keepdims=False)
             return k_pages, v_pages, last.astype(jnp.float32)
 
-        return jax.jit(build, donate_argnums=(1, 2))
+        return obs.compiled(build, name="llm/prefill_paged",
+                            donate_argnums=(1, 2))
 
     def _prefill_paged(self, i: int, req: Request):
         t = len(req.prompt_ids)
@@ -620,7 +645,8 @@ class LLMServer:
             return fam_step(params, cfg, k_pages, v_pages, bt,
                             lens, toks[:, 0], page=page)
 
-        return jax.jit(step, donate_argnums=(1, 2))
+        return obs.compiled(step, name="llm/decode_paged",
+                            donate_argnums=(1, 2))
 
     def _record_decode(self, n_active: int, seconds: float,
                        finished: int):
@@ -639,6 +665,20 @@ class LLMServer:
         if finished:
             ins["requests"].labels(reason="done").inc(finished)
         self._record_kv_gauges(ins)
+
+    def _emit_decode_span(self, req: Request):
+        """One ``llm/decode`` span covering a finished request's whole
+        decode phase, stitched under its trace — decode steps are shared
+        by every active slot, so the per-request attribution has to be
+        emitted per request, not per step."""
+        if not req.trace or not req.decode_started_at:
+            return
+        args = {"trace": req.trace["trace_id"], "stage": "llm_server",
+                "request": req.id, "tokens": len(req.tokens)}
+        if req.trace.get("parent_span"):
+            args["parent_span"] = req.trace["parent_span"]
+        obs.add_complete("llm/decode", req.decode_started_at,
+                         time.time() - req.decode_started_at, **args)
 
     def _step_paged(self) -> bool:
         active = [i for i, r in enumerate(self._slots) if r is not None]
@@ -673,6 +713,7 @@ class LLMServer:
             if (self.eos_token_id is not None
                     and tok == self.eos_token_id) \
                     or self._remaining[i] <= 0:
+                self._emit_decode_span(req)
                 req.done.set()
                 self._slots[i] = None
                 self._free.extend(self._slot_pages[i])
@@ -710,6 +751,7 @@ class LLMServer:
             self._pos[i] += 1
             if (self.eos_token_id is not None and tok == self.eos_token_id) \
                     or self._remaining[i] <= 0:
+                self._emit_decode_span(req)
                 req.done.set()
                 self._slots[i] = None
                 # freed slot restarts at position 0: stale kv beyond the
@@ -779,7 +821,8 @@ class LLMServer:
                     logits = _linear(head, x)
                 return logits[:, 0].astype(jnp.float32), k_new, v_new
 
-            self._scatter_step = jax.jit(step)
+            self._scatter_step = obs.compiled(step,
+                                              name="llm/decode_slotted")
 
         logits, k_new, v_new = self._scatter_step(
             self.model.params, self._cache["k"], self._cache["v"],

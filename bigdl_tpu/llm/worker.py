@@ -14,6 +14,15 @@ Endpoints:
   "speed": tokens/s since start}
 - ``GET  /healthz``                200/503 + engine-thread liveness and
   the reliability health-check registry (ISSUE 2)
+- ``GET  /debug/trace/<trace_id>`` stitched per-request trace;
+  ``GET /debug/traces`` slowest-N latency exemplars (ISSUE 3)
+
+Distributed tracing (ISSUE 3): the generate endpoints read the
+case-insensitive ``X-BigDL-Trace-Id``/``X-BigDL-Parent-Span`` headers
+(minting a fresh trace when absent), activate the context so the
+engine's queue-wait/prefill/decode spans stitch under the request, and
+echo ``X-BigDL-Trace-Id`` on the response. Disabled observability emits
+no trace headers at all.
 
 Backpressure (ISSUE 2): when the engine's bounded queue rejects a
 submit (``OverloadError``) the worker sheds with **503 + Retry-After**
@@ -34,7 +43,10 @@ from typing import Optional
 
 import numpy as np
 
+from bigdl_tpu import observability as obs
 from bigdl_tpu import reliability
+from bigdl_tpu.observability import request_context as rc
+from bigdl_tpu.observability import tracing
 
 
 class LLMWorker:
@@ -60,6 +72,12 @@ class LLMWorker:
                 self.send_header("Content-Type", "application/json")
                 for k, v in headers:
                     self.send_header(k, v)
+                # echo the request's trace id (absent in disabled mode).
+                # keep-alive reuses this handler: _trace is reset at the
+                # top of every do_GET/do_POST, so no cross-request leak
+                trace_id = getattr(self, "_trace", None)
+                if trace_id:
+                    self.send_header(rc.TRACE_HEADER, trace_id)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
@@ -99,7 +117,11 @@ class LLMWorker:
                                deadline.remaining()), 0.0)
 
             def do_GET(self):
-                if self.path == "/worker_get_status":
+                self._trace = None
+                debug = tracing.debug_endpoint(self.path)
+                if debug is not None:
+                    self._json(*debug)
+                elif self.path == "/worker_get_status":
                     dt = max(time.time() - worker._t0, 1e-9)
                     self._json(200, {
                         "model": worker.model_name,
@@ -134,23 +156,51 @@ class LLMWorker:
                     self._json(404, {"error": "unknown path"})
 
             def do_POST(self):
+                self._trace = None
+                ctx = None
+                if self.path in ("/worker_generate",
+                                 "/worker_generate_stream"):
+                    # case-insensitive trace extraction (or a fresh
+                    # root); None in disabled mode — no headers emitted
+                    ctx = rc.server_context(self.headers)
+                    if ctx is not None:
+                        self._trace = ctx.trace_id
                 if self.path == "/worker_generate":
                     try:
                         ids, mnt = self._read_req()
                     except Exception as e:  # noqa: BLE001
                         self._json(400, {"error": f"bad request: {e}"})
                         return
-                    req = self._submit(ids, mnt)
-                    if req is None:
-                        return
-                    try:
-                        toks = req.get(timeout=self._wait_timeout())
-                    except TimeoutError:
-                        self._json(504, {"error": "generation timed out"})
-                        return
-                    except RuntimeError as e:   # engine failed the req
-                        self._json(500, {"error": str(e)})
-                        return
+                    t_req = time.perf_counter()
+                    with rc.activate(ctx), \
+                            obs.span("llm/request", stage="llm_worker",
+                                     max_new_tokens=mnt):
+                        req = self._submit(ids, mnt)
+                        if req is None:
+                            return
+                        try:
+                            toks = req.get(timeout=self._wait_timeout())
+                        except TimeoutError:
+                            # timed-out requests are by definition the
+                            # slowest — excluding them would make the
+                            # exemplar store lie about the tail
+                            if ctx is not None:
+                                obs.EXEMPLARS.offer(
+                                    ctx.trace_id,
+                                    time.perf_counter() - t_req,
+                                    name="llm/request", request=req.id,
+                                    status="timeout")
+                            self._json(504,
+                                       {"error": "generation timed out"})
+                            return
+                        except RuntimeError as e:  # engine failed it
+                            self._json(500, {"error": str(e)})
+                            return
+                    if ctx is not None:
+                        obs.EXEMPLARS.offer(
+                            ctx.trace_id, time.perf_counter() - t_req,
+                            name="llm/request", request=req.id,
+                            status="ok", tokens=len(toks))
                     worker._tokens_out += len(toks)
                     eos = worker.server.eos_token_id
                     reason = ("stop" if eos is not None and toks
@@ -163,13 +213,16 @@ class LLMWorker:
                     except Exception as e:  # noqa: BLE001
                         self._json(400, {"error": f"bad request: {e}"})
                         return
-                    req = self._submit(ids, mnt)
+                    with rc.activate(ctx):
+                        req = self._submit(ids, mnt)
                     if req is None:
                         return
                     self.send_response(200)
                     self.send_header("Content-Type",
                                      "application/json-lines")
                     self.send_header("Transfer-Encoding", "chunked")
+                    if ctx is not None:
+                        self.send_header(rc.TRACE_HEADER, ctx.trace_id)
                     self.end_headers()
 
                     def chunk(obj):

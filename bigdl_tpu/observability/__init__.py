@@ -23,17 +23,60 @@ mutator and ``span`` into a no-op that records nothing.
 
 from __future__ import annotations
 
+import time as _time
+
 from bigdl_tpu.observability import _state
 from bigdl_tpu.observability.metrics import (
     CONTENT_TYPE, Counter, DEFAULT_BUCKETS, Gauge, Histogram,
     MetricRegistry, parse_prometheus, render_prometheus)
 from bigdl_tpu.observability import tracing
 from bigdl_tpu.observability.tracing import (
-    TRACE, TraceBuffer, add_complete, configure, export_chrome_trace,
-    span)
+    EXEMPLARS, TRACE, TraceBuffer, add_complete, assemble_trace,
+    configure, export_chrome_trace, span)
+from bigdl_tpu.observability import request_context
+from bigdl_tpu.observability.request_context import (
+    PARENT_HEADER, TRACE_HEADER, TraceContext)
+from bigdl_tpu.observability import compile_recorder
+from bigdl_tpu.observability.compile_recorder import (
+    compile_stats, compiled)
 
 #: The process-global registry every built-in hook writes to.
 REGISTRY = MetricRegistry()
+
+#: Epoch seconds this module (≈ the process) came up — exported as the
+#: standard ``process_start_time_seconds`` so ``time() - start`` uptime
+#: panels work against our /metrics unchanged.
+PROCESS_START_TIME = _time.time()
+
+
+def _ensure_standard_series():
+    """Declare the self-describing series every Prometheus scrape should
+    carry (ISSUE 3 satellite): ``bigdl_build_info`` (value 1, identity
+    as labels — the stock *_build_info idiom) and
+    ``process_start_time_seconds``. Called at render time, gated on the
+    switch, so a disabled process mints zero series."""
+    if not _state.enabled:
+        return
+    try:
+        from bigdl_tpu.version import __version__ as version
+    except Exception:
+        version = "unknown"
+    try:
+        import jax
+        jax_version = jax.__version__
+        backend = jax.default_backend()
+    except Exception:
+        jax_version, backend = "unknown", "unknown"
+    g = REGISTRY.gauge(
+        "bigdl_build_info",
+        "Constant 1; the build identity lives in the labels",
+        labelnames=("version", "jax_version", "backend"))
+    g.labels(version=version, jax_version=jax_version,
+             backend=backend).set(1)
+    REGISTRY.gauge(
+        "process_start_time_seconds",
+        "Unix epoch seconds this process started").set(
+        PROCESS_START_TIME)
 
 
 def enabled() -> bool:
@@ -65,21 +108,27 @@ def histogram(name: str, help: str = "", labelnames=(),
 
 def render() -> str:
     """Prometheus text exposition of the global registry."""
+    _ensure_standard_series()
     return render_prometheus(REGISTRY)
 
 
 def reset():
-    """Clear the global registry AND the trace ring. Test isolation
-    only: instruments held by live modules detach from the registry."""
+    """Clear the global registry, the trace ring, the exemplar store
+    AND the compile ledger. Test isolation only: instruments held by
+    live modules detach from the registry."""
     REGISTRY.clear()
     TRACE.clear()
+    EXEMPLARS.clear()
+    compile_recorder.reset()
 
 
 __all__ = [
-    "CONTENT_TYPE", "Counter", "Gauge", "Histogram", "MetricRegistry",
-    "REGISTRY",
-    "TRACE", "TraceBuffer", "DEFAULT_BUCKETS", "add_complete",
-    "configure", "counter", "disable", "enable", "enabled",
-    "export_chrome_trace", "gauge", "histogram", "parse_prometheus",
-    "render", "render_prometheus", "reset", "span", "tracing",
+    "CONTENT_TYPE", "Counter", "EXEMPLARS", "Gauge", "Histogram",
+    "MetricRegistry", "PARENT_HEADER", "PROCESS_START_TIME", "REGISTRY",
+    "TRACE", "TRACE_HEADER", "TraceBuffer", "TraceContext",
+    "DEFAULT_BUCKETS", "add_complete", "assemble_trace",
+    "compile_recorder", "compile_stats", "compiled", "configure",
+    "counter", "disable", "enable", "enabled", "export_chrome_trace",
+    "gauge", "histogram", "parse_prometheus", "render",
+    "render_prometheus", "request_context", "reset", "span", "tracing",
 ]

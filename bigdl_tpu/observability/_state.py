@@ -44,3 +44,8 @@ def refresh(key: str):
                                65536)
             if cap != tracing.TRACE.capacity:
                 tracing.TRACE.set_capacity(cap)
+    elif key == "bigdl.observability.exemplars":
+        tracing = sys.modules.get("bigdl_tpu.observability.tracing")
+        if tracing is not None:
+            tracing.EXEMPLARS.capacity = conf.get_int(
+                "bigdl.observability.exemplars", 8)

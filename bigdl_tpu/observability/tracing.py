@@ -73,6 +73,14 @@ class TraceBuffer:
         with self._lock:
             return self._buf[self._head:] + self._buf[:self._head]
 
+    def for_trace(self, trace_id: str) -> List[Dict[str, Any]]:
+        """Every retained span tagged with ``trace_id`` (the per-request
+        assembly behind ``GET /debug/trace/<id>``), in start order."""
+        out = [r for r in self.spans()
+               if r.get("args", {}).get("trace") == trace_id]
+        out.sort(key=lambda r: r.get("ts", 0.0))
+        return out
+
     def __len__(self) -> int:
         with self._lock:
             return len(self._buf)
@@ -147,13 +155,27 @@ def _jax_annotation(name: str, args: Dict[str, Any]):
 @contextmanager
 def span(name: str, **args: Any) -> Iterator[None]:
     """Record a host-side phase. Nestable; thread-aware; a no-op when
-    observability is disabled."""
+    observability is disabled.
+
+    When a :mod:`~bigdl_tpu.observability.request_context` is active
+    (``activate(ctx)``), the span is additionally tagged with the
+    request's ``trace``/``span``/``parent_span`` ids and becomes the
+    ambient parent for anything opened inside it — the mechanism that
+    stitches existing ``span()`` sites into cross-process traces."""
     if not _state.enabled:
         yield
         return
+    from bigdl_tpu.observability import request_context as rc
     stack = _stack()
     parent = stack[-1] if stack else None
     stack.append(name)
+    ctx = rc.current()
+    token = None
+    if ctx is not None:
+        # this span's own identity; children parent to it via the
+        # contextvar for the duration of the block
+        ctx = ctx.child()
+        token = rc._current.set(ctx)
     ann = _jax_annotation(name, args) if _jax_passthrough else None
     if ann is not None:
         try:
@@ -174,9 +196,16 @@ def span(name: str, **args: Any) -> Iterator[None]:
             except Exception:
                 pass
         stack.pop()
+        if token is not None:
+            rc._current.reset(token)
         rec_args = {k: v for k, v in args.items()}
         if parent is not None:
             rec_args["parent"] = parent
+        if ctx is not None:
+            rec_args["trace"] = ctx.trace_id
+            rec_args["span"] = ctx.span_id
+            if ctx.parent_id:
+                rec_args["parent_span"] = ctx.parent_id
         TRACE.append({
             "name": name,
             "ph": "X",
@@ -188,15 +217,13 @@ def span(name: str, **args: Any) -> Iterator[None]:
         })
 
 
-def add_complete(name: str, start_wall: float, dur_s: float,
-                 **args: Any):
-    """Record an already-measured phase as a complete ("X") event — for
-    call sites that timed the work themselves and must not re-bracket it
-    (owns the record schema so hand-built dicts don't drift from
-    ``span``'s). ``start_wall`` is epoch seconds; no-op when disabled."""
-    if not _state.enabled:
-        return
-    TRACE.append({
+def make_complete(name: str, start_wall: float, dur_s: float,
+                  **args: Any) -> Dict[str, Any]:
+    """Build (but do not record) a complete ("X") event record — the
+    one schema owner, so hand-built dicts and shipped-across-processes
+    spans can't drift from ``span``'s. ``start_wall`` is epoch
+    seconds."""
+    return {
         "name": name,
         "ph": "X",
         "ts": start_wall * 1e6,
@@ -204,8 +231,128 @@ def add_complete(name: str, start_wall: float, dur_s: float,
         "pid": os.getpid(),
         "tid": threading.get_ident(),
         "args": dict(args),
-    })
+    }
+
+
+def add_complete(name: str, start_wall: float, dur_s: float,
+                 **args: Any):
+    """Record an already-measured phase as a complete ("X") event — for
+    call sites that timed the work themselves and must not re-bracket
+    it. No-op when disabled."""
+    if not _state.enabled:
+        return
+    TRACE.append(make_complete(name, start_wall, dur_s, **args))
 
 
 def export_chrome_trace(path: Optional[str] = None) -> str:
     return TRACE.export_chrome_trace(path)
+
+
+# ---------------------------------------------------------------------------
+# Latency exemplars (ISSUE 3): the slowest-N request traces, by id
+# ---------------------------------------------------------------------------
+
+def _default_exemplar_capacity() -> int:
+    try:
+        from bigdl_tpu.utils.conf import conf
+        return conf.get_int("bigdl.observability.exemplars", 8)
+    except Exception:
+        return 8
+
+
+class ExemplarStore:
+    """Slowest-N request exemplars: (latency, trace_id, meta) kept
+    sorted, so an operator asking "what do my p99 requests look like"
+    gets concrete trace ids to feed ``GET /debug/trace/<id>`` /
+    ``tools/trace_report.py`` instead of an aggregate. The store holds
+    ids, not spans — the spans live in the ring buffer (an exemplar of a
+    very old request may therefore have partially fallen off; capacity
+    the ring accordingly)."""
+
+    def __init__(self, capacity: Optional[int] = None):
+        self.capacity = capacity if capacity is not None \
+            else _default_exemplar_capacity()
+        self._lock = threading.Lock()
+        self._items: List[Dict[str, Any]] = []   # sorted slowest-first
+
+    def offer(self, trace_id: str, duration_s: float, **meta: Any):
+        """Consider one finished request for retention. No-op when
+        observability is disabled."""
+        if not _state.enabled or not trace_id:
+            return
+        rec = {"trace_id": trace_id, "duration_s": float(duration_s),
+               **meta}
+        with self._lock:
+            if self.capacity <= 0:
+                return
+            # one slot per trace id: a retried offer updates in place
+            self._items = [r for r in self._items
+                           if r["trace_id"] != trace_id]
+            self._items.append(rec)
+            self._items.sort(key=lambda r: -r["duration_s"])
+            del self._items[self.capacity:]
+
+    def items(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._items)
+
+    def clear(self):
+        with self._lock:
+            self._items = []
+
+
+EXEMPLARS = ExemplarStore()
+
+
+def assemble_trace(trace_id: str) -> Dict[str, Any]:
+    """Per-request span assembly: every retained span of one trace plus
+    the per-stage rollup — the body ``GET /debug/trace/<id>`` serves and
+    the input ``tools/trace_report.py`` renders as a waterfall."""
+    spans = TRACE.for_trace(trace_id)
+    stages: Dict[str, Dict[str, float]] = {}
+    t0 = min((s["ts"] for s in spans), default=0.0)
+    t1 = max((s["ts"] + s.get("dur", 0.0) for s in spans), default=0.0)
+    for s in spans:
+        stage = s.get("args", {}).get("stage", s["name"])
+        agg = stages.setdefault(stage, {"count": 0, "seconds": 0.0})
+        agg["count"] += 1
+        agg["seconds"] += s.get("dur", 0.0) / 1e6
+    return {"trace_id": trace_id, "span_count": len(spans),
+            "wall_s": max(t1 - t0, 0.0) / 1e6, "stages": stages,
+            "spans": spans}
+
+
+def ingest_foreign_spans(spans):
+    """Adopt span records produced by ANOTHER process (a queue consumer
+    shipping its per-request spans back on the result record) into this
+    process's ring, so ``/debug/trace`` on the frontend assembles the
+    whole cross-process story. Same-pid records are skipped — in-proc
+    deployments already wrote them to this very ring."""
+    if not _state.enabled or not spans:
+        return
+    me = os.getpid()
+    for rec in spans:
+        if isinstance(rec, dict) and rec.get("pid") != me:
+            TRACE.append(rec)
+
+
+def debug_endpoint(path: str):
+    """Shared ``GET /debug/trace*`` handling for the HTTP surfaces
+    (ServingFrontend and LLMWorker serve identical bodies). Returns
+    ``(status, json-able dict)`` or None when ``path`` is not ours.
+    Disabled observability answers 404 — the surface is structurally
+    absent, not empty."""
+    if path == "/debug/traces":
+        if not _state.enabled:
+            return 404, {"error": "observability disabled"}
+        return 200, {"exemplars": EXEMPLARS.items()}
+    if path.startswith("/debug/trace/"):
+        if not _state.enabled:
+            return 404, {"error": "observability disabled"}
+        trace_id = path[len("/debug/trace/"):].strip("/")
+        asm = assemble_trace(trace_id)
+        if not asm["span_count"]:
+            return 404, {"error": f"no retained spans for trace "
+                                  f"{trace_id!r}", "trace_id": trace_id}
+        return 200, asm
+    return None

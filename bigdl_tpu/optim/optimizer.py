@@ -221,7 +221,11 @@ class BaseOptimizer:
             new_params, new_opt = optim.step(params, grads, opt_state, lr)
             return new_params, new_states, new_opt, loss, tele
 
-        return jax.jit(train_step, donate_argnums=(0, 1, 2))
+        # ISSUE 3 flight recorder: compile count/time + cost/memory
+        # analysis per signature, recompiles (a drifting batch shape mid-
+        # run) alarmed on bigdl_xla_recompiles_total{fn}
+        return obs.compiled(train_step, name="optimizer/train_step",
+                            donate_argnums=(0, 1, 2))
 
     def _place_batch(self, x, t):
         return jnp.asarray(x), jnp.asarray(t)
@@ -702,7 +706,8 @@ class DistriOptimizer(BaseOptimizer):
         smap = shard_map(local_step, mesh=self.mesh,
                          in_specs=(rep, rep, rep, sh, sh, rep, rep),
                          out_specs=(rep, rep, rep, rep, rep))
-        return jax.jit(smap, donate_argnums=(0, 1, 2))
+        return obs.compiled(smap, name="optimizer/train_step_compressed",
+                            donate_argnums=(0, 1, 2))
 
     def _replicate(self, tree):
         return _to_device(tree, self._rep)
@@ -753,11 +758,11 @@ def _forward_fn(model: Module):
     if cached is not None:
         return cached
 
-    @jax.jit
     def fwd(params, states, x):
         y, _ = model.apply(params, states, x, training=False, rng=None)
         return y
 
+    fwd = obs.compiled(fwd, name="optimizer/eval_forward")
     object.__setattr__(model, "_jit_fwd", fwd)
     return fwd
 

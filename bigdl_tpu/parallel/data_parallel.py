@@ -76,5 +76,6 @@ def dp_train_step(apply_fn: Callable, loss_fn: Callable, optim,
         new_params, new_opt = optim.step(params, grads, opt_state, lr)
         return new_params, new_states, new_opt, loss
 
-    return jax.jit(train_step,
-                   donate_argnums=(0, 1, 2) if donate else ())
+    from bigdl_tpu import observability as obs
+    return obs.compiled(train_step, name="parallel/dp_train_step",
+                        donate_argnums=(0, 1, 2) if donate else ())

@@ -177,4 +177,6 @@ def make_pipeline_train_step(pipe: PipelineModule, loss_fn: Callable,
                                          opt_state, lr)
         return new_params, new_opt, l
 
-    return jax.jit(step, donate_argnums=(0, 1))
+    from bigdl_tpu import observability as obs
+    return obs.compiled(step, name="parallel/pipeline_train_step",
+                        donate_argnums=(0, 1))

@@ -25,6 +25,7 @@ import numpy as np
 
 from bigdl_tpu import observability as obs
 from bigdl_tpu import reliability
+from bigdl_tpu.observability import request_context as rc
 from bigdl_tpu.ppml.protocol import dumps as wire_dumps
 from bigdl_tpu.ppml.protocol import loads as wire_loads
 
@@ -126,6 +127,45 @@ def _make_backend(backend: str, host: str, port: int) -> _Backend:
     return _InprocBackend()
 
 
+def emit_record_trace_spans(recs, infer_start: float, infer_dur: float):
+    """Stitch the consumer-side spans of traced queue records: one
+    ``serving/queue_wait`` (enqueue wall clock → inference start) and
+    one ``serving/infer`` per record, tagged with the record's trace so
+    they assemble under the originating request. Returns ``{uri: [span
+    records]}`` so the job can ship them back on the result records —
+    the frontend may live in a DIFFERENT process, whose ring would
+    otherwise never hold the consumer side of the trace. All span math
+    derives from the explicit ``enqueued_at``/``infer_start``/
+    ``infer_dur`` arguments (no clock read here), so the stitching is
+    fake-clock testable without servers; records that carry no trace
+    emit (and ship) nothing."""
+    from bigdl_tpu.observability import tracing
+    if not obs.enabled():
+        return {}
+    batched = len(recs)
+    out: Dict[str, list] = {}
+    for r in recs:
+        trace = r.get("trace")
+        if not isinstance(trace, dict) or not trace.get("trace_id"):
+            continue
+        args = {"trace": trace["trace_id"], "uri": r.get("uri")}
+        if trace.get("parent_span"):
+            args["parent_span"] = trace["parent_span"]
+        spans = []
+        enqueued = r.get("enqueued_at")
+        if isinstance(enqueued, (int, float)) and enqueued <= infer_start:
+            spans.append(tracing.make_complete(
+                "serving/queue_wait", enqueued, infer_start - enqueued,
+                stage="queue", **args))
+        spans.append(tracing.make_complete(
+            "serving/infer", infer_start, infer_dur,
+            stage="cluster_serving", batched=batched, **args))
+        for s in spans:
+            obs.TRACE.append(s)
+        out[r["uri"]] = spans
+    return out
+
+
 class InputQueue:
     """Client input side (ref: P:serving InputQueue.enqueue)."""
 
@@ -138,7 +178,16 @@ class InputQueue:
     def enqueue(self, uri: Optional[str] = None, **data) -> str:
         uri = uri or str(uuid.uuid4())
         arrays = {k: np.asarray(v) for k, v in data.items()}
-        payload = wire_dumps({"uri": uri, "data": arrays})
+        rec = {"uri": uri, "data": arrays}
+        # distributed tracing (ISSUE 3): an ambient request context
+        # rides the queue record next to the uri correlation key, with
+        # the enqueue wall clock so the consumer can attribute queue
+        # wait. Absent entirely when observability is disabled.
+        trace = rc.to_wire(rc.current())
+        if trace is not None:
+            rec["trace"] = trace
+            rec["enqueued_at"] = time.time()
+        payload = wire_dumps(rec)
         self._b.push(self.name, payload)
         return uri
 
@@ -166,11 +215,19 @@ class OutputQueue:
         raise TimeoutError(f"no result for {uri}")
 
     def dequeue(self, timeout: float = 10.0):
+        rec = self.dequeue_record(timeout=timeout)
+        if rec is None:
+            return None
+        return rec["uri"], rec["result"]
+
+    def dequeue_record(self, timeout: float = 10.0):
+        """Like :meth:`dequeue` but returns the whole result record —
+        including the consumer's shipped ``trace_spans`` (ISSUE 3) —
+        or None on timeout."""
         payload = self._b.pop(self.name, timeout=timeout)
         if payload is None:
             return None
-        rec = wire_loads(payload)
-        return rec["uri"], rec["result"]
+        return wire_loads(payload)
 
 
 class ClusterServing:
@@ -238,20 +295,26 @@ class ClusterServing:
         key = next(iter(recs[0]["data"]))
         x = np.concatenate([r["data"][key] for r in recs], axis=0)
         t0 = time.time()
-        with obs.span("serving/batch", records=len(recs)):
+        with obs.span("serving/batch", records=len(recs),
+                      stage="cluster_serving"):
             y = self.model.predict(x)
+        infer_dur = time.time() - t0
+        shipped = emit_record_trace_spans(recs, t0, infer_dur)
         ins = self._instruments()
         if ins is not None:
-            ins["infer"].observe(time.time() - t0)
+            ins["infer"].observe(infer_dur)
             ins["batches"].inc()
             ins["batch_size"].observe(len(recs))
             ins["served"].inc(len(recs))
         off = 0
         for r in recs:
             n = r["data"][key].shape[0]
-            payload = wire_dumps({"uri": r["uri"],
-                                    "result": y[off:off + n]})
-            self._b.push(self.stream + ":out", payload)
+            rec_out = {"uri": r["uri"], "result": y[off:off + n]}
+            # consumer-side spans ride home on the result record so the
+            # (possibly remote) frontend can assemble the full trace
+            if shipped.get(r["uri"]):
+                rec_out["trace_spans"] = shipped[r["uri"]]
+            self._b.push(self.stream + ":out", wire_dumps(rec_out))
             off += n
         self.served += len(recs)
         return len(recs)

@@ -18,6 +18,17 @@ Endpoints (mirroring the reference's REST surface):
   for old dashboards).
 - ``GET /healthz``  → 200/503 + the reliability health-check registry
   report (ISSUE 2).
+- ``GET /debug/trace/<trace_id>``  → the stitched per-request trace
+  (every retained span tagged with that id, plus the per-stage rollup);
+  ``GET /debug/traces`` lists the slowest-N latency exemplars (ISSUE 3).
+
+Distributed tracing (ISSUE 3): ``/predict`` reads the case-insensitive
+``X-BigDL-Trace-Id``/``X-BigDL-Parent-Span`` headers (minting a fresh
+trace when absent), activates the context so the existing ``span()``
+sites tag themselves, rides the context through the queue record to the
+ClusterServing job, and echoes ``X-BigDL-Trace-Id`` on the response so
+the client can fetch ``/debug/trace/<id>``. With observability disabled
+no trace headers are read, emitted, or echoed.
 
 One dispatcher thread owns the OutputQueue: concurrent handlers must
 not each poll the shared stream (they would steal each other's
@@ -42,6 +53,8 @@ import numpy as np
 
 from bigdl_tpu import observability as obs
 from bigdl_tpu import reliability
+from bigdl_tpu.observability import request_context as rc
+from bigdl_tpu.observability import tracing
 from bigdl_tpu.serving.cluster_serving import InputQueue, OutputQueue
 
 
@@ -97,6 +110,11 @@ class ServingFrontend:
                 body = text.encode()
                 self.send_response(code)
                 self.send_header("Content-Type", content_type)
+                # echo the request's trace id so the client can fetch
+                # /debug/trace/<id> (absent in disabled mode)
+                trace_id = getattr(self, "_trace", None)
+                if trace_id:
+                    self.send_header(rc.TRACE_HEADER, trace_id)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
@@ -105,8 +123,12 @@ class ServingFrontend:
                 self._text(code, json.dumps(obj), "application/json")
 
             def do_GET(self):
+                self._trace = None
                 ins = frontend._instruments()
-                if self.path == "/metrics":
+                debug = tracing.debug_endpoint(self.path)
+                if debug is not None:
+                    self._json(*debug)
+                elif self.path == "/metrics":
                     # refresh the gauge at scrape time so the exposition
                     # reflects now, not the last request
                     with frontend._lock:
@@ -139,15 +161,24 @@ class ServingFrontend:
                 self.send_response(503)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Retry-After", "1")
+                trace_id = getattr(self, "_trace", None)
+                if trace_id:
+                    self.send_header(rc.TRACE_HEADER, trace_id)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
 
             def do_POST(self):
+                self._trace = None
                 ins = frontend._instruments()
                 if self.path != "/predict":
                     self._json(404, {"error": "unknown path"})
                     return
+                # case-insensitive trace extraction (or a fresh root
+                # trace); None in disabled mode — no headers round-trip
+                ctx = rc.server_context(self.headers)
+                if ctx is not None:
+                    self._trace = ctx.trace_id
                 t_req = time.perf_counter()
                 try:
                     reliability.inject("serving.frontend.request")
@@ -171,7 +202,8 @@ class ServingFrontend:
                                                status="bad_request").inc()
                     self._json(400, {"error": f"bad request: {e}"})
                     return
-                with obs.span("serving/predict"):
+                with rc.activate(ctx), \
+                        obs.span("serving/predict", stage="frontend"):
                     try:
                         uri = frontend._submit(req.get("uri"), inputs)
                         result = frontend._wait(uri, deadline=deadline)
@@ -186,6 +218,11 @@ class ServingFrontend:
                         self._shed(ins, f"backend unavailable: {e}")
                         return
                 latency = time.perf_counter() - t_req
+                if ctx is not None:
+                    obs.EXEMPLARS.offer(
+                        ctx.trace_id, latency, name="serving/predict",
+                        uri=uri,
+                        status="ok" if result is not None else "timeout")
                 if ins is not None:
                     ins["latency"].observe(latency)
                     with frontend._lock:
@@ -268,7 +305,7 @@ class ServingFrontend:
     def _dispatch_loop(self):
         while not self._stop.is_set():
             try:
-                got = self._out.dequeue(timeout=0.1)
+                got = self._out.dequeue_record(timeout=0.1)
             except Exception:  # noqa: BLE001 — the sole dispatcher must
                 # outlive transient backend faults (injected or real);
                 # waiters time out individually, the loop keeps draining
@@ -276,7 +313,12 @@ class ServingFrontend:
                 continue
             if got is None:
                 continue
-            uri, result = got
+            uri, result = got["uri"], got["result"]
+            # consumer-side spans from a REMOTE serving job land in our
+            # ring here (same-pid records are skipped: in-proc mode
+            # already wrote them), so /debug/trace assembles the whole
+            # cross-process story
+            tracing.ingest_foreign_spans(got.get("trace_spans"))
             with self._lock:
                 ev = self._events.get(uri)
                 if ev is not None:

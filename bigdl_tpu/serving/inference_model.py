@@ -41,12 +41,16 @@ class InferenceModel:
             jnp.asarray, model.states_dict())
         mdl = self._model
 
-        @jax.jit
         def fwd(p, s, x):
             y, _ = mdl.apply(p, s, x, training=False, rng=None)
             return y
 
-        self._fwd = fwd
+        # ISSUE 3 flight recorder: ClusterServing batches arrive in
+        # whatever size the collector packed, so THIS is where silent
+        # shape-driven recompiles eat serving throughput — every one is
+        # counted on bigdl_xla_recompiles_total{fn}
+        from bigdl_tpu import observability as obs
+        self._fwd = obs.compiled(fwd, name="serving/inference_forward")
         return self
 
     load = load_bigdl
@@ -92,11 +96,14 @@ class InferenceModel:
         if self._fwd is None:
             raise RuntimeError("load a model first")
         x = jnp.zeros(example_shape, dtype)
-        lowered = self._fwd.lower(self._params, self._states, x)
+        # jax.export needs the underlying jit function, not the
+        # flight-recorder wrapper
+        fwd_jit = getattr(self._fwd, "_jit", self._fwd)
+        lowered = fwd_jit.lower(self._params, self._states, x)
         exported = None
         try:
             import jax.export as _export
-            exported = _export.export(self._fwd)(
+            exported = _export.export(fwd_jit)(
                 self._params, self._states, x).serialize()
             with open(path + ".hlo", "wb") as f:
                 f.write(exported)

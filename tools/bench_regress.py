@@ -1,0 +1,239 @@
+#!/usr/bin/env python
+"""Bench regression diff — compare the latest two north-star records.
+
+The driver appends one ``BENCH_r<N>.json`` per round whose ``tail``
+field holds the JSON lines ``bench.py`` printed (the full record first,
+the compact ``northstar_summary`` record last — the tail may be
+truncated from the HEAD, which is exactly why the compact record is
+printed last). This tool parses the newest two rounds, flattens every
+numeric metric it can find, and prints per-metric deltas, warning when a
+move exceeds the threshold (default 10%) — a throughput cliff between
+rounds should be a red line in the log, not something a human spots by
+eyeballing two JSON blobs.
+
+CLI:
+    python tools/bench_regress.py                 # ./BENCH_r*.json
+    python tools/bench_regress.py --dir path --warn-pct 5 --json
+    python tools/bench_regress.py --progress      # append one summary
+                                                  # line to PROGRESS.jsonl
+
+Library: ``compare_latest(dir)`` is embedded by ``bench.py`` as the
+optional ``regress`` block of its output record.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+
+def _bench_files(directory: str) -> List[str]:
+    files = glob.glob(os.path.join(directory, "BENCH_r*.json"))
+
+    def round_no(path: str) -> int:
+        m = re.search(r"BENCH_r(\d+)\.json$", os.path.basename(path))
+        return int(m.group(1)) if m else -1
+
+    return sorted((f for f in files if round_no(f) >= 0), key=round_no)
+
+
+def _json_objects(tail: str) -> List[dict]:
+    """Every parseable JSON object among the tail's lines. Head
+    truncation can leave the first line unparseable — skipped; a salvage
+    pass then recovers the embedded ``{"metric": ...}`` sub-records
+    (rounds before the compact tail record exist only in that form)."""
+    out = []
+    for line in tail.splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            obj = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(obj, dict):
+            out.append(obj)
+    if not out:
+        decoder = json.JSONDecoder()
+        for m in re.finditer(r'\{"metric"', tail):
+            try:
+                obj, _ = decoder.raw_decode(tail, m.start())
+            except ValueError:
+                continue
+            if isinstance(obj, dict):
+                out.append(obj)
+    return out
+
+
+def _flatten_northstar(ns: dict) -> Dict[str, float]:
+    flat: Dict[str, float] = {}
+    for key, val in ns.items():
+        if isinstance(val, (int, float)) and not isinstance(val, bool):
+            flat[key] = float(val)
+        elif isinstance(val, dict):
+            for sub, sv in val.items():
+                if sub in ("unit", "error"):
+                    continue
+                if isinstance(sv, (int, float)) \
+                        and not isinstance(sv, bool):
+                    name = key if sub == "v" else f"{key}.{sub}"
+                    flat[name] = float(sv)
+    return flat
+
+
+# full-record metric names → the compact northstar keys, so rounds that
+# predate the compact tail record (or whose compact line was truncated
+# away) still diff against newer ones in one namespace
+_ALIASES = {
+    "resnet50_imagenet_train_throughput": "resnet_img_s",
+    "bert_base_finetune_throughput": "bert",
+    "llama2_7b_int4_prefill_4k": "prefill_4k",
+    "lenet_convergence_top1": "lenet_top1",
+    "cifar_resnet20_convergence_top1": "cifar_top1",
+    "llama2_7b_int4_decode_throughput": "llama_b1",
+    "llama_7b_paged_decode_step": "paged_b8",
+}
+
+
+def _canon(metric: str, extra: Optional[dict]) -> str:
+    if metric == "llama2_7b_int4_decode_throughput" and \
+            isinstance(extra, dict) and extra.get("batch") == 8:
+        return "llama_b8"
+    return _ALIASES.get(metric, metric)
+
+
+def _flatten_full(rec: dict) -> Dict[str, float]:
+    flat: Dict[str, float] = {}
+    if isinstance(rec.get("value"), (int, float)):
+        flat[_canon(rec.get("metric", "value"), rec.get("extra"))] = \
+            float(rec["value"])
+    for key, sub in (rec.get("extra") or {}).items():
+        if isinstance(sub, dict) and \
+                isinstance(sub.get("value"), (int, float)):
+            flat[_canon(sub.get("metric", key), sub.get("extra"))] = \
+                float(sub["value"])
+    return flat
+
+
+def load_metrics(path: str) -> Dict[str, float]:
+    """Flat {metric: value} from one BENCH_r*.json (or a raw bench.py
+    output file). Prefers the compact northstar record (survives tail
+    truncation); falls back to the full record's top-level values."""
+    with open(path) as f:
+        doc = json.load(f)
+    objs = _json_objects(doc["tail"]) if isinstance(doc, dict) \
+        and isinstance(doc.get("tail"), str) else \
+        [doc] if isinstance(doc, dict) else []
+    # union of BOTH name spaces: the full record's metric names (the
+    # only form in pre-compact rounds / salvaged truncated tails) and
+    # the compact northstar keys — the diff intersects whatever the two
+    # rounds share
+    flat: Dict[str, float] = {}
+    for obj in objs:
+        if "metric" in obj:
+            flat.update(_flatten_full(obj))
+    for obj in objs:
+        ns = (obj.get("extra") or {}).get("northstar_summary")
+        if isinstance(ns, dict):
+            flat.update(_flatten_northstar(ns))
+    return flat
+
+
+def compare(base_path: str, head_path: str,
+            warn_pct: float = 10.0) -> Dict[str, Any]:
+    base = load_metrics(base_path)
+    head = load_metrics(head_path)
+    deltas: Dict[str, dict] = {}
+    warned: List[str] = []
+    for name in sorted(set(base) & set(head)):
+        b, h = base[name], head[name]
+        pct = (h - b) / abs(b) * 100.0 if b else None
+        warn = pct is not None and abs(pct) >= warn_pct
+        deltas[name] = {"base": b, "head": h,
+                        "pct": round(pct, 2) if pct is not None else None,
+                        "warn": warn}
+        if warn:
+            warned.append(name)
+    return {"base": os.path.basename(base_path),
+            "head": os.path.basename(head_path),
+            "warn_pct": warn_pct, "deltas": deltas, "warned": warned,
+            "only_base": sorted(set(base) - set(head)),
+            "only_head": sorted(set(head) - set(base))}
+
+
+def compare_latest(directory: str = ".", warn_pct: float = 10.0,
+                   progress_path: Optional[str] = None
+                   ) -> Optional[Dict[str, Any]]:
+    """Diff the newest two rounds; None when fewer than two exist. When
+    ``progress_path`` is given, one compact summary line is appended
+    there (the PROGRESS.jsonl breadcrumb the ISSUE asks for)."""
+    files = _bench_files(directory)
+    if len(files) < 2:
+        return None
+    out = compare(files[-2], files[-1], warn_pct)
+    if progress_path:
+        line = {"ts": time.time(), "kind": "bench_regress",
+                "base": out["base"], "head": out["head"],
+                "metrics": len(out["deltas"]),
+                "warned": out["warned"]}
+        try:
+            with open(progress_path, "a") as f:
+                f.write(json.dumps(line) + "\n")
+        except OSError:
+            pass   # a read-only checkout must not fail the bench
+    return out
+
+
+def _print(out: Dict[str, Any]):
+    print(f"bench regress: {out['base']} -> {out['head']} "
+          f"(warn at ±{out['warn_pct']:g}%)")
+    if not out["deltas"]:
+        print("  no shared metrics")
+        return
+    name_w = max(len(n) for n in out["deltas"])
+    for name, d in out["deltas"].items():
+        pct = f"{d['pct']:+.1f}%" if d["pct"] is not None else "n/a"
+        flag = "  << WARN" if d["warn"] else ""
+        print(f"  {name:<{name_w}}  {d['base']:>12.4g} -> "
+              f"{d['head']:>12.4g}  {pct:>8}{flag}")
+    for name in out["only_head"]:
+        print(f"  {name:<{name_w}}  (new in {out['head']})")
+    for name in out["only_base"]:
+        print(f"  {name:<{name_w}}  (gone since {out['base']})")
+
+
+def _flag_value(argv: List[str], flag: str) -> Optional[str]:
+    if flag not in argv:
+        return None
+    i = argv.index(flag)
+    if i + 1 >= len(argv):
+        print(f"{flag} needs a value", file=sys.stderr)
+        raise SystemExit(2)
+    return argv[i + 1]
+
+
+def main(argv: List[str]) -> int:
+    directory = _flag_value(argv, "--dir") or "."
+    warn = _flag_value(argv, "--warn-pct")
+    warn_pct = float(warn) if warn is not None else 10.0
+    progress = os.path.join(directory, "PROGRESS.jsonl") \
+        if "--progress" in argv else None
+    out = compare_latest(directory, warn_pct, progress_path=progress)
+    if out is None:
+        print("need at least two BENCH_r*.json rounds to diff",
+              file=sys.stderr)
+        return 1
+    if "--json" in argv:
+        print(json.dumps(out))
+    else:
+        _print(out)
+    return 2 if out["warned"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

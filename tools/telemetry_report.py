@@ -14,6 +14,8 @@ CLI:
     python tools/telemetry_report.py run/log/train/scalars.jsonl
     python tools/telemetry_report.py trace.json
     python tools/telemetry_report.py --json trace.json   # machine output
+    python tools/telemetry_report.py --trace <id> trace.json  # one
+        request's spans only (distributed-trace filter, ISSUE 3)
 """
 
 from __future__ import annotations
@@ -39,6 +41,7 @@ def _dist(vals: List[float]) -> Dict[str, float]:
             "mean": sum(s) / len(s),
             "p50": _pct(s, 0.50),
             "p90": _pct(s, 0.90),
+            "p95": _pct(s, 0.95),
             "p99": _pct(s, 0.99),
             "max": s[-1]}
 
@@ -70,8 +73,11 @@ def summarize_scalars(path: str) -> dict:
     return out
 
 
-def summarize_trace(path_or_doc) -> dict:
-    """Per-span-name duration distributions from Chrome-trace JSON."""
+def summarize_trace(path_or_doc, trace_id: Optional[str] = None) -> dict:
+    """Per-span-name duration distributions (p50/p95/p99 among them)
+    from Chrome-trace JSON. ``trace_id`` restricts to one request's
+    spans (the ISSUE 3 distributed-trace tag), making latency exemplars
+    scriptable: feed an id from ``GET /debug/traces`` straight in."""
     if isinstance(path_or_doc, dict):
         doc = path_or_doc
     else:
@@ -82,8 +88,15 @@ def summarize_trace(path_or_doc) -> dict:
     for ev in events:
         if ev.get("ph") != "X" or "dur" not in ev:
             continue
+        if trace_id is not None and \
+                ev.get("args", {}).get("trace") != trace_id:
+            continue
         names.setdefault(ev["name"], []).append(ev["dur"] / 1e6)
-    return {"spans": {name: _dist(d) for name, d in sorted(names.items())}}
+    out = {"spans": {name: _dist(d)
+                     for name, d in sorted(names.items())}}
+    if trace_id is not None:
+        out["trace_id"] = trace_id
+    return out
 
 
 def summarize_registry(registry=None) -> dict:
@@ -130,13 +143,14 @@ def _print_table(title: str, header: List[str], rows: List[List]):
         print("  ".join(c.ljust(w) for c, w in zip(r, widths)))
 
 
-def report(path: str, as_json: bool = False) -> dict:
+def report(path: str, as_json: bool = False,
+           trace_id: Optional[str] = None) -> dict:
     if path.endswith(".jsonl"):
         summary = {"kind": "scalars", "path": path,
                    **summarize_scalars(path)}
     else:
         summary = {"kind": "trace", "path": path,
-                   **summarize_trace(path)}
+                   **summarize_trace(path, trace_id=trace_id)}
     if as_json:
         print(json.dumps(summary))
         return summary
@@ -150,23 +164,36 @@ def report(path: str, as_json: bool = False) -> dict:
         if st:
             _print_table(
                 f"step time (wall deltas of '{st['tag']}')",
-                ["count", "mean_s", "p50_s", "p90_s", "p99_s", "max_s"],
+                ["count", "mean_s", "p50_s", "p90_s", "p95_s", "p99_s",
+                 "max_s"],
                 [[st["count"], st["mean"], st["p50"], st["p90"],
-                  st["p99"], st["max"]]])
+                  st["p95"], st["p99"], st["max"]]])
     else:
+        title = f"trace spans: {path}"
+        if trace_id is not None:
+            title += f" (trace {trace_id})"
         _print_table(
-            f"trace spans: {path}",
-            ["span", "count", "mean_s", "p50_s", "p90_s", "p99_s",
-             "max_s"],
-            [[name, d["count"], d["mean"], d["p50"], d["p90"], d["p99"],
-              d["max"]]
+            title,
+            ["span", "count", "mean_s", "p50_s", "p90_s", "p95_s",
+             "p99_s", "max_s"],
+            [[name, d["count"], d["mean"], d["p50"], d["p90"], d["p95"],
+              d["p99"], d["max"]]
              for name, d in summary["spans"].items()])
     return summary
 
 
 def main(argv: List[str]) -> int:
     as_json = "--json" in argv
-    paths = [a for a in argv if not a.startswith("--")]
+    trace_id = None
+    if "--trace" in argv:
+        i = argv.index("--trace")
+        if i + 1 >= len(argv):
+            print("--trace needs a trace id", file=sys.stderr)
+            return 2
+        trace_id = argv[i + 1]
+    paths = [a for i, a in enumerate(argv)
+             if not a.startswith("--")
+             and (i == 0 or argv[i - 1] != "--trace")]
     if not paths:
         print(__doc__)
         return 2
@@ -174,7 +201,7 @@ def main(argv: List[str]) -> int:
         if not os.path.exists(p):
             print(f"no such file: {p}", file=sys.stderr)
             return 1
-        report(p, as_json=as_json)
+        report(p, as_json=as_json, trace_id=trace_id)
     return 0
 
 

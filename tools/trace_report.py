@@ -1,0 +1,160 @@
+#!/usr/bin/env python
+"""Trace report — render one request's cross-process waterfall.
+
+Input is Chrome-trace JSON (``observability.export_chrome_trace`` or the
+``spans`` list of ``GET /debug/trace/<id>``). Spans tagged with a
+``trace`` arg (the ISSUE 3 request-context machinery) group into
+per-request traces; each renders as a waterfall — where the request's
+wall time went: queue wait vs prefill vs decode vs postprocess — plus a
+per-stage rollup.
+
+CLI:
+    python tools/trace_report.py trace.json                # slowest trace
+    python tools/trace_report.py trace.json --trace <id>   # specific one
+    python tools/trace_report.py trace.json --list         # all trace ids
+    python tools/trace_report.py trace.json --json         # machine output
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Any, Dict, List, Optional
+
+
+def load_events(path_or_doc) -> List[dict]:
+    if isinstance(path_or_doc, dict):
+        doc = path_or_doc
+    elif isinstance(path_or_doc, list):
+        return path_or_doc
+    else:
+        with open(path_or_doc) as f:
+            doc = json.load(f)
+    if "spans" in doc and "traceEvents" not in doc:
+        return doc["spans"]          # a /debug/trace/<id> body
+    return doc.get("traceEvents", [])
+
+
+def traces_in(events: List[dict]) -> Dict[str, List[dict]]:
+    """Group complete events by their ``trace`` arg (untagged spans are
+    process-local, not part of any request — skipped)."""
+    out: Dict[str, List[dict]] = {}
+    for ev in events:
+        if ev.get("ph") != "X" or "dur" not in ev:
+            continue
+        trace_id = ev.get("args", {}).get("trace")
+        if trace_id:
+            out.setdefault(trace_id, []).append(ev)
+    return out
+
+
+def build_waterfall(events: List[dict], trace_id: str) -> Dict[str, Any]:
+    """The per-stage timing decomposition of one trace: rows in start
+    order with offsets relative to the earliest span, plus stage
+    aggregates. Pure function of the span records (fake-clock
+    testable)."""
+    spans = sorted((e for e in events
+                    if e.get("args", {}).get("trace") == trace_id),
+                   key=lambda e: e.get("ts", 0.0))
+    if not spans:
+        return {"trace_id": trace_id, "rows": [], "stages": {},
+                "wall_ms": 0.0}
+    t0 = min(e["ts"] for e in spans)
+    t1 = max(e["ts"] + e.get("dur", 0.0) for e in spans)
+    wall = max(t1 - t0, 0.0)
+    rows, stages = [], {}
+    for e in spans:
+        args = e.get("args", {})
+        stage = args.get("stage", e["name"])
+        dur = e.get("dur", 0.0)
+        rows.append({
+            "name": e["name"], "stage": stage,
+            "pid": e.get("pid"), "tid": e.get("tid"),
+            "start_ms": round((e["ts"] - t0) / 1e3, 3),
+            "dur_ms": round(dur / 1e3, 3),
+            "frac": round(dur / wall, 4) if wall else 0.0,
+        })
+        stages[stage] = round(stages.get(stage, 0.0) + dur / 1e3, 3)
+    return {"trace_id": trace_id, "wall_ms": round(wall / 1e3, 3),
+            "span_count": len(rows), "rows": rows, "stages": stages}
+
+
+def render_waterfall(wf: Dict[str, Any], width: int = 40) -> str:
+    """ASCII waterfall: one bar per span, offset+length to scale."""
+    lines = [f"trace {wf['trace_id']}  wall {wf['wall_ms']:.3f} ms  "
+             f"{wf['span_count']} spans"]
+    wall = wf["wall_ms"] or 1.0
+    name_w = max((len(r["name"]) for r in wf["rows"]), default=4)
+    stage_w = max((len(str(r["stage"])) for r in wf["rows"]), default=5)
+    for r in wf["rows"]:
+        lead = int(width * r["start_ms"] / wall)
+        bar = max(int(width * r["dur_ms"] / wall), 1)
+        lines.append(
+            f"  {r['name']:<{name_w}}  {r['stage']:<{stage_w}}  "
+            f"{' ' * lead}{'█' * bar:<{width - lead}}  "
+            f"{r['dur_ms']:>9.3f} ms @ {r['start_ms']:.3f}")
+    lines.append("  -- stage rollup --")
+    for stage, ms in sorted(wf["stages"].items(), key=lambda kv: -kv[1]):
+        pct = 100.0 * ms / wall
+        lines.append(f"  {stage:<{name_w + stage_w + 2}}  "
+                     f"{ms:>9.3f} ms  {pct:5.1f}%")
+    return "\n".join(lines)
+
+
+def report(path: str, trace_id: Optional[str] = None,
+           as_json: bool = False, list_only: bool = False) -> dict:
+    events = load_events(path)
+    traces = traces_in(events)
+    if list_only:
+        summary = {tid: build_waterfall(evs, tid)
+                   for tid, evs in traces.items()}
+        listing = sorted(
+            ({"trace_id": t, "wall_ms": w["wall_ms"],
+              "spans": w["span_count"]} for t, w in summary.items()),
+            key=lambda r: -r["wall_ms"])
+        if as_json:
+            print(json.dumps({"traces": listing}))
+        else:
+            for r in listing:
+                print(f"{r['trace_id']}  {r['wall_ms']:>10.3f} ms  "
+                      f"{r['spans']} spans")
+        return {"traces": listing}
+    if trace_id is None:
+        if not traces:
+            print("no traced spans in input", file=sys.stderr)
+            return {}
+        # default to the slowest request — the one worth staring at
+        trace_id = max(traces, key=lambda t: build_waterfall(
+            traces[t], t)["wall_ms"])
+    wf = build_waterfall(traces.get(trace_id, []), trace_id)
+    if as_json:
+        print(json.dumps(wf))
+    else:
+        print(render_waterfall(wf))
+    return wf
+
+
+def main(argv: List[str]) -> int:
+    as_json = "--json" in argv
+    list_only = "--list" in argv
+    trace_id = None
+    if "--trace" in argv:
+        i = argv.index("--trace")
+        if i + 1 >= len(argv):
+            print("--trace needs a trace id", file=sys.stderr)
+            return 2
+        trace_id = argv[i + 1]
+    paths = [a for i, a in enumerate(argv)
+             if not a.startswith("--")
+             and (i == 0 or argv[i - 1] != "--trace")]
+    if not paths:
+        print(__doc__)
+        return 2
+    for p in paths:
+        report(p, trace_id=trace_id, as_json=as_json,
+               list_only=list_only)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
