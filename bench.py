@@ -851,6 +851,16 @@ def _compact_northstar(out: dict) -> dict:
         "lenet_top1": g("lenet_convergence", "bayes_ref_top1", "in_band"),
         "cifar_top1": g("cifar_convergence", "bayes_ref_top1", "in_band"),
     }
+    # ISSUE 4: per-depth live-engine decode step time (host overlap win)
+    mb = ((ex.get("telemetry") or {}).get("microbench_decode") or {})
+    if "error" in mb:
+        ns["decode_pipeline"] = {"error": str(mb["error"])[:80]}
+    else:
+        ns["decode_pipeline"] = {
+            k: (v or {}).get("step_ms") for k, v in mb.items()
+            if k.startswith("depth")}
+        if mb.get("speedup_vs_depth1") is not None:
+            ns["decode_pipeline"]["speedup"] = mb["speedup_vs_depth1"]
     return {"metric": out["metric"], "value": out["value"],
             "unit": out["unit"], "vs_baseline": out.get("vs_baseline"),
             "extra": {"northstar_summary": ns,
@@ -886,6 +896,15 @@ def _telemetry_block() -> dict:
         out["chaos_smoke"] = run_chaos(seed=0, events=3, smoke=True)
     except Exception as e:  # never lose the telemetry to the chaos run
         out["chaos_smoke"] = {"error": repr(e)}
+    try:
+        # ISSUE 4: live-engine decode latency across pipeline depths —
+        # the host-overlap win (and its host/stall attribution) lands in
+        # every bench round next to the device-side decode numbers
+        from tools.microbench_decode import run_microbench
+        out["microbench_decode"] = run_microbench(
+            depths=(1, 2, 4), batch=4, tokens=24)
+    except Exception as e:
+        out["microbench_decode"] = {"error": repr(e)}
     return out
 
 

@@ -3,6 +3,9 @@
 from bigdl_tpu.llm.kernels.int4_matmul import (
     asym_int4_matmul, int4_matmul, int4_matmul_reference, int8_matmul,
     quantize_tpu, to_tpu_layout)
+from bigdl_tpu.llm.kernels.sampling import (
+    fence_token, make_sampled_step, sample_tokens)
 
-__all__ = ["asym_int4_matmul", "int4_matmul", "int4_matmul_reference",
-           "int8_matmul", "quantize_tpu", "to_tpu_layout"]
+__all__ = ["asym_int4_matmul", "fence_token", "int4_matmul",
+           "int4_matmul_reference", "int8_matmul", "make_sampled_step",
+           "quantize_tpu", "sample_tokens", "to_tpu_layout"]

@@ -1,0 +1,103 @@
+"""On-device next-token sampling for the serving engine (ISSUE 4).
+
+The synchronous engine sampled on the HOST: every decode step pulled the
+(B, V) logits' argmax to python before it could dispatch the next step —
+one device→host roundtrip per token, serialized against device compute
+(on the tunneled TPU runtime that roundtrip is ~100 ms, BENCH_r02's
+measured "sync overhead"). Folding sampling INTO the compiled decode
+step means the step consumes the previous step's logits entirely on
+device and emits ready-to-drain token ids, so the host only fetches a
+small int vector — and, under pipelining, fetches it one step late
+while the device is already running the next step.
+
+Everything here is plain XLA (argmax / top_k / categorical): it lowers
+to the same fused program on TPU and CPU, no Mosaic kernel needed — the
+decode step's cost is the weight stream, not the (B, V) reduction.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def sample_tokens(logits, key, *, do_sample: bool = False,
+                  temperature=1.0, top_k: int = 0):
+    """``(B, V)`` logits → ``(B,)`` int32 next tokens.
+
+    ``do_sample``/``top_k`` are trace-time constants (they change the
+    program); ``temperature`` is a runtime scalar so serving can tune it
+    without a recompile. Greedy (``do_sample=False``) is bit-identical
+    to the host-side ``argmax`` it replaces — the serving parity tests
+    assert served tokens equal ``generate()``'s.
+    """
+    if not do_sample:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = logits / jnp.maximum(temperature, 1e-6)
+    if top_k > 0:
+        kth = jax.lax.top_k(scaled, top_k)[0][:, -1][:, None]
+        scaled = jnp.where(scaled < kth, -1e30, scaled)
+    return jax.random.categorical(key, scaled).astype(jnp.int32)
+
+
+def fence_token(*arrays):
+    """A ``(1,)`` int32 whose VALUE is garbage but whose availability
+    data-depends on every input array.
+
+    ``jax.block_until_ready`` is unreliable on the axon-tunneled TPU
+    runtime (serving._sync_barrier's round-4 finding); the only portable
+    completion fence is a real device→host fetch of data that depends on
+    the computation. The engine concatenates this element onto the
+    sampled token vector, so ONE small fetch both delivers the tokens
+    and bounds the step's pool writes — no second roundtrip.
+
+    The first element of each array is summed (never multiplied by zero:
+    XLA may constant-fold ``x*0`` for ints and would sever the data
+    dependence), NaN-scrubbed and clipped so the int cast is defined.
+    """
+    acc = jnp.float32(0.0)
+    for a in arrays:
+        acc = acc + a.ravel()[0].astype(jnp.float32)
+    acc = jnp.clip(jnp.nan_to_num(acc), -1e9, 1e9)
+    return acc.astype(jnp.int32)[None]
+
+
+def make_sampled_step(fam_step):
+    """Lift a family ``paged_decode_step`` (toks-in, logits-out) into the
+    pipelined engine's step shape (logits-in, sampled-ids-out).
+
+    The lifted step:
+
+    - samples the next token for every row from ``last`` ON DEVICE;
+    - masks block-table rows and lengths of inactive rows to the trash
+      page (page 0 / length 0), so rows whose dispatch budget is spent
+      — or whose slot is empty — dummy-write into the trash page
+      exactly like the synchronous engine's zeroed ``bt`` rows did;
+    - advances ``lens`` for active rows on device (the host never
+      re-uploads the length vector);
+    - returns ``(out, logits, k_pages, v_pages, new_lens, key)`` where
+      ``out`` is ``(B+1,)`` int32: the B sampled ids plus a
+      :func:`fence_token` element bounding the pool writes.
+
+    Each family module exposes ``paged_decode_step_sampled =
+    make_sampled_step(paged_decode_step)`` so the engine dispatches one
+    compiled program per family with no per-family sampling code.
+    """
+
+    def sampled_step(params, cfg, k_pages, v_pages, bt, lens, last,
+                     active, temperature, key, *, page: int,
+                     do_sample: bool = False, top_k: int = 0):
+        key, sub = jax.random.split(key)
+        toks = sample_tokens(last, sub, do_sample=do_sample,
+                             temperature=temperature, top_k=top_k)
+        bt_eff = jnp.where(active[:, None], bt, 0)
+        lens_eff = jnp.where(active, lens, 0)
+        logits, k_pages, v_pages = fam_step(
+            params, cfg, k_pages, v_pages, bt_eff, lens_eff, toks,
+            page=page)
+        new_lens = lens + active.astype(lens.dtype)
+        out = jnp.concatenate(
+            [toks, fence_token(k_pages, v_pages, logits)])
+        return out, logits, k_pages, v_pages, new_lens, key
+
+    return sampled_step

@@ -310,6 +310,13 @@ def paged_decode_step(params, cfg, k_pages, v_pages, bt, lens, toks,
     return logits[:, 0].astype(jnp.float32), k_pages, v_pages
 
 
+# pipelined-engine step shape (ISSUE 4): sampling folded on device,
+# device-resident lens carry, fence element — see kernels.sampling
+from bigdl_tpu.llm.kernels.sampling import make_sampled_step  # noqa: E402
+
+paged_decode_step_sampled = make_sampled_step(paged_decode_step)
+
+
 class GptNeoXForCausalLM(CausalLMFacade):
     """Generation facade — shared driver (see models._facade)."""
 

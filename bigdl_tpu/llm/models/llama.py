@@ -760,14 +760,12 @@ def forward(params: Dict[str, Any], cfg: LlamaConfig,
 # ---------------------------------------------------------------------------
 
 def _pick_token(logits, key, do_sample: bool, temperature, top_k: int):
-    """logits (B, V) → (B,) int32 next tokens."""
-    if not do_sample:
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    scaled = logits / jnp.maximum(temperature, 1e-6)
-    if top_k > 0:
-        kth = jnp.sort(scaled, axis=-1)[:, -top_k][:, None]
-        scaled = jnp.where(scaled < kth, -1e30, scaled)
-    return jax.random.categorical(key, scaled).astype(jnp.int32)
+    """logits (B, V) → (B,) int32 next tokens (shared on-device
+    sampling: the serving engine's pipelined decode step folds the same
+    primitive into its compiled program, ISSUE 4)."""
+    from bigdl_tpu.llm.kernels.sampling import sample_tokens
+    return sample_tokens(logits, key, do_sample=do_sample,
+                         temperature=temperature, top_k=top_k)
 
 
 def decode_scan(params, cache, last_logits, key, temperature,

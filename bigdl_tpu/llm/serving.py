@@ -16,6 +16,11 @@ here is a TPU-shaped **continuous batching** loop:
   into the shared cache at the slot's rows (the "continuous" part:
   no waiting for the whole batch to drain, the vLLM scheduling idea on
   a slot-static cache);
+- steps are dispatched PIPELINED (ISSUE 4): sampling runs on device
+  inside the compiled step, and up to ``bigdl.llm.pipeline_depth``
+  steps are in flight before the oldest's tokens are drained — host
+  scheduling (admission, prefill, EOS bookkeeping) overlaps device
+  compute instead of round-tripping per token;
 - results stream out through the handle (``get()`` blocks; ``tokens``
   grows as the loop runs).
 
@@ -26,6 +31,7 @@ deployment shim over exactly this object.
 
 from __future__ import annotations
 
+import collections
 import functools
 import queue
 import threading
@@ -39,6 +45,7 @@ import numpy as np
 
 from bigdl_tpu import observability as obs
 from bigdl_tpu import reliability
+from bigdl_tpu.llm.kernels.sampling import make_sampled_step
 from bigdl_tpu.observability import request_context as rc
 
 
@@ -53,14 +60,33 @@ def _llm_instruments():
             "Prompt tokens prefilled into the KV cache"),
         "prefill_seconds": obs.histogram(
             "bigdl_llm_prefill_seconds",
-            "Wall time of one request prefill (compile excluded after "
-            "first hit per length bucket)"),
+            "Host wall of one request prefill (compile excluded after "
+            "first hit per length bucket). At pipeline_depth 1 this "
+            "covers execution (the prefill barriers); at depth > 1 it "
+            "is DISPATCH time — execution overlaps decode by design"),
         "decode_tokens": obs.counter(
             "bigdl_llm_decode_tokens_total",
             "Tokens decoded across all slots"),
         "decode_seconds": obs.histogram(
             "bigdl_llm_decode_step_seconds",
-            "Wall time of one engine decode step (all active slots)"),
+            "Host wall attributed to one decode step: scheduling + "
+            "fence stall (under pipelining device compute overlaps the "
+            "host, so this is NOT pure device time — see the host/stall "
+            "split below and docs/PERFORMANCE.md)"),
+        "decode_host": obs.histogram(
+            "bigdl_llm_decode_host_seconds",
+            "Host-side scheduling slice of one decode step (page "
+            "allocation + dispatch; no device wait)",
+            buckets=obs.FAST_BUCKETS),
+        "decode_stall": obs.histogram(
+            "bigdl_llm_decode_stall_seconds",
+            "Host time blocked on the device fence when draining a "
+            "decode step (the pipeline's residual stall)",
+            buckets=obs.FAST_BUCKETS),
+        "inflight": obs.gauge(
+            "bigdl_llm_pipeline_inflight",
+            "Decode steps dispatched but not yet drained (bounded by "
+            "bigdl.llm.pipeline_depth)"),
         "requests": obs.counter(
             "bigdl_llm_requests_total",
             "Requests finished by the engine", labelnames=("reason",)),
@@ -81,9 +107,11 @@ def _sync_barrier(*arrays):
     ``jax.block_until_ready`` alone is NOT reliable on every runtime
     (the axon-tunneled TPU runtime returns early from it); the only
     portable barrier is a real device-to-host fetch, so we pull one
-    element of every array in a single tiny transfer. The engine is
-    already host-synchronous once per token (the argmax fetch), so this
-    adds one small dispatch per step, not a new synchronization regime.
+    element of every array in a single tiny transfer. The pipelined
+    engine (ISSUE 4) uses this only at ``pipeline_depth=1`` — its
+    steady-state fence is the drain fetch of the step's own
+    (tokens ‖ fence) vector, which delivers the data AND the barrier in
+    one transfer (kernels.sampling.fence_token).
     """
     jax.block_until_ready(arrays)
     np.asarray(jnp.stack([a.ravel()[0].astype(jnp.float32)
@@ -216,6 +244,12 @@ def paged_decode_step(params, cfg, k_pages, v_pages, bt, lens, toks,
     return logits[:, 0].astype(jnp.float32), k_pages, v_pages
 
 
+# pipelined-engine step shape for the llama family (ISSUE 4): greedy/
+# temperature/top-k sampling folded into the compiled step, lens carried
+# on device, fence element folded onto the token vector
+paged_decode_step_sampled = make_sampled_step(paged_decode_step)
+
+
 class Request:
     """Handle returned by :meth:`LLMServer.submit`."""
 
@@ -269,15 +303,38 @@ class LLMServer:
 
     ``paged=False`` keeps the round-3 slot-static cache (one
     ``max_seq_len`` window per slot).
+
+    **Pipelined dispatch (ISSUE 4).** Decode no longer round-trips to
+    the host per token: sampling is folded into the compiled step (next
+    ids are produced on device), block tables and lengths live device-
+    resident with incremental scatter updates, and up to
+    ``pipeline_depth`` steps (``bigdl.llm.pipeline_depth``, default 2)
+    are dispatched before the oldest is drained — so admission, prefill
+    scheduling and EOS bookkeeping run WHILE the device computes. Each
+    in-flight record pins the (non-donated) buffers its step consumes
+    until the drain fetch — a real device→host fetch of the step's
+    fence — proves the step retired, preserving the round-4
+    buffer-lifetime fix without a blocking barrier per token. Steps
+    dispatched for a request that drains as finished are speculative;
+    their tokens are discarded and their page use stays inside the
+    request's admission budget (dispatches per request are capped at
+    ``max_new_tokens``). ``pipeline_depth=1`` reproduces the
+    synchronous engine exactly: every step drains (and every prefill
+    barriers) before the next dispatch, and no buffer outlives its
+    iteration. See docs/PERFORMANCE.md.
     """
 
     def __init__(self, model, max_batch: int = 4, max_seq_len: int = 256,
                  eos_token_id: Optional[int] = None, paged: bool = True,
                  page_size: int = 16, num_pages: Optional[int] = None,
-                 max_queue: int = 0):
+                 max_queue: int = 0,
+                 pipeline_depth: Optional[int] = None,
+                 temperature: float = 0.0, top_k: int = 0,
+                 sample_seed: int = 0):
         import inspect
 
         from bigdl_tpu.llm.models.llama import forward, init_cache
+        from bigdl_tpu.utils.conf import conf
 
         self.model = model
         self.cfg = model.config
@@ -290,6 +347,7 @@ class LLMServer:
         if fam_forward is None:
             self._fam_forward, self._fam_init_cache = forward, init_cache
             self._fam_paged_step = paged_decode_step
+            self._fam_sampled_step = paged_decode_step_sampled
             self._family = "llama"
         else:
             self._fam_forward = fam_forward
@@ -297,6 +355,12 @@ class LLMServer:
             fam_mod = inspect.getmodule(fam_forward)
             self._fam_paged_step = getattr(fam_mod, "paged_decode_step",
                                            None)
+            self._fam_sampled_step = getattr(
+                fam_mod, "paged_decode_step_sampled", None)
+            if self._fam_sampled_step is None and \
+                    self._fam_paged_step is not None:
+                self._fam_sampled_step = make_sampled_step(
+                    self._fam_paged_step)
             self._family = fam_mod.__name__.rsplit(".", 1)[-1]
             if paged and self._fam_paged_step is None:
                 raise NotImplementedError(
@@ -328,6 +392,28 @@ class LLMServer:
                                jnp.float32)
         self._stop = threading.Event()
         self._lock = threading.Lock()
+        # pipelined dispatch (ISSUE 4): bounded window of dispatched-
+        # but-undrained steps; each record pins the non-donated buffers
+        # its step consumes until the drain fetch proves it retired
+        depth = pipeline_depth if pipeline_depth is not None else \
+            conf.get_int("bigdl.llm.pipeline_depth", 2)
+        self.pipeline_depth = max(1, int(depth))
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self._do_sample = self.temperature > 0.0
+        self._temp = jnp.float32(self.temperature if self._do_sample
+                                 else 1.0)
+        self._sample_key = jax.random.PRNGKey(sample_seed)
+        self._inflight: "collections.deque" = collections.deque()
+        # buffers consumed by eagerly-dispatched bookkeeping updates
+        # (prefill scatters, freed-row resets): released at the NEXT
+        # dispatched step's fence — those updates enqueue after the
+        # already-in-flight steps, so only a later fence bounds them
+        self._pending_release: List[Any] = []
+        # always-on plain-python accounting (not metric series): the
+        # host-vs-stall split tools/microbench_decode.py reads
+        self.host_seconds = 0.0
+        self.stall_seconds = 0.0
         # ISSUE 3 flight recorder: every jit entry point of the engine
         # is wrapped so compiles/recompiles (the per-length prefill
         # buckets, a batch-width drift on the decode step) are counted,
@@ -361,6 +447,13 @@ class LLMServer:
             self._budget_avail = self._num_pages - 1
             self._bt = np.zeros((max_batch, self._pages_cap), np.int32)
             self._lens = np.zeros(max_batch, np.int32)
+            # device-resident twins (ISSUE 4): the step reads/advances
+            # these on device; the host applies incremental scatters
+            # (page grants, prefills, freed-row resets) instead of
+            # re-uploading the whole tables every token. The np arrays
+            # above remain the host's dispatch-time bookkeeping view.
+            self._bt_dev = jnp.asarray(self._bt)
+            self._lens_dev = jnp.asarray(self._lens)
             self._slot_pages: List[List[int]] = [[] for _ in
                                                  range(max_batch)]
             self._slot_budget = np.zeros(max_batch, np.int64)
@@ -368,8 +461,10 @@ class LLMServer:
             self._cache = init_cache(self.cfg, max_batch, self.max_seq_len,
                                      dtype=model.cache_dtype)
             # per-slot write positions (the shared scalar cache["pos"] is
-            # replaced by a vector so slots advance independently)
+            # replaced by a vector so slots advance independently); the
+            # device twin advances inside the compiled step (ISSUE 4)
             self._pos = np.zeros(max_batch, np.int32)
+            self._pos_dev = jnp.asarray(self._pos)
 
     @property
     def pages_in_use(self) -> int:
@@ -380,6 +475,11 @@ class LLMServer:
     # -- client API ----------------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens: int = 32) -> Request:
         reliability.inject("llm.submit")
+        if max_new_tokens < 1:
+            # a zero-budget request would occupy a slot with no step
+            # ever dispatched for it (dispatches are capped at
+            # max_new_tokens) — reject instead of wedging the slot
+            raise ValueError("max_new_tokens must be >= 1")
         req = Request(prompt_ids, max_new_tokens)
         if len(req.prompt_ids) + max_new_tokens > self.max_seq_len:
             raise ValueError("prompt + max_new_tokens exceeds max_seq_len")
@@ -427,8 +527,45 @@ class LLMServer:
         self._stop.set()
         if self._thread:
             self._thread.join(timeout=30)
+        if self._thread is not None and self._thread.is_alive():
+            # join timed out: the engine thread is wedged but still owns
+            # the window — touching the deque here would race it
+            return
+        # resolve any still-in-flight dispatches (stop(drain=False)
+        # abandons their tokens by contract; with drain=True the loop
+        # idles only once every request finished, so leftovers here are
+        # purely speculative) — the fence fetch guarantees no pinned
+        # buffer is dropped while a computation still reads it
+        while self._inflight:
+            rec = self._inflight.popleft()
+            try:
+                np.asarray(rec["out"])
+            except Exception:   # a dead device can't hold references
+                pass
+        if self._pending_release:
+            # bookkeeping scatters enqueued AFTER the newest step have
+            # no later fence — bound them via their own outputs (the
+            # current device tables data-depend on every such update)
+            # before the pinned references drop
+            try:
+                if self.paged:
+                    _sync_barrier(self._k_pages, self._v_pages,
+                                  self._bt_dev, self._lens_dev,
+                                  self._last)
+                else:
+                    _sync_barrier(self._cache["k"], self._cache["v"],
+                                  self._pos_dev, self._last)
+            except Exception:
+                pass
+            self._pending_release.clear()
 
     # -- engine --------------------------------------------------------------
+    def _pin(self, *arrays):
+        """Keep references to buffers consumed by an in-flight dispatch
+        until a later step's fence resolves (the round-4 race: a
+        released buffer can be recycled for concurrent jax work while
+        the enqueued computation still reads it)."""
+        self._pending_release.extend(arrays)
     def _admit(self):
         """Fill free slots from the queue; per-slot prefill. Paged mode
         additionally requires the request's worst-case page budget
@@ -537,18 +674,28 @@ class LLMServer:
             "v": jnp.where(keep, new_cache["v"], old["v"]),
             "pos": old["pos"],
         }
+        # RACE FIX (round 4, pipelined in ISSUE 4): the buffers consumed
+        # by the dispatches above must outlive them. Under jax's async
+        # dispatch, dropping the previous cache while the computation
+        # consuming it is still in flight lets the runtime recycle those
+        # buffers for CONCURRENT jax work on other threads, and the
+        # in-flight computation then reads overwritten memory
+        # (reproduced: 14/30 greedy-parity mismatches with 4 hammer
+        # threads; 0/30 with the barrier — see the stress test in
+        # tests/test_llm_serving.py). At depth 1 we barrier exactly like
+        # the synchronous engine; at depth > 1 the references are pinned
+        # until the next drained step's fence instead of blocking.
+        self._pin(old["k"], old["v"], cache_in["pos"], toks, positions,
+                  logits, new_cache["k"], new_cache["v"], self._last,
+                  self._pos_dev)
         self._last = self._last.at[i].set(logits[i, -1])
-        # RACE FIX (round 4): synchronize before the old cache buffers are
-        # released. Under jax's async dispatch, dropping the previous
-        # cache while the step consuming it is still in flight lets the
-        # runtime recycle those buffers for CONCURRENT jax computations on
-        # other threads (e.g. another serving loop or test traffic), and
-        # the in-flight step then reads overwritten memory. Reproduced:
-        # 14/30 greedy-parity mismatches with 4 hammer threads; 0/30 with
-        # this barrier (see the stress test in tests/test_llm_serving.py).
-        _sync_barrier(self._cache["k"], self._cache["v"], self._last)
-        del old
         self._pos[i] = start + t
+        self._pos_dev = self._pos_dev.at[i].set(start + t)
+        if self.pipeline_depth == 1:
+            _sync_barrier(self._cache["k"], self._cache["v"], self._last,
+                          self._pos_dev)
+            self._pending_release.clear()
+        del old
         self._slots[i] = req
         self._remaining[i] = req.max_new_tokens
 
@@ -617,51 +764,88 @@ class LLMServer:
             toks[0, :t] = req.prompt_ids
             pids = np.zeros(bucket // page, np.int32)
             pids[:npages] = ids
+            toks_d = jnp.asarray(toks)
+            t_d = jnp.asarray(t, jnp.int32)
+            pids_d = jnp.asarray(pids)
             self._k_pages, self._v_pages, last = fn(
                 self.model.params, self._k_pages, self._v_pages,
-                jnp.asarray(toks), jnp.asarray(t, jnp.int32),
-                jnp.asarray(pids))
+                toks_d, t_d, pids_d)
         except BaseException:
             self._free.extend(ids)   # physical pages must not leak
             raise
+        # same async-dispatch buffer-lifetime contract as _prefill_slot:
+        # pin everything the prefill + scatter dispatches consume, then
+        # barrier only at depth 1 (the synchronous engine's behavior)
+        self._pin(toks_d, t_d, pids_d, last, self._last, self._bt_dev,
+                  self._lens_dev)
         self._last = self._last.at[i].set(last)
-        # same async-dispatch buffer-lifetime barrier as _prefill_slot
-        _sync_barrier(self._k_pages, self._v_pages, self._last)
         self._bt[i, :] = 0
         self._bt[i, :npages] = ids
         self._lens[i] = t
+        row = np.zeros(self._pages_cap, np.int32)
+        row[:npages] = ids
+        row_d = jnp.asarray(row)
+        self._pin(row_d)
+        self._bt_dev = self._bt_dev.at[i].set(row_d)
+        self._lens_dev = self._lens_dev.at[i].set(t)
+        if self.pipeline_depth == 1:
+            _sync_barrier(self._k_pages, self._v_pages, self._last,
+                          self._bt_dev, self._lens_dev)
+            self._pending_release.clear()
         self._slot_pages[i] = ids
         self._slots[i] = req
         self._remaining[i] = req.max_new_tokens
 
     def _build_paged_decode(self):
-        """One decode step over the page pool — the family's
-        ``paged_decode_step`` jitted with donated pools."""
+        """One pipelined decode step over the page pool — the family's
+        ``paged_decode_step_sampled`` jitted with donated pools:
+        consumes the previous step's logits, samples on device, writes
+        K/V, advances the device-resident lengths for active rows and
+        returns the sampled ids with a fence element appended."""
         cfg = self.cfg
         page = self._page
-        fam_step = self._fam_paged_step
+        fam_sampled = self._fam_sampled_step
+        do_sample, top_k = self._do_sample, self.top_k
 
-        def step(params, k_pages, v_pages, bt, lens, toks):
-            return fam_step(params, cfg, k_pages, v_pages, bt,
-                            lens, toks[:, 0], page=page)
+        def step(params, k_pages, v_pages, bt, lens, last, active, temp,
+                 key):
+            return fam_sampled(params, cfg, k_pages, v_pages, bt, lens,
+                               last, active, temp, key, page=page,
+                               do_sample=do_sample, top_k=top_k)
 
         return obs.compiled(step, name="llm/decode_paged",
                             donate_argnums=(1, 2))
 
-    def _record_decode(self, n_active: int, seconds: float,
-                       finished: int):
+    def _record_decode(self, n_active: int, applied: int, host_s: float,
+                       stall_s: float, finished: int):
+        """Per-step attribution (ISSUE 4 satellite): the old single wall
+        number silently included the sync barrier and overstated device
+        cost; host scheduling and the device-fence stall are now
+        separate series (their sum is the host wall this step cost —
+        device compute overlapped by the pipeline shows up in neither).
+        ``applied`` counts only DELIVERED tokens — speculative rows
+        (finished requests) decoded but discarded don't inflate the
+        token counter."""
         ins = self._instruments()
         if ins is None:
             return
-        ins["decode_tokens"].inc(n_active)
-        ins["decode_seconds"].observe(seconds)
+        wall = host_s + stall_s
+        ins["decode_tokens"].inc(applied)
+        ins["decode_seconds"].observe(wall)
+        ins["decode_host"].observe(host_s)
+        ins["decode_stall"].observe(stall_s)
         # the duration is already measured, so the span is appended
         # directly rather than re-bracketing the step with a context
         # manager
         obs.tracing.add_complete(
-            "llm/decode_step", time.time() - seconds, seconds,
-            active=n_active, step=self.steps)
-        ins["active"].set(n_active - finished)
+            "llm/decode_step", time.time() - wall, wall,
+            active=n_active, step=self.steps,
+            host_s=round(host_s, 6), stall_s=round(stall_s, 6))
+        # live occupancy, not the drained record's pair count: a record
+        # may carry speculative pairs for requests finished by an
+        # earlier drain, which would leave a phantom nonzero gauge on
+        # an idle server
+        ins["active"].set(sum(r is not None for r in self._slots))
         if finished:
             ins["requests"].labels(reason="done").inc(finished)
         self._record_kv_gauges(ins)
@@ -680,159 +864,265 @@ class LLMServer:
         obs.add_complete("llm/decode", req.decode_started_at,
                          time.time() - req.decode_started_at, **args)
 
+    def _dispatchable(self) -> List[int]:
+        """Slots a new step should decode for: occupied AND with
+        dispatch budget left. A request gets at most ``max_new_tokens``
+        dispatched steps — so speculative dispatches past a data-
+        dependent EOS never allocate pages beyond the admission
+        reserve, and a slot whose final step is in flight goes quiet."""
+        return [i for i, r in enumerate(self._slots)
+                if r is not None and self._remaining[i] > 0]
+
+    def _after_dispatch(self, rec: dict, t0: float) -> bool:
+        """Shared dispatch epilogue: account host time, push the record
+        onto the in-flight window, drain down to the depth bound (depth
+        1 drains immediately — the synchronous engine)."""
+        rec["host_s"] = time.perf_counter() - t0
+        self.host_seconds += rec["host_s"]
+        self.steps += 1
+        self._inflight.append(rec)
+        ins = self._instruments()
+        if ins is not None:
+            ins["inflight"].set(len(self._inflight))
+        while len(self._inflight) >= self.pipeline_depth:
+            self._drain_next()
+        return True
+
+    def _drain_next(self):
+        """Retire the oldest in-flight step: ONE device→host fetch of
+        its (tokens ‖ fence) vector — the portable completion barrier —
+        then EOS/max-token bookkeeping one step behind dispatch
+        (mirroring the optimizer's ``_pending_loss`` drain). Slots whose
+        request finished meanwhile discard their speculative token."""
+        rec = self._inflight.popleft()
+        t0 = time.perf_counter()
+        vals = np.asarray(rec["out"])
+        stall = time.perf_counter() - t0
+        self.stall_seconds += stall
+        # the fence proves every computation enqueued before this step —
+        # including the updates rec["pinned"] was holding buffers for —
+        # has retired; the references may drop now
+        rec["pinned"] = rec["refs"] = None
+        finished = applied = 0
+        for i, req in rec["pairs"]:
+            if self._slots[i] is not req:
+                continue   # speculative token for a finished request
+            tok = int(vals[i])
+            req.tokens.append(tok)
+            applied += 1
+            if (self.eos_token_id is not None
+                    and tok == self.eos_token_id) \
+                    or len(req.tokens) >= req.max_new_tokens:
+                self._finish_slot(i, req)
+                finished += 1
+        if finished and self.pipeline_depth == 1:
+            # strict synchrony at depth 1: the freed-row resets above
+            # must resolve before their consumed buffers drop (exactly
+            # the old engine's per-step barrier cadence)
+            if self.paged:
+                _sync_barrier(self._bt_dev, self._lens_dev)
+            else:
+                _sync_barrier(self._pos_dev)
+            self._pending_release.clear()
+        ins = self._instruments()
+        if ins is not None:
+            ins["inflight"].set(len(self._inflight))
+        self._record_decode(len(rec["pairs"]), applied,
+                            rec.get("host_s", 0.0), stall, finished)
+
+    def _finish_slot(self, i: int, req: Request):
+        self._emit_decode_span(req)
+        req.done.set()
+        self._slots[i] = None
+        self._remaining[i] = 0
+        if self.paged:
+            self._free.extend(self._slot_pages[i])
+            self._slot_pages[i] = []
+            self._budget_avail += int(self._slot_budget[i])
+            self._slot_budget[i] = 0
+            self._bt[i, :] = 0    # orphaned rows must point at trash:
+            self._lens[i] = 0     # a stale id could alias a reissued
+            # page and the inactive row's dummy write would clobber it
+            self._pin(self._bt_dev, self._lens_dev)
+            self._bt_dev = self._bt_dev.at[i].set(0)
+            self._lens_dev = self._lens_dev.at[i].set(0)
+        else:
+            # freed slot restarts at position 0: stale kv beyond the
+            # next request's own positions is masked by the causal
+            # valid test and overwritten as it advances
+            self._pos[i] = 0
+            self._pin(self._pos_dev)
+            self._pos_dev = self._pos_dev.at[i].set(0)
+
     def _step_paged(self) -> bool:
-        active = [i for i, r in enumerate(self._slots) if r is not None]
-        if not active:
+        disp = self._dispatchable()
+        if not disp:
+            if self._inflight:   # nothing new to dispatch: keep draining
+                self._drain_next()
+                return True
             return False
         t_step = time.perf_counter()
         page = self._page
-        # the page for position lens[i] must exist before the step
-        for i in active:
+        # the page for position lens[i] must exist before the step; the
+        # grant is an incremental scatter into the device-resident block
+        # table, not a re-upload (ISSUE 4)
+        allocs = []
+        for i in disp:
             pos = int(self._lens[i])
             if pos % page == 0:
                 pid = self._free.pop()   # guaranteed by budget reserve
                 self._bt[i, pos // page] = pid
                 self._slot_pages[i].append(pid)
-        nxt = np.asarray(jnp.argmax(self._last, axis=-1), np.int32)
-        key = self._step_cache_key() + ("decode",)
+                allocs.append((i, pos // page, pid))
+        if allocs:
+            rows, cols, vals = (np.asarray(v, np.int32)
+                                for v in zip(*allocs))
+            vals_d = jnp.asarray(vals)
+            self._pin(self._bt_dev, vals_d)
+            self._bt_dev = self._bt_dev.at[rows, cols].set(vals_d)
+        mask = np.zeros(self.max_batch, bool)
+        mask[disp] = True
+        active = jnp.asarray(mask)
+        key = self._step_cache_key() + ("decode", self._do_sample,
+                                        self.top_k)
         pdecode = _PAGED_STEP_CACHE.get(key)
         if pdecode is None:
             pdecode = _PAGED_STEP_CACHE[key] = self._build_paged_decode()
-        logits, self._k_pages, self._v_pages = pdecode(
-            self.model.params, self._k_pages, self._v_pages,
-            jnp.asarray(self._bt), jnp.asarray(self._lens),
-            jnp.asarray(nxt[:, None]))
+        bt_in, lens_in = self._bt_dev, self._lens_dev
+        last_in, key_in = self._last, self._sample_key
+        out, logits, self._k_pages, self._v_pages, self._lens_dev, \
+            self._sample_key = pdecode(
+                self.model.params, self._k_pages, self._v_pages, bt_in,
+                lens_in, last_in, active, self._temp, key_in)
         self._last = logits
-        _sync_barrier(self._k_pages, self._v_pages, logits)
-        for i in active:
-            tok = int(nxt[i])
-            req = self._slots[i]
-            req.tokens.append(tok)
-            self._remaining[i] -= 1
+        for i in disp:
             self._lens[i] += 1
-            if (self.eos_token_id is not None
-                    and tok == self.eos_token_id) \
-                    or self._remaining[i] <= 0:
-                self._emit_decode_span(req)
-                req.done.set()
-                self._slots[i] = None
-                self._free.extend(self._slot_pages[i])
-                self._slot_pages[i] = []
-                self._budget_avail += int(self._slot_budget[i])
-                self._slot_budget[i] = 0
-                self._bt[i, :] = 0    # orphaned rows must point at trash:
-                self._lens[i] = 0     # a stale id could alias a reissued
-                # page and the inactive row's dummy write would clobber it
-        self.steps += 1
-        self._record_decode(
-            len(active), time.perf_counter() - t_step,
-            finished=sum(1 for i in active if self._slots[i] is None))
-        return True
+            self._remaining[i] -= 1
+        rec = {"out": out,
+               "pairs": [(i, self._slots[i]) for i in disp],
+               "refs": (bt_in, lens_in, last_in, active, key_in),
+               "pinned": self._pending_release}
+        self._pending_release = []
+        return self._after_dispatch(rec, t_step)
 
     def _step_slotted(self):
-        """One decode step of the slot-static (paged=False) engine."""
-        active = [i for i, r in enumerate(self._slots) if r is not None]
-        if not active:
+        """One pipelined decode step of the slot-static (paged=False)
+        engine: same dispatch/drain structure as the paged path, with
+        the per-slot position vector device-resident and advanced inside
+        the compiled step."""
+        disp = self._dispatchable()
+        if not disp:
+            if self._inflight:
+                self._drain_next()
+                return True
             return False
         t_step = time.perf_counter()
-        nxt = np.asarray(jnp.argmax(self._last, axis=-1), np.int32)
-        toks = jnp.asarray(nxt[:, None])
-        positions = jnp.asarray(self._pos[:, None])
-        # per-slot positions: slot rows beyond their own pos are masked
-        # by the causal test (slot_index <= q_position) in attention;
-        # the cache update slices at pos 0..1 would collide — use
-        # scatter per slot
-        logits, new_cache = self._decode_scatter(toks, positions)
-        for i in active:
-            tok = int(nxt[i])
-            req = self._slots[i]
-            req.tokens.append(tok)
-            self._remaining[i] -= 1
-            self._pos[i] += 1
-            if (self.eos_token_id is not None and tok == self.eos_token_id) \
-                    or self._remaining[i] <= 0:
-                self._emit_decode_span(req)
-                req.done.set()
-                self._slots[i] = None
-                # freed slot restarts at position 0: stale kv beyond the
-                # next request's own positions is masked by the causal
-                # valid test and overwritten as it advances
-                self._pos[i] = 0
-        self._last = logits
-        self.steps += 1
-        self._record_decode(
-            len(active), time.perf_counter() - t_step,
-            finished=sum(1 for i in active if self._slots[i] is None))
-        return True
-
-    def _decode_scatter(self, toks, positions):
-        """One decode step writing each slot's kv at its own position."""
-        if not hasattr(self, "_scatter_step"):
-            from bigdl_tpu.llm.models.llama import (_attention, _linear,
-                                                    attention_qkv, mlp,
-                                                    rms_norm, rope_cfg)
-            cfg = self.cfg
-
-            def step(params, cache_k, cache_v, pos_vec, toks, last_mask):
-                x = params["embed_tokens"][toks[:, 0]][:, None]   # (B,1,H)
-                b = x.shape[0]
-                s_max = cache_k.shape[2]
-                positions = pos_vec                               # (B, 1)
-                valid = (jnp.arange(s_max)[None, :]
-                         <= positions[:, 0][:, None])             # (B, S)
-
-                def layer_step(carry, inputs):
-                    x, = carry
-                    lp, k_cache, v_cache = inputs
-                    h = rms_norm(x, lp["input_layernorm"],
-                                 cfg.rms_norm_eps)
-                    q, k, v = attention_qkv(lp, h, cfg)
-                    q = rope_cfg(q, positions, cfg)
-                    k = rope_cfg(k, positions, cfg)
-                    # scatter each slot's kv at ITS position
-                    onehot = (jnp.arange(s_max)[None, :]
-                              == positions[:, 0][:, None])        # (B, S)
-                    k_cache = jnp.where(
-                        onehot[:, :, None, None],
-                        k.astype(k_cache.dtype), k_cache)
-                    v_cache = jnp.where(
-                        onehot[:, :, None, None],
-                        v.astype(v_cache.dtype), v_cache)
-                    attn = _attention(q, k_cache, v_cache, positions,
-                                      valid, cfg)
-                    x = x + _linear(lp["o_proj"], attn)
-                    h2 = rms_norm(x, lp["post_attention_layernorm"],
-                                  cfg.rms_norm_eps)
-                    if cfg.num_experts:
-                        from bigdl_tpu.llm.models.llama import _moe_ffn
-                        x = x + _moe_ffn(lp, h2, cfg)
-                    else:
-                        x = x + mlp(lp, h2, x.dtype)
-                    return (x,), (k_cache, v_cache)
-
-                (x,), (k_new, v_new) = jax.lax.scan(
-                    layer_step, (x,),
-                    (params["layers"], cache_k, cache_v))
-                x = rms_norm(x, params["norm"], cfg.rms_norm_eps)
-                head = params.get("lm_head")
-                if head is None:
-                    logits = x @ params["embed_tokens"].T.astype(x.dtype)
-                else:
-                    logits = _linear(head, x)
-                return logits[:, 0].astype(jnp.float32), k_new, v_new
-
-            self._scatter_step = obs.compiled(step,
-                                              name="llm/decode_slotted")
-
-        logits, k_new, v_new = self._scatter_step(
-            self.model.params, self._cache["k"], self._cache["v"],
-            positions, toks, None)
+        step = self._slotted_step()
+        mask = np.zeros(self.max_batch, bool)
+        mask[disp] = True
+        active = jnp.asarray(mask)
+        k_in, v_in = self._cache["k"], self._cache["v"]
+        pos_in, last_in, key_in = (self._pos_dev, self._last,
+                                   self._sample_key)
+        out, logits, k_new, v_new, self._pos_dev, self._sample_key = \
+            step(self.model.params, k_in, v_in, pos_in, last_in, active,
+                 self._temp, key_in)
         old = self._cache
         self._cache = {"k": k_new, "v": v_new, "pos": old["pos"]}
-        # same async-dispatch buffer-lifetime barrier as _prefill_slot
-        _sync_barrier(k_new, v_new, logits)
+        self._last = logits
+        for i in disp:
+            self._pos[i] += 1
+            self._remaining[i] -= 1
+        # the old cache is NOT donated on this legacy path: it is an
+        # input of the in-flight step and must be pinned until its fence
+        rec = {"out": out,
+               "pairs": [(i, self._slots[i]) for i in disp],
+               "refs": (k_in, v_in, pos_in, last_in, active, key_in),
+               "pinned": self._pending_release}
+        self._pending_release = []
         del old
-        return logits, None
+        return self._after_dispatch(rec, t_step)
+
+    def _slotted_step(self):
+        """Build (once) the compiled slot-static decode step: on-device
+        sampling from the previous logits, per-slot kv scatter at each
+        row's own position, device position advance for active rows, and
+        the fence element on the token vector."""
+        if hasattr(self, "_scatter_step"):
+            return self._scatter_step
+        from bigdl_tpu.llm.kernels.sampling import (fence_token,
+                                                    sample_tokens)
+        from bigdl_tpu.llm.models.llama import (_attention, _linear,
+                                                attention_qkv, mlp,
+                                                rms_norm, rope_cfg)
+        cfg = self.cfg
+        do_sample, top_k = self._do_sample, self.top_k
+
+        def step(params, cache_k, cache_v, pos_vec, last, active, temp,
+                 key):
+            key, sub = jax.random.split(key)
+            toks = sample_tokens(last, sub, do_sample=do_sample,
+                                 temperature=temp, top_k=top_k)
+            x = params["embed_tokens"][toks][:, None]         # (B,1,H)
+            b = x.shape[0]
+            s_max = cache_k.shape[2]
+            positions = pos_vec[:, None].astype(jnp.int32)    # (B, 1)
+            valid = (jnp.arange(s_max)[None, :]
+                     <= positions[:, 0][:, None])             # (B, S)
+
+            def layer_step(carry, inputs):
+                x, = carry
+                lp, k_cache, v_cache = inputs
+                h = rms_norm(x, lp["input_layernorm"],
+                             cfg.rms_norm_eps)
+                q, k, v = attention_qkv(lp, h, cfg)
+                q = rope_cfg(q, positions, cfg)
+                k = rope_cfg(k, positions, cfg)
+                # scatter each slot's kv at ITS position
+                onehot = (jnp.arange(s_max)[None, :]
+                          == positions[:, 0][:, None])        # (B, S)
+                k_cache = jnp.where(
+                    onehot[:, :, None, None],
+                    k.astype(k_cache.dtype), k_cache)
+                v_cache = jnp.where(
+                    onehot[:, :, None, None],
+                    v.astype(v_cache.dtype), v_cache)
+                attn = _attention(q, k_cache, v_cache, positions,
+                                  valid, cfg)
+                x = x + _linear(lp["o_proj"], attn)
+                h2 = rms_norm(x, lp["post_attention_layernorm"],
+                              cfg.rms_norm_eps)
+                if cfg.num_experts:
+                    from bigdl_tpu.llm.models.llama import _moe_ffn
+                    x = x + _moe_ffn(lp, h2, cfg)
+                else:
+                    x = x + mlp(lp, h2, x.dtype)
+                return (x,), (k_cache, v_cache)
+
+            (x,), (k_new, v_new) = jax.lax.scan(
+                layer_step, (x,),
+                (params["layers"], cache_k, cache_v))
+            x = rms_norm(x, params["norm"], cfg.rms_norm_eps)
+            head = params.get("lm_head")
+            if head is None:
+                logits = x @ params["embed_tokens"].T.astype(x.dtype)
+            else:
+                logits = _linear(head, x)
+            logits = logits[:, 0].astype(jnp.float32)
+            new_pos = pos_vec + active.astype(pos_vec.dtype)
+            out = jnp.concatenate(
+                [toks, fence_token(k_new, v_new, logits)])
+            return out, logits, k_new, v_new, new_pos, key
+
+        # donate the cache like the paged pools: at depth > 1 each
+        # in-flight record would otherwise pin a full (L,B,S,H,D) cache
+        # generation until its fence — donation lets the runtime alias
+        # generations in place (the records still hold the refs for
+        # backends that decline donation; a donated ref holds no HBM)
+        self._scatter_step = obs.compiled(step,
+                                          name="llm/decode_slotted",
+                                          donate_argnums=(1, 2))
+        return self._scatter_step
 
     def _step(self):
         """Decode one token for every active slot."""

@@ -27,8 +27,8 @@ import time as _time
 
 from bigdl_tpu.observability import _state
 from bigdl_tpu.observability.metrics import (
-    CONTENT_TYPE, Counter, DEFAULT_BUCKETS, Gauge, Histogram,
-    MetricRegistry, parse_prometheus, render_prometheus)
+    CONTENT_TYPE, Counter, DEFAULT_BUCKETS, FAST_BUCKETS, Gauge,
+    Histogram, MetricRegistry, parse_prometheus, render_prometheus)
 from bigdl_tpu.observability import tracing
 from bigdl_tpu.observability.tracing import (
     EXEMPLARS, TRACE, TraceBuffer, add_complete, assemble_trace,
@@ -126,7 +126,7 @@ __all__ = [
     "CONTENT_TYPE", "Counter", "EXEMPLARS", "Gauge", "Histogram",
     "MetricRegistry", "PARENT_HEADER", "PROCESS_START_TIME", "REGISTRY",
     "TRACE", "TRACE_HEADER", "TraceBuffer", "TraceContext",
-    "DEFAULT_BUCKETS", "add_complete", "assemble_trace",
+    "DEFAULT_BUCKETS", "FAST_BUCKETS", "add_complete", "assemble_trace",
     "compile_recorder", "compile_stats", "compiled", "configure",
     "counter", "disable", "enable", "enabled", "export_chrome_trace",
     "gauge", "histogram", "parse_prometheus", "render",

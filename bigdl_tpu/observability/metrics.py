@@ -47,6 +47,13 @@ DEFAULT_BUCKETS: Tuple[float, ...] = (
     .0005, .001, .0025, .005, .01, .025, .05, .1, .25, .5,
     1.0, 2.5, 5.0, 10.0, 30.0)
 
+#: Sub-millisecond work — the pipelined engine's host-side scheduling
+#: slice and its device-fence stalls (ISSUE 4) live at 10 µs..10 ms,
+#: below DEFAULT_BUCKETS' useful resolution.
+FAST_BUCKETS: Tuple[float, ...] = (
+    .00001, .000025, .00005, .0001, .00025, .0005, .001, .0025,
+    .005, .01, .025, .05, .1, .5)
+
 
 def _escape_help(s: str) -> str:
     return s.replace("\\", "\\\\").replace("\n", "\\n")

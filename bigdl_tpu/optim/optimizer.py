@@ -20,6 +20,7 @@ from __future__ import annotations
 import logging
 import os
 import pickle
+import queue as _queue
 import signal
 import threading
 import time
@@ -81,6 +82,86 @@ def _train_instruments():
             "bigdl_train_throughput_examples_per_sec",
             "Throughput of the last completed epoch"),
     }
+
+
+class BatchPrefetcher:
+    """Double-buffered host→device batch staging (ISSUE 4).
+
+    The synchronous loop places batch N+1 only after step N returns, so
+    the device idles for the whole host-side stage (numpy assembly +
+    ``device_put``) every iteration — exactly the stall the reference's
+    DistriOptimizer hides by overlapping data prep with training (arXiv
+    1804.05839 §4). Here a background thread runs ``place_fn`` (the
+    optimizer's ``_place_batch``) for upcoming batches while the main
+    loop's current step is still dispatching/executing, holding at most
+    ``depth`` staged batches in a bounded queue. The main loop's data
+    timer then measures only queue-pop latency — visible in the
+    existing ``bigdl_train_data_wait_seconds_total`` /
+    ``..._compute_seconds_total`` split.
+
+    Gated by ``bigdl.train.prefetch`` (default true); ``false`` restores
+    the exact synchronous behavior (placement inline in the loop, no
+    thread, no queue). Iteration yields ``(x, t, size)`` with inputs
+    already on device. Errors in the producer (a failing transform, a
+    device_put OOM) surface on the consuming thread; ``close()`` (or an
+    abandoned epoch — early trigger fire, preemption) unblocks and
+    retires the producer. This complements ``DataSet.prefetch`` (which
+    overlaps host-side decode/augment): this stage overlaps the final
+    host→device placement with device compute.
+    """
+
+    _END = object()
+
+    def __init__(self, batches, place_fn, depth: int = 2):
+        self._q: "_queue.Queue" = _queue.Queue(maxsize=max(1, depth))
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, args=(batches, place_fn), daemon=True)
+        self._thread.start()
+
+    def _run(self, batches, place_fn):
+        try:
+            for mb in batches:
+                x, t = place_fn(mb.get_input(), mb.get_target())
+                if not self._put((x, t, mb.size())):
+                    return
+            self._put(self._END)
+        except BaseException as e:  # surface errors on the consumer
+            self._put(e)
+
+    def _put(self, item) -> bool:
+        # bounded put that gives up when the consumer is gone, so an
+        # abandoned epoch cannot leave the producer blocked forever
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.1)
+                return True
+            except _queue.Full:
+                continue
+        return False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._stop.is_set():
+            raise StopIteration
+        item = self._q.get()
+        if item is self._END:
+            self._stop.set()
+            raise StopIteration
+        if isinstance(item, BaseException):
+            self._stop.set()
+            raise item
+        return item
+
+    def close(self):
+        self._stop.set()
+        try:                       # unblock a producer stuck on put()
+            while True:
+                self._q.get_nowait()
+        except _queue.Empty:
+            pass
 
 
 def _to_device(tree, sharding=None):
@@ -422,53 +503,75 @@ class BaseOptimizer:
         ins = _train_instruments() if self._obs else None
         self._obs_ins = ins
 
+        from bigdl_tpu.utils.conf import conf
+        prefetch_on = conf.get_bool("bigdl.train.prefetch", True)
+        prefetch_depth = conf.get_int("bigdl.train.prefetch.depth", 2)
+
         while not self.end_trigger(state):
             records = 0
-            t_epoch = time.time()
+            t_epoch = time.perf_counter()
             ended_mid_epoch = False
-            with obs.span("train/epoch", epoch=state["epoch"]):
-                for mb in batcher(self.dataset.data(train=True)):
-                    reliability.inject("optimizer.step")
-                    with obs.span("train/step", step=state["neval"]):
-                        t0 = time.time()
-                        x, t = self._place_batch(mb.get_input(),
-                                                 mb.get_target())
-                        t_data = time.time() - t0
-                        self.metrics.add("data", t_data)
-                        lr = self.optim_method.current_lr()
-                        key, sub = jax.random.split(key)
-                        t0 = time.time()
-                        params, states, opt_state, loss, tele = step(
-                            params, states, opt_state, x, t, lr, sub)
-                        t_compute = time.time() - t0
-                        self.metrics.add("compute", t_compute)
-                        # loss is materialized one step late so the host
-                        # can dispatch iteration N+1 while the device
-                        # still runs N
-                        self._drain_loss()
-                        self._pending_loss = (loss, tele, state["neval"],
-                                              lr)
-                        records += mb.size()
-                        state["record_count"] += mb.size()
-                        if ins is not None:
-                            ins["step"].observe(t_data + t_compute)
-                            ins["data_wait"].inc(t_data)
-                            ins["compute"].inc(t_compute)
-                            ins["examples"].inc(mb.size())
-                            ins["steps"].inc()
-                    self.optim_method.host_state["eval_counter"] += 1
-                    state["neval"] += 1
-                    state["iteration_done"] += 1
-                    self._after_iteration(params, states, opt_state, state)
-                    self._check_preemption(params, states, opt_state,
-                                           state)
-                    if end_uses_loss:
-                        self._drain_loss()
-                    if self.end_trigger(state):
-                        ended_mid_epoch = True
-                        break
+            # ISSUE 4: with prefetch on, a background thread stages batch
+            # N+1 (including device placement) while step N is in
+            # flight; the data timer below then measures queue-pop
+            # latency, not staging. Off → inline placement, exactly the
+            # synchronous loop.
+            source = batcher(self.dataset.data(train=True))
+            batches = BatchPrefetcher(source, self._place_batch,
+                                      depth=prefetch_depth) \
+                if prefetch_on else self._staged_batches(source)
+            try:
+                with obs.span("train/epoch", epoch=state["epoch"]):
+                    while True:
+                        t0 = time.perf_counter()
+                        item = next(batches, None)
+                        t_data = time.perf_counter() - t0
+                        if item is None:
+                            break
+                        x, t, nrec = item
+                        reliability.inject("optimizer.step")
+                        with obs.span("train/step", step=state["neval"]):
+                            self.metrics.add("data", t_data)
+                            lr = self.optim_method.current_lr()
+                            key, sub = jax.random.split(key)
+                            t0 = time.perf_counter()
+                            params, states, opt_state, loss, tele = step(
+                                params, states, opt_state, x, t, lr, sub)
+                            t_compute = time.perf_counter() - t0
+                            self.metrics.add("compute", t_compute)
+                            # loss is materialized one step late so the
+                            # host can dispatch iteration N+1 while the
+                            # device still runs N
+                            self._drain_loss()
+                            self._pending_loss = (loss, tele,
+                                                  state["neval"], lr)
+                            records += nrec
+                            state["record_count"] += nrec
+                            if ins is not None:
+                                ins["step"].observe(t_data + t_compute)
+                                ins["data_wait"].inc(t_data)
+                                ins["compute"].inc(t_compute)
+                                ins["examples"].inc(nrec)
+                                ins["steps"].inc()
+                        self.optim_method.host_state["eval_counter"] += 1
+                        state["neval"] += 1
+                        state["iteration_done"] += 1
+                        self._after_iteration(params, states, opt_state,
+                                              state)
+                        self._check_preemption(params, states, opt_state,
+                                               state)
+                        if end_uses_loss:
+                            self._drain_loss()
+                        if self.end_trigger(state):
+                            ended_mid_epoch = True
+                            break
+            finally:
+                # an abandoned epoch (early trigger fire, preemption,
+                # a raising step) must retire the producer thread
+                if isinstance(batches, BatchPrefetcher):
+                    batches.close()
             self._drain_loss()
-            thr = records / max(time.time() - t_epoch, 1e-9)
+            thr = records / max(time.perf_counter() - t_epoch, 1e-9)
             logger.info(
                 "Epoch %d done: loss=%.6f throughput=%.1f records/s (%s)",
                 state["epoch"], state["loss"], thr, self.metrics.summary())
@@ -502,6 +605,14 @@ class BaseOptimizer:
         self._last_opt_state = jax.tree_util.tree_map(np.asarray,
                                                       opt_state)
         return self.model
+
+    def _staged_batches(self, source):
+        """Synchronous staging (``bigdl.train.prefetch=false``): place
+        each batch inline so the loop's data timer covers the full
+        host-side stage, exactly like the pre-prefetch loop."""
+        for mb in source:
+            x, t = self._place_batch(mb.get_input(), mb.get_target())
+            yield x, t, mb.size()
 
     def _drain_loss(self):
         pending = getattr(self, "_pending_loss", None)

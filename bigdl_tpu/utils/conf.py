@@ -47,6 +47,11 @@ _DEFAULTS: Dict[str, str] = {
     "bigdl.reliability.retry.base.delay": "0.05",  # seconds
     "bigdl.reliability.retry.max.delay": "2.0",    # backoff cap
     "bigdl.checkpoint.keep": "0",             # retention; 0 = unlimited
+    # async engine (ISSUE 4): decode steps dispatched ahead of the host
+    # drain. 1 = fully synchronous (the pre-pipeline engine, exactly)
+    "bigdl.llm.pipeline_depth": "2",
+    "bigdl.train.prefetch": "true",           # stage batch N+1 during N
+    "bigdl.train.prefetch.depth": "2",        # staged batches held ahead
 }
 
 

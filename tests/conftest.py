@@ -33,6 +33,11 @@ def pytest_configure(config):
         "markers",
         "chaos: seeded fault-injection chaos runs (always also slow: "
         "tier-1 filters on 'not slow')")
+    config.addinivalue_line(
+        "markers",
+        "perf: performance microbenchmarks (latency/throughput "
+        "assertions are advisory on shared CI hosts; select with "
+        "-m perf)")
 
 
 @pytest.fixture(scope="session")

@@ -107,16 +107,32 @@ def _canon(metric: str, extra: Optional[dict]) -> str:
     return _ALIASES.get(metric, metric)
 
 
+def _step_ms_of(extra: Optional[dict]) -> Optional[float]:
+    sms = (extra or {}).get("step_ms")
+    return float(sms) if isinstance(sms, (int, float)) \
+        and not isinstance(sms, bool) else None
+
+
 def _flatten_full(rec: dict) -> Dict[str, float]:
+    """Top-level + embedded sub-record values, PLUS each record's
+    ``extra.step_ms`` under ``<name>.step_ms`` — a throughput number can
+    hold steady while per-step latency regresses (e.g. batch padding
+    drift), so the diff tracks both (ISSUE 4 satellite)."""
     flat: Dict[str, float] = {}
     if isinstance(rec.get("value"), (int, float)):
-        flat[_canon(rec.get("metric", "value"), rec.get("extra"))] = \
-            float(rec["value"])
+        name = _canon(rec.get("metric", "value"), rec.get("extra"))
+        flat[name] = float(rec["value"])
+        sms = _step_ms_of(rec.get("extra"))
+        if sms is not None:
+            flat[name + ".step_ms"] = sms
     for key, sub in (rec.get("extra") or {}).items():
         if isinstance(sub, dict) and \
                 isinstance(sub.get("value"), (int, float)):
-            flat[_canon(sub.get("metric", key), sub.get("extra"))] = \
-                float(sub["value"])
+            name = _canon(sub.get("metric", key), sub.get("extra"))
+            flat[name] = float(sub["value"])
+            sms = _step_ms_of(sub.get("extra"))
+            if sms is not None:
+                flat[name + ".step_ms"] = sms
     return flat
 
 
