@@ -861,6 +861,18 @@ def _compact_northstar(out: dict) -> dict:
             if k.startswith("depth")}
         if mb.get("speedup_vs_depth1") is not None:
             ns["decode_pipeline"]["speedup"] = mb["speedup_vs_depth1"]
+    # ISSUE 5: prefix-cache headline — TTFT off/on + prefill tokens the
+    # radix cache deleted on the shared-prompt workload
+    pb = ((ex.get("telemetry") or {}).get("microbench_prefix") or {})
+    if "error" in pb:
+        ns["prefix_cache"] = {"error": str(pb["error"])[:80]}
+    else:
+        ns["prefix_cache"] = {
+            "ttft_off_ms": (pb.get("cache_off") or {}).get("ttft_ms"),
+            "ttft_on_ms": (pb.get("cache_on") or {}).get("ttft_ms"),
+            "tokens_saved": pb.get("prefill_tokens_saved"),
+            "speedup": pb.get("ttft_speedup"),
+        }
     return {"metric": out["metric"], "value": out["value"],
             "unit": out["unit"], "vs_baseline": out.get("vs_baseline"),
             "extra": {"northstar_summary": ns,
@@ -905,6 +917,14 @@ def _telemetry_block() -> dict:
             depths=(1, 2, 4), batch=4, tokens=24)
     except Exception as e:
         out["microbench_decode"] = {"error": repr(e)}
+    try:
+        # ISSUE 5: shared-system-prompt replay with the prefix cache
+        # off/on — TTFT and prefill-tokens-saved (bench_regress diffs
+        # the ttft_ms pair across rounds)
+        from tools.microbench_prefix import run_prefix_bench
+        out["microbench_prefix"] = run_prefix_bench()
+    except Exception as e:
+        out["microbench_prefix"] = {"error": repr(e)}
     return out
 
 

@@ -34,7 +34,10 @@ def sample_tokens(logits, key, *, do_sample: bool = False,
     if not do_sample:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     scaled = logits / jnp.maximum(temperature, 1e-6)
-    if top_k > 0:
+    if 0 < top_k < scaled.shape[-1]:
+        # top_k >= vocab is a no-op filter — and lax.top_k rejects
+        # k > minor dim outright, so the clamp is correctness, not
+        # just a shortcut (locked by tests/test_sampling.py)
         kth = jax.lax.top_k(scaled, top_k)[0][:, -1][:, None]
         scaled = jnp.where(scaled < kth, -1e30, scaled)
     return jax.random.categorical(key, scaled).astype(jnp.int32)

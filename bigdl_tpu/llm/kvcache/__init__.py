@@ -1,0 +1,335 @@
+"""Prefix-aware KV-cache subsystem (ISSUE 5 tentpole).
+
+``bigdl_tpu/llm/kvcache`` owns the page pool that used to be embedded in
+``LLMServer`` and adds prefix reuse on top of it:
+
+- :mod:`~bigdl_tpu.llm.kvcache.pool` — refcounted page pool with
+  copy-on-write fork semantics and the admission-budget ledger;
+- :mod:`~bigdl_tpu.llm.kvcache.radix` — radix prefix index keyed on
+  page-size token chunks, leaf-first LRU eviction;
+- :mod:`~bigdl_tpu.llm.kvcache.prefill` — the family-generic partial
+  prefill (gather prefix pages → run suffix at a position offset →
+  scatter back, with the COW tail fork fused into the scatter);
+- :class:`KVCacheManager` (here) — the engine-facing façade: admission
+  lookup + suffix-only budget charging, adoption refcounts/pins,
+  chain insertion at prefill and EOS, on-demand LRU eviction (the
+  ``kvcache.evict`` fault site), and hit/miss/evict accounting.
+
+``bigdl.llm.kvcache.enabled=false`` (the default) keeps the manager as
+a pure pool wrapper: no radix index is constructed, no
+``bigdl_kvcache_*`` series are declared, every admission charges the
+full worst case, and page ids flow in the seed engine's exact order —
+the engine is bit-identical to the pre-kvcache one (asserted in
+tests/test_kvcache.py).
+
+See docs/KVCACHE.md for the page lifecycle and the invariants.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+from bigdl_tpu.llm.kvcache.pool import PagePool, PagePoolError
+from bigdl_tpu.llm.kvcache.prefill import make_partial_prefill
+from bigdl_tpu.llm.kvcache.radix import PrefixMatch, RadixIndex
+
+
+def _ceil_div(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+class Admission:
+    """One admitted request's cache grant, held per engine slot.
+
+    ``charge`` is the suffix-only budget reservation (released wholesale
+    at EOS); ``shared_pages`` the adopted full-prefix pages (one pool
+    ref + a possibly-shared pin each); ``tail_src`` the COW fork source
+    page when the match ended mid-page (a transient ref/pin dropped as
+    soon as the partial prefill is dispatched)."""
+
+    __slots__ = ("matched_len", "shared_pages", "tail_src", "tail_len",
+                 "charge")
+
+    def __init__(self, matched_len: int = 0,
+                 shared_pages: Optional[List[int]] = None,
+                 tail_src: Optional[int] = None, tail_len: int = 0,
+                 charge: int = 0):
+        self.matched_len = matched_len
+        self.shared_pages = shared_pages or []
+        self.tail_src = tail_src
+        self.tail_len = tail_len
+        self.charge = charge
+
+
+class KVCacheManager:
+    """Engine-facing façade over the pool + radix index.
+
+    Thread-safe (its own RLock): the engine thread admits/releases under
+    the engine lock, while ``submit`` peeks suffix costs from client
+    threads for shed diagnostics."""
+
+    def __init__(self, num_pages: int, page_size: int,
+                 enabled: bool = False):
+        self.pool = PagePool(num_pages, page_size)
+        self.page = page_size
+        self.enabled = bool(enabled)
+        self.index: Optional[RadixIndex] = (
+            RadixIndex(self.pool) if self.enabled else None)
+        self._lock = threading.RLock()
+        # always-on plain accounting (tools/microbench_prefix.py and
+        # GET /debug/kvcache read these; metric series mirror them only
+        # when observability is enabled)
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.prefix_tokens_reused = 0
+        self._ins: Optional[Dict[str, Any]] = None
+
+    # -- observability -------------------------------------------------------
+    def _instruments(self):
+        from bigdl_tpu import observability as obs
+        if not (self.enabled and obs.enabled()):
+            return None
+        if self._ins is None:
+            self._ins = {
+                "hits": obs.counter(
+                    "bigdl_kvcache_hits_total",
+                    "Admissions that reused a cached prefix"),
+                "misses": obs.counter(
+                    "bigdl_kvcache_misses_total",
+                    "Admissions with no cached prefix"),
+                "evictions": obs.counter(
+                    "bigdl_kvcache_evictions_total",
+                    "Pages evicted from the prefix index under pool "
+                    "pressure"),
+                "reused": obs.counter(
+                    "bigdl_kvcache_prefix_tokens_reused_total",
+                    "Prompt tokens served from cached prefixes instead "
+                    "of prefill"),
+                "indexed": obs.gauge(
+                    "bigdl_kvcache_indexed_pages",
+                    "Pages currently referenced by the prefix index"),
+                "shared": obs.gauge(
+                    "bigdl_kvcache_shared_pages",
+                    "Pages with more than one reference (index + live "
+                    "requests)"),
+                "occupancy": obs.gauge(
+                    "bigdl_kvcache_pool_occupancy",
+                    "Fraction of the usable page pool allocated "
+                    "(live + indexed)"),
+            }
+        return self._ins
+
+    def record_gauges(self):
+        ins = self._instruments()
+        if ins is None:
+            return
+        ins["indexed"].set(self.index.indexed_pages())
+        ins["shared"].set(self.pool.shared_pages())
+        ins["occupancy"].set(
+            self.pool.allocated() / max(self.pool.num_pages - 1, 1))
+
+    def _count(self, name: str, n: int = 1):
+        ins = self._instruments()
+        if ins is not None:
+            ins[name].inc(n)
+
+    # -- admission -----------------------------------------------------------
+    def suffix_budget(self, prompt_len: int, max_new: int,
+                      matched_len: int) -> int:
+        """Worst-case pages the request may still need to OWN: every
+        page from the first non-fully-shared one through the last
+        decode token. The COW fork target (a mid-page match's page) is
+        inside this range, so forks are pre-reserved too."""
+        full = _ceil_div(prompt_len + max_new, self.page)
+        return full - matched_len // self.page
+
+    def peek(self, prompt_ids, max_new: int) -> Dict[str, int]:
+        """Lock-held read-only suffix cost for shed/reject diagnostics:
+        no refs taken, no LRU touch, no counters."""
+        with self._lock:
+            matched = 0
+            if self.enabled:
+                m = self.index.lookup(prompt_ids, touch=False)
+                matched = min(m.matched_len, len(prompt_ids) - 1)
+            return {
+                "pages_needed": self.suffix_budget(
+                    len(prompt_ids), max_new, matched),
+                "pages_free": self.pool.budget_avail,
+                "matched_tokens": matched,
+            }
+
+    def admit(self, prompt_ids, max_new: int) -> Optional[Admission]:
+        """Look up the longest cached prefix, charge the suffix-only
+        budget (+ pins for newly-adopted shared pages), take adoption
+        refs, and pre-evict enough free pages for the prompt's own
+        pages. Returns None when the budget cannot cover it (the
+        engine's head-of-line wait). Raises only from the seeded
+        ``kvcache.evict`` fault site, with NOTHING charged or adopted —
+        the engine retries the whole admission."""
+        T = len(prompt_ids)
+        with self._lock:
+            if not self.enabled:
+                charge = self.suffix_budget(T, max_new, 0)
+                if charge > self.pool.budget_avail:
+                    return None
+                self.pool.charge(charge)
+                return Admission(charge=charge)
+            m = self.index.lookup(prompt_ids)
+            # a fully-cached prompt still runs >= 1 suffix token — the
+            # engine needs its logits to start decoding
+            if m.matched_len > T - 1:
+                m.matched_len = T - 1
+                if m.tail_len > 1:
+                    m.tail_len -= 1
+                elif m.tail_len == 1:
+                    m.tail_src, m.tail_len = None, 0
+                else:
+                    # pure full-page match: the last page turns into a
+                    # COW tail source missing its final slot
+                    m.tail_src = m.full_pages.pop()
+                    m.tail_len = self.page - 1
+            if not m.tail_len:
+                m.tail_src = None
+            charge = self.suffix_budget(T, max_new, m.matched_len)
+            adopt = list(m.full_pages)
+            if m.tail_src is not None:
+                adopt.append(m.tail_src)
+            need = charge + self.pool.pin_cost(adopt)
+            if need > self.pool.budget_avail:
+                return None
+            self.pool.charge(charge)
+            for pid in adopt:
+                self.pool.incref(pid)
+                self.pool.pin(pid)
+            adm = Admission(m.matched_len, m.full_pages, m.tail_src,
+                            m.tail_len, charge)
+            try:
+                own_prompt = (_ceil_div(T, self.page)
+                              - m.matched_len // self.page)
+                self.ensure_free(own_prompt)
+            except BaseException:
+                self.cancel(adm)
+                raise
+            if m.matched_len:
+                self.hits += 1
+                self.prefix_tokens_reused += m.matched_len
+                self._count("hits")
+                self._count("reused", m.matched_len)
+            else:
+                self.misses += 1
+                self._count("misses")
+            return adm
+
+    def cancel(self, adm: Admission):
+        """Roll an admission back (failed prefill / injected fault):
+        drop adoption refs+pins and the budget charge."""
+        with self._lock:
+            self.release_transient(adm)
+            for pid in adm.shared_pages:
+                self.pool.decref(pid)
+                self.pool.unpin(pid)
+            adm.shared_pages = []
+            self.pool.release(adm.charge)
+            adm.charge = 0
+
+    def release_transient(self, adm: Admission):
+        """Drop the COW fork source's transient ref/pin — safe as soon
+        as the partial prefill consuming it has been dispatched (the
+        donated-pool data dependency orders any later overwrite after
+        the gather)."""
+        with self._lock:
+            if adm.tail_src is not None:
+                self.pool.decref(adm.tail_src)
+                self.pool.unpin(adm.tail_src)
+                adm.tail_src = None
+
+    def release_slot(self, charge: int, owned, adopted):
+        """EOS/eviction release: decrement refcounts instead of freeing
+        — pages the index still references stay warm for reuse."""
+        with self._lock:
+            for pid in owned:
+                self.pool.decref(pid)
+            for pid in adopted:
+                self.pool.decref(pid)
+                self.pool.unpin(pid)
+            self.pool.release(charge)
+
+    # -- index maintenance ---------------------------------------------------
+    def insert(self, tokens, pages):
+        """Index a chain (prompt at prefill time; prompt+generated at
+        EOS). The index takes its own ref on each newly-indexed page."""
+        if not self.enabled or not len(tokens):
+            return
+        with self._lock:
+            self.index.insert(tokens, pages)
+            self.record_gauges()
+
+    # -- physical pages ------------------------------------------------------
+    def ensure_free(self, n: int):
+        """Make ``n`` pages allocatable, LRU-evicting index-only chains
+        under pool pressure. The ``kvcache.evict`` fault site arms
+        eviction races (chaos_check --kvcache); it fires BEFORE any
+        mutation so an injected raise is cleanly retryable."""
+        short = n - self.pool.free_pages()
+        if short <= 0:
+            return
+        if not self.enabled:
+            raise PagePoolError(
+                "page shortage with the prefix cache disabled: the "
+                "admission budget should have prevented this")
+        from bigdl_tpu import reliability
+        reliability.inject("kvcache.evict")
+        with self._lock:
+            freed = self.index.evict_lru(short)
+            self.evictions += len(freed)
+            self._count("evictions", len(freed))
+            self.record_gauges()
+            if len(freed) < short:
+                raise PagePoolError(
+                    f"eviction reclaimed {len(freed)}/{short} pages: "
+                    "the pin/budget invariant is broken")
+
+    def take_free(self) -> int:
+        with self._lock:
+            return self.pool.take_free()
+
+    def alloc(self, n: int) -> List[int]:
+        with self._lock:
+            return self.pool.alloc(n)
+
+    def free_owned(self, pages):
+        with self._lock:
+            for pid in pages:
+                self.pool.decref(pid)
+
+    # -- introspection -------------------------------------------------------
+    @property
+    def budget_avail(self) -> int:
+        return self.pool.budget_avail
+
+    def debug_stats(self) -> Dict[str, Any]:
+        """The ``GET /debug/kvcache`` body."""
+        with self._lock:
+            out = {
+                "enabled": self.enabled,
+                "page_size": self.page,
+                "num_pages": self.pool.num_pages,
+                "pages_free": self.pool.free_pages(),
+                "pages_allocated": self.pool.allocated(),
+                "pages_shared": self.pool.shared_pages(),
+                "pages_pinned": self.pool.pinned_pages(),
+                "budget_avail": self.pool.budget_avail,
+                "hits": self.hits,
+                "misses": self.misses,
+                "evictions": self.evictions,
+                "prefix_tokens_reused": self.prefix_tokens_reused,
+            }
+            if self.index is not None:
+                out["index"] = self.index.stats()
+            return out
+
+
+__all__ = ["Admission", "KVCacheManager", "PagePool", "PagePoolError",
+           "PrefixMatch", "RadixIndex", "make_partial_prefill"]

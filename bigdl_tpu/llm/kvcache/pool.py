@@ -1,0 +1,191 @@
+"""Refcounted KV page pool with copy-on-write semantics (ISSUE 5
+tentpole part 1).
+
+The serving engine's page pool used to be three bare attributes on
+``LLMServer`` (``_free`` / ``_budget_avail`` / ``_slot_pages``) with
+exactly one owner per page. Prefix sharing changes the ownership story:
+a page holding the KV of a common prompt prefix is referenced by the
+radix index AND by every live request that adopted it, so pages carry
+**refcounts** and are freed only when the last reference drops.
+
+Two kinds of capacity live here, deliberately separate:
+
+- **physical** pages — the free-id list. ``take_free``/``decref`` move
+  ids between the free list and the allocated map. The id order is the
+  seed engine's exactly (ids pop low-first, frees append), so a server
+  with the cache disabled allocates bit-identically to the pre-kvcache
+  engine.
+- **budget** — the admission reservation counter (the vLLM-style
+  worst-case reserve that makes decode deadlock-free). Reservations are
+  bookkeeping only; they never touch the free list. With prefix reuse
+  the engine charges only the *uncached suffix* plus one reservation per
+  newly **pinned** shared page (see below), so shared prefixes stop
+  eating admission capacity.
+
+**Pinning.** An index-held page (refcount 1) is evictable and costs no
+budget. The moment a live request adopts it the page becomes
+unevictable, so capacity must be reserved for it — but only ONCE no
+matter how many requests share it. ``pin``/``unpin`` keep a per-page
+live-adopter count and charge/release a single reservation on the
+0→1 / 1→0 transitions. The pool-wide invariant that keeps allocation
+deadlock-free::
+
+    unevictable pages  =  owned-by-live  +  pinned-shared
+                       <=  Σ admission charges  +  Σ pins
+                       =  (num_pages - 1) - budget_avail
+
+so ``free + evictable >= any remaining reservation`` always holds and a
+charged request can always obtain its physical pages (after eviction).
+
+**Copy-on-write.** The write barrier is a refcount rule, not a method:
+a page you solely own may be written in place; a shared page
+(refcount > 1) must never be — the writer allocates a fresh page and
+copies the shared slots. The serving engine realizes the fork inside
+the partial-prefill scatter: the adopted tail page is gathered
+read-only and its live slots are re-scattered into the adopter's own
+page, which is exactly fork-then-write in one dispatch (no separate
+copy kernel, no window where a half-forked page is visible).
+
+Pure host-side bookkeeping: no jax imports, trivially unit-testable.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+
+class PagePoolError(RuntimeError):
+    """Internal-invariant violation (double free, free-list underflow)."""
+
+
+class PagePool:
+    """Refcounted page-id allocator over ``num_pages`` physical pages.
+
+    Page 0 is the engine's trash page (inactive rows dummy-write there)
+    and is never allocatable; usable capacity is ``num_pages - 1``.
+    Not thread-safe by itself — the owning :class:`KVCacheManager`
+    serializes access (the engine additionally holds its own lock).
+    """
+
+    def __init__(self, num_pages: int, page_size: int):
+        if num_pages < 2:
+            raise ValueError("pool needs at least one usable page "
+                             "(page 0 is the reserved trash page)")
+        self.num_pages = num_pages
+        self.page_size = page_size
+        # seed-engine order: list(range(n-1, 0, -1)) popped from the end
+        # hands out page 1 first — disabled-mode allocation parity
+        self._free: List[int] = list(range(num_pages - 1, 0, -1))
+        self._ref: Dict[int, int] = {}
+        self.budget_avail = num_pages - 1
+        # live-adopter counts for shared (index-held) pages; each page
+        # with a nonzero count holds exactly ONE budget reservation
+        self._pins: Dict[int, int] = {}
+
+    # -- physical pages ------------------------------------------------------
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    def free_ids(self) -> List[int]:
+        """The raw free list (read-only by convention): the engine's
+        ``_free`` property and the pool-drained test assertions."""
+        return self._free
+
+    def allocated(self) -> int:
+        return len(self._ref)
+
+    def take_free(self) -> int:
+        """Pop one page (refcount 1). Caller must have reserved budget
+        and ensured the free list is non-empty (``ensure`` upstream) —
+        an empty list here is an accounting bug, not back-pressure."""
+        if not self._free:
+            raise PagePoolError(
+                "free-list underflow: allocation outside the admission "
+                "budget (reservation accounting is broken)")
+        pid = self._free.pop()
+        self._ref[pid] = 1
+        return pid
+
+    def alloc(self, n: int) -> List[int]:
+        return [self.take_free() for _ in range(n)]
+
+    def incref(self, pid: int) -> int:
+        if pid not in self._ref:
+            raise PagePoolError(f"incref of unallocated page {pid}")
+        self._ref[pid] += 1
+        return self._ref[pid]
+
+    def decref(self, pid: int) -> int:
+        """Drop one reference; refcount 0 returns the id to the free
+        list (append — the seed engine's ``_free.extend`` order)."""
+        r = self._ref.get(pid)
+        if r is None:
+            raise PagePoolError(f"decref of unallocated page {pid}")
+        if r == 1:
+            del self._ref[pid]
+            self._free.append(pid)
+            return 0
+        self._ref[pid] = r - 1
+        return r - 1
+
+    def refcount(self, pid: int) -> int:
+        return self._ref.get(pid, 0)
+
+    def shared_pages(self) -> int:
+        """Pages referenced more than once (the shared-page gauge)."""
+        return sum(1 for r in self._ref.values() if r > 1)
+
+    # -- admission budget ----------------------------------------------------
+    def charge(self, n: int):
+        """Reserve ``n`` pages of admission budget (worst-case suffix
+        cost). Callers check :attr:`budget_avail` first — going negative
+        is an accounting bug."""
+        if n > self.budget_avail:
+            raise PagePoolError(
+                f"budget overdraft: charge {n} with {self.budget_avail} "
+                "available")
+        self.budget_avail -= n
+
+    def release(self, n: int):
+        self.budget_avail += n
+        if self.budget_avail > self.num_pages - 1:
+            raise PagePoolError("budget over-release")
+
+    def pin(self, pid: int):
+        """A live request adopted shared page ``pid``: reserve one page
+        of budget on the first adopter only (0→1 transition)."""
+        c = self._pins.get(pid, 0)
+        if c == 0:
+            self.charge(1)
+        self._pins[pid] = c + 1
+
+    def pin_cost(self, pids) -> int:
+        """Reservations :meth:`pin` would newly take for ``pids`` —
+        admission checks ``suffix_budget + pin_cost`` atomically."""
+        seen = set()
+        cost = 0
+        for pid in pids:
+            if pid not in seen and self._pins.get(pid, 0) == 0:
+                cost += 1
+            seen.add(pid)
+        return cost
+
+    def unpin(self, pid: int):
+        c = self._pins.get(pid, 0)
+        if c <= 0:
+            raise PagePoolError(f"unpin of unpinned page {pid}")
+        if c == 1:
+            del self._pins[pid]
+            self.release(1)
+        else:
+            self._pins[pid] = c - 1
+
+    def pinned_pages(self) -> int:
+        return len(self._pins)
+
+    # -- eviction support ----------------------------------------------------
+    def evictable(self, pid: int) -> bool:
+        """Only the index holds it: refcount exactly 1 and unpinned.
+        (A pinned page always has refcount >= 2, but the explicit check
+        keeps the invariant readable.)"""
+        return self.refcount(pid) == 1 and pid not in self._pins

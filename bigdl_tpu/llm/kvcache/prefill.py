@@ -1,0 +1,111 @@
+"""Partial prefill over a pre-populated block-table prefix (ISSUE 5).
+
+When admission finds a cached prefix, only the uncached suffix must run
+through the model — but the suffix's attention still needs the prefix's
+K/V. :func:`make_partial_prefill` lifts a family ``forward`` into a
+prefill that:
+
+1. **gathers** the prefix pages (the request's pre-populated block-table
+   prefix) into the dense temp cache the family forward already expects,
+   at their absolute positions 0..offset;
+2. runs the family forward over the suffix tokens only, at a **position
+   offset** — the suffix attends to the gathered prefix plus itself
+   causally, exactly the math of the full prefill's later rows;
+3. **scatters** one page-aligned window back into the (donated) pools:
+   the suffix K/V into the request's own pages, PLUS the shared slots of
+   a partially-matched tail page into the request's fork target — the
+   copy-on-write fork fused into the same dispatch (no separate copy
+   kernel, no window where a half-forked page is visible).
+
+Shapes are static per ``(n_pp, bucket)`` — prefix pages padded to a
+power of two (pad ids point at trash page 0), suffix length padded like
+the full prefill's pow2 buckets — so the compile count stays
+logarithmic. The dynamic values (offset, true suffix length, page ids,
+per-token scatter targets) are runtime arguments.
+
+Why the garbage in pad pages / beyond-offset slots is harmless: every
+temp-cache slot at index >= offset is either overwritten by the
+suffix's own in-forward cache write (indices offset..offset+bucket) or
+masked by the forward's validity bound (indices >= offset+bucket), and
+causal masking orders real queries before any padding position.
+
+Each paged family module exposes::
+
+    paged_prefill_partial = make_partial_prefill(forward, init_cache)
+
+mirroring ``paged_decode_step_sampled = make_sampled_step(...)`` — one
+entry point per family, zero per-family math here.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def make_partial_prefill(forward_fn, init_cache_fn):
+    """Lift a family ``forward``/``init_cache`` pair into the engine's
+    partial-prefill shape.
+
+    The lifted function (jitted by the engine with the pools donated)::
+
+        partial_prefill(params, cfg, k_pages, v_pages, toks, length,
+                        offset, prefix_ids, phys, slots, *,
+                        page, n_pp, bucket, cache_dtype)
+        -> (k_pages, v_pages, last_logits)
+
+    - ``toks`` (1, bucket) int32 suffix tokens (zero-padded);
+    - ``length`` () int32 true suffix length (>= 1);
+    - ``offset`` () int32 cached-prefix length (the position offset);
+    - ``prefix_ids`` (n_pp,) int32 physical pages holding positions
+      ``0..offset`` in order (pad entries 0 = trash page);
+    - ``phys``/``slots`` (page + bucket,) int32 scatter targets for the
+      window starting at position ``(offset // page) * page``: token
+      ``j`` of the window lands in ``(phys[j], slots[j])``; entries the
+      request must not write route to trash page 0. The leading
+      sub-page slots (window start .. offset) target the COW fork page,
+      re-writing the adopted tail's shared slots into a page the
+      request owns.
+    """
+
+    def partial_prefill(params, cfg, k_pages, v_pages, toks, length,
+                        offset, prefix_ids, phys, slots, *, page: int,
+                        n_pp: int, bucket: int, cache_dtype):
+        L = k_pages.shape[0]
+        # one page of slack past the gathered prefix: the scatter window
+        # below is page-aligned, so with a page-aligned offset it starts
+        # AT the prefix end and must slice page+bucket in-bounds tokens
+        s_temp = n_pp * page + page + bucket
+        cache = init_cache_fn(cfg, 1, s_temp, dtype=cache_dtype)
+
+        def gathered(pages):
+            g = pages[:, prefix_ids]                 # (L,n_pp,H,page,D)
+            g = g.transpose(0, 1, 3, 2, 4)           # (L,n_pp,page,H,D)
+            return g.reshape(L, n_pp * page, *g.shape[3:])
+
+        cache["k"] = cache["k"].at[:, 0, :n_pp * page].set(
+            gathered(k_pages).astype(cache_dtype))
+        cache["v"] = cache["v"].at[:, 0, :n_pp * page].set(
+            gathered(v_pages).astype(cache_dtype))
+        cache["pos"] = offset.astype(jnp.int32)
+        positions = (offset + jnp.arange(bucket, dtype=jnp.int32))[None]
+        logits, cache2 = forward_fn(params, cfg, toks, cache, positions)
+
+        # page-aligned write-back window: [window0, window0+page+bucket)
+        # covers the fork page's shared slots AND every suffix token
+        window0 = (offset // page) * page
+        ks, vs = cache2["k"][:, 0], cache2["v"][:, 0]  # (L,s_temp,H,D)
+
+        def scatter(pages, vals):
+            w = jax.lax.dynamic_slice_in_dim(vals, window0,
+                                             page + bucket, axis=1)
+            return pages.at[:, phys, :, slots].set(
+                w.transpose(1, 0, 2, 3).astype(pages.dtype))
+
+        k_pages = scatter(k_pages, ks)
+        v_pages = scatter(v_pages, vs)
+        last = jax.lax.dynamic_index_in_dim(logits[0], length - 1, 0,
+                                            keepdims=False)
+        return k_pages, v_pages, last.astype(jnp.float32)
+
+    return partial_prefill

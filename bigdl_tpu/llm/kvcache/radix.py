@@ -1,0 +1,194 @@
+"""Radix prefix index over the KV page pool (ISSUE 5 tentpole part 2).
+
+Cached prefixes are stored as a radix tree keyed on **token-id chunks of
+page size** (the SGLang RadixAttention idea on our paged substrate): an
+edge's key is the exact tuple of token ids one page holds, and the node
+owns that page's id. Interior nodes are always full pages; a node whose
+chunk is shorter than a page is a **tail** — the partially-filled last
+page of an indexed chain, adoptable via copy-on-write (the adopter's
+first divergent write forks it, see pool.py).
+
+Lookup walks full chunks exactly, then scans the frontier's children
+for the best partial overlap (>= 1 token) — a divergent tail still
+donates the shared slots of its page, the rest is masked/overwritten by
+the adopter's own prefill. Every traversed node is LRU-touched.
+
+Eviction is leaf-first LRU: under pool pressure the least-recently-used
+leaf whose page only the index references (``pool.evictable``) is
+removed and its page decref'd back to the free list; interior nodes
+become leaves as their subtrees drain, so cold chains disappear
+back-to-front. Pages adopted by live requests (refcount > 1) are never
+eviction candidates.
+
+The index holds exactly one pool reference per node; dropping a node is
+one ``decref``. Host-side only — no jax, unit-testable with a bare
+:class:`~bigdl_tpu.llm.kvcache.pool.PagePool`.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from bigdl_tpu.llm.kvcache.pool import PagePool
+
+
+class RadixNode:
+    __slots__ = ("chunk", "page", "children", "parent", "last_used")
+
+    def __init__(self, chunk: Tuple[int, ...], page: Optional[int],
+                 parent: Optional["RadixNode"]):
+        self.chunk = chunk
+        self.page = page
+        self.parent = parent
+        self.children: Dict[Tuple[int, ...], "RadixNode"] = {}
+        self.last_used = 0
+
+
+class PrefixMatch:
+    """Result of :meth:`RadixIndex.lookup`.
+
+    ``matched_len`` counts matched TOKENS; ``full_pages`` are the page
+    ids of the fully-matched chunks (shareable outright);
+    ``tail_src``/``tail_len`` name the partially-matched page (COW
+    fork source) when the match ends mid-page."""
+
+    __slots__ = ("matched_len", "full_pages", "tail_src", "tail_len")
+
+    def __init__(self, matched_len: int = 0,
+                 full_pages: Optional[List[int]] = None,
+                 tail_src: Optional[int] = None, tail_len: int = 0):
+        self.matched_len = matched_len
+        self.full_pages = full_pages or []
+        self.tail_src = tail_src
+        self.tail_len = tail_len
+
+
+def _common_prefix(a, b) -> int:
+    n = min(len(a), len(b))
+    for i in range(n):
+        if a[i] != b[i]:
+            return i
+    return n
+
+
+class RadixIndex:
+    """The prefix tree. Page references it takes/drops go through the
+    shared :class:`PagePool`; hit/miss/evict accounting lives in the
+    manager (one layer up) so the tree stays a pure data structure."""
+
+    def __init__(self, pool: PagePool):
+        self.pool = pool
+        self.page = pool.page_size
+        self.root = RadixNode((), None, None)
+        self._tick = 0
+        # flat registry for O(nodes) LRU scans (node count is bounded by
+        # the pool size, so a scan is tiny)
+        self._nodes: List[RadixNode] = []
+
+    def __len__(self) -> int:
+        return len(self._nodes)
+
+    def indexed_pages(self) -> int:
+        return len(self._nodes)
+
+    def _touch(self, node: RadixNode):
+        self._tick += 1
+        while node is not None and node is not self.root:
+            node.last_used = self._tick
+            node = node.parent
+
+    # -- lookup --------------------------------------------------------------
+    def lookup(self, tokens, *, touch: bool = True) -> PrefixMatch:
+        """Longest cached prefix of ``tokens``: exact full-page chunks,
+        then the best >=1-token partial overlap among the frontier's
+        children (full-page children included — a divergent page still
+        shares its common slots)."""
+        toks = [int(t) for t in tokens]
+        page = self.page
+        node = self.root
+        full_pages: List[int] = []
+        i = 0
+        while i + page <= len(toks):
+            child = node.children.get(tuple(toks[i:i + page]))
+            if child is None:
+                break
+            node = child
+            full_pages.append(child.page)
+            i += page
+        rem = tuple(toks[i:])
+        best: Optional[RadixNode] = None
+        best_m = 0
+        if rem:
+            for child in node.children.values():
+                m = _common_prefix(child.chunk, rem)
+                if m > best_m or (m == best_m and best is not None
+                                  and m and child.last_used
+                                  > best.last_used):
+                    best, best_m = child, m
+        if touch:
+            self._touch(best if best_m else node)
+        if best_m:
+            return PrefixMatch(i + best_m, full_pages, best.page, best_m)
+        return PrefixMatch(i, full_pages)
+
+    # -- insert --------------------------------------------------------------
+    def insert(self, tokens, pages) -> List[int]:
+        """Index ``tokens`` backed by ``pages`` (page ``j`` holds tokens
+        ``[j*page, (j+1)*page)``; the last chunk may be partial). Chunks
+        already indexed keep their EXISTING node/page — same tokens at
+        the same positions hold identical KV, so the duplicate page is
+        simply not adopted (it frees at its owner's release). Returns
+        the page ids newly referenced (one pool incref each)."""
+        toks = [int(t) for t in tokens]
+        page = self.page
+        taken: List[int] = []
+        node = self.root
+        for j in range(0, len(toks), page):
+            chunk = tuple(toks[j:j + page])
+            pid = int(pages[j // page])
+            child = node.children.get(chunk)
+            if child is None:
+                if pid == 0 or self.pool.refcount(pid) == 0:
+                    break   # trash/freed page must never be indexed
+                child = RadixNode(chunk, pid, node)
+                node.children[chunk] = child
+                self._nodes.append(child)
+                self.pool.incref(pid)
+                taken.append(pid)
+            node = child
+        self._touch(node)
+        return taken
+
+    # -- eviction ------------------------------------------------------------
+    def evict_lru(self, n_pages: int) -> List[int]:
+        """Drop least-recently-used evictable leaves until ``n_pages``
+        page ids returned to the free list (or nothing evictable is
+        left). Leaf-first: interior nodes become candidates only once
+        their subtree is gone, so chains evict back-to-front."""
+        freed: List[int] = []
+        while len(freed) < n_pages:
+            victim: Optional[RadixNode] = None
+            for node in self._nodes:
+                if node.children:
+                    continue
+                if not self.pool.evictable(node.page):
+                    continue
+                if victim is None or node.last_used < victim.last_used:
+                    victim = node
+            if victim is None:
+                break
+            self._remove(victim)
+            self.pool.decref(victim.page)
+            freed.append(victim.page)
+        return freed
+
+    def _remove(self, node: RadixNode):
+        del node.parent.children[node.chunk]
+        self._nodes.remove(node)
+
+    # -- introspection -------------------------------------------------------
+    def stats(self) -> Dict[str, int]:
+        leaves = sum(1 for n in self._nodes if not n.children)
+        return {"nodes": len(self._nodes), "leaves": leaves,
+                "tails": sum(1 for n in self._nodes
+                             if len(n.chunk) < self.page)}

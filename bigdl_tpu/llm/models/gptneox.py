@@ -316,6 +316,12 @@ from bigdl_tpu.llm.kernels.sampling import make_sampled_step  # noqa: E402
 
 paged_decode_step_sampled = make_sampled_step(paged_decode_step)
 
+# prefix-cache partial prefill (ISSUE 5): suffix-only prefill over a
+# pre-populated block-table prefix — see llm/kvcache/prefill.py
+from bigdl_tpu.llm.kvcache.prefill import make_partial_prefill  # noqa: E402
+
+paged_prefill_partial = make_partial_prefill(forward, init_cache)
+
 
 class GptNeoXForCausalLM(CausalLMFacade):
     """Generation facade — shared driver (see models._facade)."""

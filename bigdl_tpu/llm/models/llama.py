@@ -883,6 +883,14 @@ def decode_scan_paged(params, k_pages, v_pages, bt, pos, last_logits, key,
     return toks.T, k_pages, v_pages, pos, last, key, finished
 
 
+# prefix-cache partial prefill (ISSUE 5): run only the uncached suffix
+# at a position offset over a pre-populated block-table prefix, with the
+# COW tail fork fused into the write-back — see llm/kvcache/prefill.py
+from bigdl_tpu.llm.kvcache.prefill import make_partial_prefill  # noqa: E402
+
+paged_prefill_partial = make_partial_prefill(forward, init_cache)
+
+
 # ---------------------------------------------------------------------------
 # generation facade
 # ---------------------------------------------------------------------------

@@ -46,6 +46,7 @@ import numpy as np
 from bigdl_tpu import observability as obs
 from bigdl_tpu import reliability
 from bigdl_tpu.llm.kernels.sampling import make_sampled_step
+from bigdl_tpu.llm.kvcache import KVCacheManager
 from bigdl_tpu.observability import request_context as rc
 
 
@@ -266,6 +267,10 @@ class Request:
         self.trace = rc.to_wire(rc.current())
         self.submitted_at = time.time() if self.trace else 0.0
         self.decode_started_at = 0.0
+        # always-on TTFT accounting (ISSUE 5 microbench): submit stamp
+        # here, first-token stamp at the engine's drain
+        self.t_submit = time.perf_counter()
+        self.t_first_token = 0.0
 
     def get(self, timeout: Optional[float] = None) -> List[int]:
         if not self.done.wait(timeout):
@@ -322,6 +327,21 @@ class LLMServer:
     synchronous engine exactly: every step drains (and every prefill
     barriers) before the next dispatch, and no buffer outlives its
     iteration. See docs/PERFORMANCE.md.
+
+    **Prefix-aware KV cache (ISSUE 5, ``bigdl.llm.kvcache.enabled`` /
+    ``kvcache=`` ctor arg; default off).** The page pool lives in the
+    :mod:`bigdl_tpu.llm.kvcache` subsystem: pages are refcounted, a
+    radix index keyed on page-size token chunks keeps finished (and
+    live) requests' prompt chains warm, and admission looks up the
+    longest cached prefix — the budget is charged only for the uncached
+    suffix, prefill runs only over the suffix at a position offset, and
+    a partially-matched tail page is copy-on-write forked into the
+    request's own first page by the same fused scatter. EOS releases
+    DECREMENT refcounts instead of freeing; index-only chains are
+    LRU-evicted under pool pressure. Disabled, the manager degenerates
+    to the old free-list (same allocation order, full-prompt budgets,
+    no index, no extra metric series) — bit-identical to the
+    pre-kvcache engine. See docs/KVCACHE.md.
     """
 
     def __init__(self, model, max_batch: int = 4, max_seq_len: int = 256,
@@ -330,7 +350,8 @@ class LLMServer:
                  max_queue: int = 0,
                  pipeline_depth: Optional[int] = None,
                  temperature: float = 0.0, top_k: int = 0,
-                 sample_seed: int = 0):
+                 sample_seed: int = 0,
+                 kvcache: Optional[bool] = None):
         import inspect
 
         from bigdl_tpu.llm.models.llama import forward, init_cache
@@ -345,9 +366,11 @@ class LLMServer:
         # yet, so it stays generate()-only)
         fam_forward = getattr(type(model), "_forward", None)
         if fam_forward is None:
+            from bigdl_tpu.llm.models import llama as _llama_mod
             self._fam_forward, self._fam_init_cache = forward, init_cache
             self._fam_paged_step = paged_decode_step
             self._fam_sampled_step = paged_decode_step_sampled
+            self._fam_partial_prefill = _llama_mod.paged_prefill_partial
             self._family = "llama"
         else:
             self._fam_forward = fam_forward
@@ -361,6 +384,8 @@ class LLMServer:
                     self._fam_paged_step is not None:
                 self._fam_sampled_step = make_sampled_step(
                     self._fam_paged_step)
+            self._fam_partial_prefill = getattr(
+                fam_mod, "paged_prefill_partial", None)
             self._family = fam_mod.__name__.rsplit(".", 1)[-1]
             if paged and self._fam_paged_step is None:
                 raise NotImplementedError(
@@ -411,9 +436,12 @@ class LLMServer:
         # already-in-flight steps, so only a later fence bounds them
         self._pending_release: List[Any] = []
         # always-on plain-python accounting (not metric series): the
-        # host-vs-stall split tools/microbench_decode.py reads
+        # host-vs-stall split tools/microbench_decode.py reads, plus the
+        # prefill-token tally tools/microbench_prefix.py diffs cache
+        # on/off (prefix reuse shows up as fewer prefilled tokens)
         self.host_seconds = 0.0
         self.stall_seconds = 0.0
+        self.prefill_tokens_total = 0
         # ISSUE 3 flight recorder: every jit entry point of the engine
         # is wrapped so compiles/recompiles (the per-length prefill
         # buckets, a batch-width drift on the decode step) are counted,
@@ -443,8 +471,19 @@ class LLMServer:
                      cfg.num_key_value_heads, page_size, cfg.head_dim)
             self._k_pages = jnp.zeros(shape, model.cache_dtype)
             self._v_pages = jnp.zeros(shape, model.cache_dtype)
-            self._free = list(range(self._num_pages - 1, 0, -1))
-            self._budget_avail = self._num_pages - 1
+            # the page pool now lives in the kvcache subsystem (ISSUE 5
+            # tentpole): refcounted pages + admission budget; with the
+            # prefix cache on, a radix index keeps finished requests'
+            # chains warm for reuse. Disabled (the default) allocates
+            # bit-identically to the embedded free-list it replaces.
+            kv_on = (kvcache if kvcache is not None else
+                     conf.get_bool("bigdl.llm.kvcache.enabled", False))
+            if kv_on and self._fam_partial_prefill is None:
+                raise NotImplementedError(
+                    f"{type(model).__name__} has no partial-prefill "
+                    "entry point; the prefix cache needs one per family")
+            self._kv = KVCacheManager(self._num_pages, page_size,
+                                      enabled=bool(kv_on))
             self._bt = np.zeros((max_batch, self._pages_cap), np.int32)
             self._lens = np.zeros(max_batch, np.int32)
             # device-resident twins (ISSUE 4): the step reads/advances
@@ -456,8 +495,11 @@ class LLMServer:
             self._lens_dev = jnp.asarray(self._lens)
             self._slot_pages: List[List[int]] = [[] for _ in
                                                  range(max_batch)]
-            self._slot_budget = np.zeros(max_batch, np.int64)
+            # per-slot cache grant (suffix budget charge + adopted
+            # shared pages) — release decrements refcounts at EOS
+            self._slot_adm: List[Optional[Any]] = [None] * max_batch
         else:
+            self._kv = None       # the slot-static cache has no pages
             self._cache = init_cache(self.cfg, max_batch, self.max_seq_len,
                                      dtype=model.cache_dtype)
             # per-slot write positions (the shared scalar cache["pos"] is
@@ -472,6 +514,22 @@ class LLMServer:
         proportional-HBM claim, testable)."""
         return sum(len(p) for p in self._slot_pages) if self.paged else -1
 
+    # the pool moved into the kvcache subsystem (ISSUE 5); these views
+    # keep the embedded-pool names the tests and tools read
+    @property
+    def _free(self) -> List[int]:
+        return self._kv.pool.free_ids()
+
+    @property
+    def _budget_avail(self) -> int:
+        return self._kv.budget_avail
+
+    @property
+    def prefix_tokens_saved(self) -> int:
+        """Prompt tokens served from the prefix cache instead of being
+        prefilled (always-on; 0 with the cache disabled)."""
+        return self._kv.prefix_tokens_reused if self._kv else 0
+
     # -- client API ----------------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens: int = 32) -> Request:
         reliability.inject("llm.submit")
@@ -483,13 +541,19 @@ class LLMServer:
         req = Request(prompt_ids, max_new_tokens)
         if len(req.prompt_ids) + max_new_tokens > self.max_seq_len:
             raise ValueError("prompt + max_new_tokens exceeds max_seq_len")
+        pages = None
         if self.paged:
-            budget = -(-(len(req.prompt_ids) + req.max_new_tokens)
-                       // self._page)
-            if budget > self._num_pages - 1:
+            # post-lookup suffix cost (ISSUE 5 satellite): a request
+            # whose prefix is cached is charged only for the uncached
+            # suffix, so feasibility and the shed diagnostics below
+            # must be judged on that cost, not the full prompt
+            pages = self._kv.peek(req.prompt_ids, req.max_new_tokens)
+            if pages["pages_needed"] > self._num_pages - 1:
                 raise ValueError(
-                    f"request needs {budget} pages but the pool holds "
-                    f"{self._num_pages - 1}; it could never be admitted")
+                    f"request needs {pages['pages_needed']} pages "
+                    f"(uncached suffix of prompt + max_new_tokens) but "
+                    f"the pool holds {self._num_pages - 1}; it could "
+                    "never be admitted")
         if self._draining.is_set():
             reliability.count_shed("llm_server")
             raise reliability.OverloadError(
@@ -497,10 +561,25 @@ class LLMServer:
         try:
             self._queue.put_nowait(req)
         except queue.Full:
-            reliability.count_shed("llm_server")
-            raise reliability.OverloadError(
-                f"request queue full ({self.max_queue} waiting); "
-                "retry later") from None
+            # the 503 carries the page accounting (post-lookup suffix
+            # cost vs budget actually free) so clients and the shed
+            # counter can tell queue pressure from page pressure
+            if pages is not None and \
+                    pages["pages_needed"] > pages["pages_free"]:
+                reliability.count_shed("llm_server_pages")
+            else:
+                reliability.count_shed("llm_server")
+            msg = (f"request queue full ({self.max_queue} waiting); "
+                   "retry later")
+            if pages is not None:
+                msg += (f" [needs {pages['pages_needed']} pages for the "
+                        f"uncached suffix, {pages['pages_free']} "
+                        "budget-free]")
+            err = reliability.OverloadError(msg)
+            if pages is not None:
+                err.pages_needed = pages["pages_needed"]
+                err.pages_free = pages["pages_free"]
+            raise err from None
         return req
 
     def start(self) -> "LLMServer":
@@ -542,6 +621,8 @@ class LLMServer:
                 np.asarray(rec["out"])
             except Exception:   # a dead device can't hold references
                 pass
+            for args in rec.pop("kv_release", ()):
+                self._kv.release_slot(*args)
         if self._pending_release:
             # bookkeeping scatters enqueued AFTER the newest step have
             # no later fence — bound them via their own outputs (the
@@ -585,14 +666,44 @@ class LLMServer:
                 except queue.Empty:
                     return
             self._pending_head = None
+            adm = None
             if self.paged:
-                budget = -(-(len(req.prompt_ids) + req.max_new_tokens)
-                           // self._page)
-                if budget > self._budget_avail:
+                t_lk = time.perf_counter()
+                try:
+                    # lookup + suffix-only budget charge + adoption refs
+                    # + pre-eviction for the prompt's own pages, in one
+                    # atomic manager call (ISSUE 5)
+                    adm = self._kv.admit(req.prompt_ids,
+                                         req.max_new_tokens)
+                except BaseException:
+                    # injected kvcache.evict fault: nothing was charged
+                    # or adopted — hold the head, let the loop retry
+                    self._pending_head = req
+                    raise
+                if adm is None:
+                    peek = self._kv.peek(req.prompt_ids,
+                                         req.max_new_tokens)
+                    if peek["pages_needed"] > self._num_pages - 1:
+                        # the cached prefix that made this request
+                        # feasible at submit time has been evicted: it
+                        # can never be admitted now — fail it instead
+                        # of wedging the whole admission line
+                        req.error = (
+                            f"request needs {peek['pages_needed']} "
+                            f"pages but the pool holds "
+                            f"{self._num_pages - 1} (cached prefix "
+                            "evicted since submit)")
+                        req.done.set()
+                        continue
                     self._pending_head = req   # retry next loop pass
                     return
-                self._budget_avail -= budget
-                self._slot_budget[i] = budget
+                self._slot_adm[i] = adm
+                if self._kv.enabled:
+                    wall = time.perf_counter() - t_lk
+                    obs.add_complete(
+                        "kvcache/lookup", time.time() - wall, wall,
+                        request=req.id, matched_tokens=adm.matched_len,
+                        prompt_tokens=len(req.prompt_ids))
             ctx = rc.from_wire(req.trace)
             if ctx is not None and req.submitted_at:
                 # engine-side admission wait, parented to the submitter
@@ -612,17 +723,19 @@ class LLMServer:
                      else self._prefill_slot)(i, req)
             except BaseException as e:
                 # a failing prefill must not leak its admission budget
-                # (the resilient _loop would otherwise shrink the pool
-                # forever) nor leave the client blocked until timeout
-                if self.paged:
-                    self._budget_avail += int(self._slot_budget[i])
-                    self._slot_budget[i] = 0
+                # or adoption refcounts (the resilient _loop would
+                # otherwise shrink the pool forever) nor leave the
+                # client blocked until timeout
+                if self.paged and adm is not None:
+                    self._kv.cancel(adm)
+                    self._slot_adm[i] = None
                 req.error = f"{type(e).__name__}: {e}"
                 req.done.set()
                 raise
             req.decode_started_at = time.time()
-            self._record_prefill(len(req.prompt_ids),
-                                 time.perf_counter() - t0)
+            suffix = len(req.prompt_ids) - (adm.matched_len if adm
+                                            else 0)
+            self._record_prefill(suffix, time.perf_counter() - t0)
 
     def _instruments(self):
         """None when observability is off; declared on first use so
@@ -640,8 +753,10 @@ class LLMServer:
             # page 0 is the reserved trash page, never allocatable
             ins["kv_occupancy"].set(
                 self.pages_in_use / max(self._num_pages - 1, 1))
+            self._kv.record_gauges()   # bigdl_kvcache_* (enabled only)
 
     def _record_prefill(self, n_tokens: int, seconds: float):
+        self.prefill_tokens_total += n_tokens   # always-on (microbench)
         ins = self._instruments()
         if ins is not None:
             ins["prefill_tokens"].inc(n_tokens)
@@ -749,10 +864,15 @@ class LLMServer:
                             donate_argnums=(1, 2))
 
     def _prefill_paged(self, i: int, req: Request):
+        # the slot's admission grant was stored by _admit; a cached
+        # prefix routes to the suffix-only partial prefill
+        adm = self._slot_adm[i]
+        if adm is not None and adm.matched_len:
+            return self._prefill_paged_partial(i, req, adm)
         t = len(req.prompt_ids)
         page = self._page
         npages = -(-t // page)
-        ids = [self._free.pop() for _ in range(npages)]
+        ids = self._kv.alloc(npages)
         try:
             bucket = max(page, 1 << (t - 1).bit_length())  # pow2, >= page
             key = self._step_cache_key() + ("prefill", bucket)
@@ -771,7 +891,7 @@ class LLMServer:
                 self.model.params, self._k_pages, self._v_pages,
                 toks_d, t_d, pids_d)
         except BaseException:
-            self._free.extend(ids)   # physical pages must not leak
+            self._kv.free_owned(ids)   # physical pages must not leak
             raise
         # same async-dispatch buffer-lifetime contract as _prefill_slot:
         # pin everything the prefill + scatter dispatches consume, then
@@ -795,6 +915,117 @@ class LLMServer:
         self._slot_pages[i] = ids
         self._slots[i] = req
         self._remaining[i] = req.max_new_tokens
+        self._index_prompt(i, req)
+
+    def _build_partial_prefill(self, n_pp: int, bucket: int):
+        """Compile the family's partial prefill for one (prefix-pages,
+        suffix-length) bucket pair — see llm/kvcache/prefill.py for the
+        gather → offset-forward → fused-COW-scatter structure."""
+        cfg, page = self.cfg, self._page
+        fam = self._fam_partial_prefill
+        cache_dtype = self.model.cache_dtype
+
+        def build(params, k_pages, v_pages, toks, length, offset,
+                  prefix_ids, phys, slots):
+            return fam(params, cfg, k_pages, v_pages, toks, length,
+                       offset, prefix_ids, phys, slots, page=page,
+                       n_pp=n_pp, bucket=bucket, cache_dtype=cache_dtype)
+
+        return obs.compiled(build, name="llm/prefill_partial",
+                            donate_argnums=(1, 2))
+
+    def _prefill_paged_partial(self, i: int, req: Request, adm):
+        """Prefill only the uncached suffix (ISSUE 5): the block-table
+        prefix is pre-populated with adopted shared pages, the suffix
+        runs at position offset ``matched_len``, and a partially-matched
+        tail page is copy-on-write forked into the request's own first
+        suffix page by the same scatter."""
+        page = self._page
+        T = len(req.prompt_ids)
+        off = adm.matched_len
+        koff = off // page
+        own = self._kv.alloc(-(-T // page) - koff)
+        try:
+            row_pages = list(adm.shared_pages) + own
+            gsrc = list(adm.shared_pages)
+            if adm.tail_src is not None:
+                gsrc.append(adm.tail_src)
+            n_pp = 1 << (len(gsrc) - 1).bit_length()     # pow2 bucket
+            t_suf = T - off
+            bucket = max(page, 1 << (t_suf - 1).bit_length())
+            key = self._step_cache_key() + ("prefill_partial", n_pp,
+                                            bucket)
+            fn = _PAGED_STEP_CACHE.get(key)
+            if fn is None:
+                fn = _PAGED_STEP_CACHE[key] = \
+                    self._build_partial_prefill(n_pp, bucket)
+            toks = np.zeros((1, bucket), np.int32)
+            toks[0, :t_suf] = req.prompt_ids[off:]
+            pids = np.zeros(n_pp, np.int32)
+            pids[:len(gsrc)] = gsrc
+            # scatter targets for the page-aligned window at koff*page:
+            # leading sub-page slots re-write the adopted tail into the
+            # fork page the request owns; suffix tokens land in their
+            # own pages; padding routes to trash page 0
+            W = page + bucket
+            p0 = koff * page
+            phys = np.zeros(W, np.int32)
+            slots = np.zeros(W, np.int32)
+            for j in range(W):
+                p = p0 + j
+                if p < T:
+                    phys[j] = row_pages[p // page]
+                slots[j] = p % page
+            toks_d = jnp.asarray(toks)
+            len_d = jnp.asarray(t_suf, jnp.int32)
+            off_d = jnp.asarray(off, jnp.int32)
+            pids_d = jnp.asarray(pids)
+            phys_d = jnp.asarray(phys)
+            slots_d = jnp.asarray(slots)
+            self._k_pages, self._v_pages, last = fn(
+                self.model.params, self._k_pages, self._v_pages,
+                toks_d, len_d, off_d, pids_d, phys_d, slots_d)
+        except BaseException:
+            self._kv.free_owned(own)
+            raise
+        self._pin(toks_d, len_d, off_d, pids_d, phys_d, slots_d, last,
+                  self._last, self._bt_dev, self._lens_dev)
+        self._last = self._last.at[i].set(last)
+        npages = len(row_pages)
+        self._bt[i, :] = 0
+        self._bt[i, :npages] = row_pages
+        self._lens[i] = T
+        row = np.zeros(self._pages_cap, np.int32)
+        row[:npages] = row_pages
+        row_d = jnp.asarray(row)
+        self._pin(row_d)
+        self._bt_dev = self._bt_dev.at[i].set(row_d)
+        self._lens_dev = self._lens_dev.at[i].set(T)
+        if self.pipeline_depth == 1:
+            _sync_barrier(self._k_pages, self._v_pages, self._last,
+                          self._bt_dev, self._lens_dev)
+            self._pending_release.clear()
+        # the dispatch consumed the tail source in order; its transient
+        # ref/pin can drop now (the donated-pool dependency chain orders
+        # any later overwrite after the gather)
+        self._kv.release_transient(adm)
+        self._slot_pages[i] = own
+        self._slots[i] = req
+        self._remaining[i] = req.max_new_tokens
+        self._index_prompt(i, req)
+
+    def _index_prompt(self, i: int, req: Request):
+        """Make this request's FULL prompt pages reusable immediately
+        (not at EOS): concurrent requests sharing the prompt adopt them
+        while this one is still decoding. The partially-filled prompt
+        tail stays private — it is indexed at EOS, and adopters fork it
+        (COW) rather than racing this request's decode writes."""
+        if self._kv is None or not self._kv.enabled:
+            return
+        nfull = len(req.prompt_ids) // self._page
+        if nfull:
+            self._kv.insert(req.prompt_ids[:nfull * self._page],
+                            self._bt[i, :nfull])
 
     def _build_paged_decode(self):
         """One pipelined decode step over the page pool — the family's
@@ -901,14 +1132,19 @@ class LLMServer:
         self.stall_seconds += stall
         # the fence proves every computation enqueued before this step —
         # including the updates rec["pinned"] was holding buffers for —
-        # has retired; the references may drop now
+        # has retired; the references may drop now, and so may the page
+        # refcounts held for finished requests' in-flight block tables
         rec["pinned"] = rec["refs"] = None
+        for args in rec.pop("kv_release", ()):
+            self._kv.release_slot(*args)
         finished = applied = 0
         for i, req in rec["pairs"]:
             if self._slots[i] is not req:
                 continue   # speculative token for a finished request
             tok = int(vals[i])
             req.tokens.append(tok)
+            if len(req.tokens) == 1:
+                req.t_first_token = time.perf_counter()  # TTFT stamp
             applied += 1
             if (self.eos_token_id is not None
                     and tok == self.eos_token_id) \
@@ -936,10 +1172,30 @@ class LLMServer:
         self._slots[i] = None
         self._remaining[i] = 0
         if self.paged:
-            self._free.extend(self._slot_pages[i])
+            adm = self._slot_adm[i]
+            owned = self._slot_pages[i]
+            adopted = adm.shared_pages if adm is not None else []
+            charge = adm.charge if adm is not None else 0
+            if self._kv.enabled:
+                # keep the chain warm (ISSUE 5): index the full pages of
+                # prompt+output plus the partial tail, THEN drop this
+                # request's refs — indexed pages survive at refcount 1
+                # (evictable), unindexed ones free immediately
+                toks = list(map(int, req.prompt_ids)) + \
+                    list(map(int, req.tokens))
+                self._kv.insert(toks,
+                                self._bt[i, :-(-len(toks) // self._page)])
             self._slot_pages[i] = []
-            self._budget_avail += int(self._slot_budget[i])
-            self._slot_budget[i] = 0
+            self._slot_adm[i] = None
+            if self._kv.enabled and self._inflight:
+                # pinned pages hold refcounts (the PR 4 buffer-pinning
+                # invariant extended): in-flight speculative steps still
+                # read these pages through their device block tables, so
+                # the decrefs run at the newest in-flight step's fence
+                self._inflight[-1].setdefault("kv_release", []).append(
+                    (charge, owned, adopted))
+            else:
+                self._kv.release_slot(charge, owned, adopted)
             self._bt[i, :] = 0    # orphaned rows must point at trash:
             self._lens[i] = 0     # a stale id could alias a reissued
             # page and the inactive row's dummy write would clobber it
@@ -965,12 +1221,18 @@ class LLMServer:
         page = self._page
         # the page for position lens[i] must exist before the step; the
         # grant is an incremental scatter into the device-resident block
-        # table, not a re-upload (ISSUE 4)
+        # table, not a re-upload (ISSUE 4). Under the prefix cache the
+        # free list may be held by warm chains — pre-evict for ALL the
+        # grants this step needs BEFORE mutating any table, so an
+        # injected kvcache.evict raise is cleanly retryable
+        boundary = sum(1 for i in disp if int(self._lens[i]) % page == 0)
+        if boundary:
+            self._kv.ensure_free(boundary)
         allocs = []
         for i in disp:
             pos = int(self._lens[i])
             if pos % page == 0:
-                pid = self._free.pop()   # guaranteed by budget reserve
+                pid = self._kv.take_free()  # guaranteed by the reserve
                 self._bt[i, pos // page] = pid
                 self._slot_pages[i].append(pid)
                 allocs.append((i, pos // page, pid))

@@ -98,7 +98,16 @@ class LLMWorker:
                 try:
                     return worker.server.submit(ids, max_new_tokens=mnt)
                 except reliability.OverloadError as e:
-                    self._json(503, {"error": str(e)},
+                    # page accounting rides the Retry-After diagnostics
+                    # (ISSUE 5 satellite): pages_needed is the POST-
+                    # LOOKUP suffix cost, so clients see how far the
+                    # prefix cache already got them
+                    body = {"error": str(e)}
+                    for key in ("pages_needed", "pages_free"):
+                        val = getattr(e, key, None)
+                        if val is not None:
+                            body[key] = int(val)
+                    self._json(503, body,
                                headers=(("Retry-After", "1"),))
                     return None
                 except ValueError as e:
@@ -121,6 +130,16 @@ class LLMWorker:
                 debug = tracing.debug_endpoint(self.path)
                 if debug is not None:
                     self._json(*debug)
+                elif self.path == "/debug/kvcache":
+                    # prefix-cache state (ISSUE 5): pool refcounts,
+                    # radix index size, hit/miss/evict tallies. 404
+                    # when the cache is disabled — the surface is
+                    # structurally absent, not empty
+                    kv = getattr(worker.server, "_kv", None)
+                    if kv is None or not kv.enabled:
+                        self._json(404, {"error": "kvcache disabled"})
+                    else:
+                        self._json(200, kv.debug_stats())
                 elif self.path == "/worker_get_status":
                     dt = max(time.time() - worker._t0, 1e-9)
                     self._json(200, {

@@ -47,6 +47,7 @@ SITES = (
     "serving.frontend.request",    # HTTP /predict admission
     "llm.submit",                  # LLMServer request admission
     "llm.step",                    # LLM engine decode step
+    "kvcache.evict",               # prefix-cache LRU eviction (ISSUE 5)
 )
 
 

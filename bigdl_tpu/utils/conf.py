@@ -50,6 +50,9 @@ _DEFAULTS: Dict[str, str] = {
     # async engine (ISSUE 4): decode steps dispatched ahead of the host
     # drain. 1 = fully synchronous (the pre-pipeline engine, exactly)
     "bigdl.llm.pipeline_depth": "2",
+    # prefix-aware KV cache (ISSUE 5): radix-indexed page reuse with
+    # refcounts + COW. false = the pre-kvcache engine exactly
+    "bigdl.llm.kvcache.enabled": "false",
     "bigdl.train.prefetch": "true",           # stage batch N+1 during N
     "bigdl.train.prefetch.depth": "2",        # staged batches held ahead
 }

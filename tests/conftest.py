@@ -38,6 +38,10 @@ def pytest_configure(config):
         "perf: performance microbenchmarks (latency/throughput "
         "assertions are advisory on shared CI hosts; select with "
         "-m perf)")
+    config.addinivalue_line(
+        "markers",
+        "kvcache: prefix-aware KV-cache subsystem tests (pool/radix "
+        "units + engine parity; select with -m kvcache)")
 
 
 @pytest.fixture(scope="session")

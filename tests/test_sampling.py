@@ -1,0 +1,121 @@
+"""On-device sampling kernel edge cases (ISSUE 5 satellite).
+
+llm/kernels/sampling.py was folded into every compiled decode step in
+PR 4 and partial prefill (ISSUE 5) changes its call sites again — these
+tests lock the kernel's boundary behaviors so those refactors cannot
+silently shift sampling semantics:
+
+- ``top_k >= vocab`` must be a no-op filter (identical draws to
+  unfiltered sampling under the same key);
+- ``top_k == 1`` must equal greedy argmax for ANY key (one unmasked
+  logit survives);
+- ``temperature ~ 0`` must stay numerically stable (the 1e-6 floor) and
+  behave like argmax, never NaN.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu.llm.kernels.sampling import (fence_token, make_sampled_step,
+                                            sample_tokens)
+
+VOCAB = 32
+
+
+@pytest.fixture()
+def logits(rng):
+    return jnp.asarray(rng.randn(4, VOCAB).astype(np.float32))
+
+
+class TestSampleTokens:
+    def test_greedy_is_argmax(self, logits):
+        toks = sample_tokens(logits, jax.random.PRNGKey(0))
+        np.testing.assert_array_equal(
+            np.asarray(toks), np.asarray(jnp.argmax(logits, -1)))
+        assert toks.dtype == jnp.int32
+
+    def test_top_k_geq_vocab_matches_unfiltered(self, logits):
+        """The kth threshold is the global min when k >= vocab: masking
+        removes nothing and the categorical draw must be bit-identical
+        to top_k=0 under the same key."""
+        key = jax.random.PRNGKey(7)
+        for k in (VOCAB, VOCAB + 1, 10 * VOCAB):
+            a = sample_tokens(logits, key, do_sample=True,
+                              temperature=jnp.float32(0.8), top_k=k)
+            b = sample_tokens(logits, key, do_sample=True,
+                              temperature=jnp.float32(0.8), top_k=0)
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_top_k_1_equals_greedy_for_any_key(self, logits):
+        """With one surviving logit the categorical is deterministic:
+        every key must reproduce the greedy argmax."""
+        want = np.asarray(jnp.argmax(logits, -1))
+        for seed in range(5):
+            got = sample_tokens(logits, jax.random.PRNGKey(seed),
+                                do_sample=True,
+                                temperature=jnp.float32(1.3), top_k=1)
+            np.testing.assert_array_equal(np.asarray(got), want)
+
+    @pytest.mark.parametrize("temp", [1e-9, 1e-6, 1e-4])
+    def test_near_zero_temperature_is_stable_argmax(self, logits, temp):
+        """temperature -> 0 sharpens to a point mass; the 1e-6 floor
+        keeps the division finite, so the draw is the argmax — never a
+        NaN-poisoned arbitrary index."""
+        got = sample_tokens(logits, jax.random.PRNGKey(3),
+                            do_sample=True,
+                            temperature=jnp.float32(temp), top_k=0)
+        arr = np.asarray(got)
+        assert not np.any(np.isnan(arr.astype(np.float64)))
+        np.testing.assert_array_equal(
+            arr, np.asarray(jnp.argmax(logits, -1)))
+
+    def test_temperature_is_runtime_not_trace_constant(self, logits):
+        """Serving tunes temperature without a recompile: the jitted
+        kernel must accept it as a traced scalar."""
+        fn = jax.jit(lambda lg, key, t: sample_tokens(
+            lg, key, do_sample=True, temperature=t, top_k=2))
+        key = jax.random.PRNGKey(0)
+        a = fn(logits, key, jnp.float32(0.7))
+        b = fn(logits, key, jnp.float32(1.9))   # same compile, new temp
+        assert a.shape == b.shape == (4,)
+
+
+class TestFenceToken:
+    def test_fence_depends_on_all_inputs_and_is_finite(self):
+        out = fence_token(jnp.full((3,), jnp.inf),
+                          jnp.array([np.nan, 1.0]),
+                          jnp.array([2], jnp.int32))
+        arr = np.asarray(out)
+        assert arr.shape == (1,) and arr.dtype == np.int32
+
+    def test_sampled_step_emits_fence_element(self):
+        """The lifted step returns (B+1,) ids — B samples + the fence —
+        and masks inactive rows to the trash page."""
+        seen = {}
+
+        def fam_step(params, cfg, kp, vp, bt, lens, toks, *, page):
+            seen["bt"] = bt
+            seen["lens"] = lens
+            b = toks.shape[0]
+            logits = jnp.zeros((b, VOCAB), jnp.float32)
+            return logits, kp, vp
+
+        step = make_sampled_step(fam_step)
+        b = 2
+        kp = vp = jnp.zeros((1, 2, 1, 4, 2), jnp.float32)
+        bt = jnp.ones((b, 2), jnp.int32)
+        lens = jnp.array([3, 5], jnp.int32)
+        last = jnp.asarray(np.eye(b, VOCAB, dtype=np.float32))
+        active = jnp.array([True, False])
+        out, logits, kp, vp, new_lens, key = step(
+            {}, None, kp, vp, bt, lens, last, active, jnp.float32(1.0),
+            jax.random.PRNGKey(0), page=4)
+        assert out.shape == (b + 1,)
+        np.testing.assert_array_equal(np.asarray(out[:b]), [0, 1])
+        # inactive rows: trash block table + zero length + no advance
+        np.testing.assert_array_equal(np.asarray(seen["bt"]),
+                                      [[1, 1], [0, 0]])
+        np.testing.assert_array_equal(np.asarray(seen["lens"]), [3, 0])
+        np.testing.assert_array_equal(np.asarray(new_lens), [4, 5])

@@ -107,32 +107,51 @@ def _canon(metric: str, extra: Optional[dict]) -> str:
     return _ALIASES.get(metric, metric)
 
 
-def _step_ms_of(extra: Optional[dict]) -> Optional[float]:
-    sms = (extra or {}).get("step_ms")
-    return float(sms) if isinstance(sms, (int, float)) \
-        and not isinstance(sms, bool) else None
+# latency fields lifted out of each record's ``extra`` into their own
+# ``<name>.<field>`` metrics: throughput can hold steady while per-step
+# latency (ISSUE 4) or time-to-first-token (ISSUE 5's prefix cache)
+# regresses, so the diff tracks them explicitly. (The prefix bench's
+# TTFT pair rides the telemetry block, lifted separately below; this
+# generic lift covers records that carry the field directly.)
+_EXTRA_FIELDS = ("step_ms", "ttft_ms")
+
+
+def _extra_field(extra: Optional[dict], field: str) -> Optional[float]:
+    val = (extra or {}).get(field)
+    return float(val) if isinstance(val, (int, float)) \
+        and not isinstance(val, bool) else None
 
 
 def _flatten_full(rec: dict) -> Dict[str, float]:
     """Top-level + embedded sub-record values, PLUS each record's
-    ``extra.step_ms`` under ``<name>.step_ms`` — a throughput number can
-    hold steady while per-step latency regresses (e.g. batch padding
-    drift), so the diff tracks both (ISSUE 4 satellite)."""
+    ``extra.step_ms``/``extra.ttft_ms`` under ``<name>.<field>``."""
     flat: Dict[str, float] = {}
     if isinstance(rec.get("value"), (int, float)):
         name = _canon(rec.get("metric", "value"), rec.get("extra"))
         flat[name] = float(rec["value"])
-        sms = _step_ms_of(rec.get("extra"))
-        if sms is not None:
-            flat[name + ".step_ms"] = sms
+        for field in _EXTRA_FIELDS:
+            val = _extra_field(rec.get("extra"), field)
+            if val is not None:
+                flat[f"{name}.{field}"] = val
     for key, sub in (rec.get("extra") or {}).items():
         if isinstance(sub, dict) and \
                 isinstance(sub.get("value"), (int, float)):
             name = _canon(sub.get("metric", key), sub.get("extra"))
             flat[name] = float(sub["value"])
-            sms = _step_ms_of(sub.get("extra"))
-            if sms is not None:
-                flat[name + ".step_ms"] = sms
+            for field in _EXTRA_FIELDS:
+                val = _extra_field(sub.get("extra"), field)
+                if val is not None:
+                    flat[f"{name}.{field}"] = val
+    # ISSUE 5: the prefix microbench's TTFT pair lives in the full
+    # record's telemetry block, not in a metric sub-record — lift it so
+    # rounds diff TTFT even when the compact northstar line (which
+    # carries the same pair as prefix_cache.ttft_*) was truncated away
+    mb = (((rec.get("extra") or {}).get("telemetry") or {})
+          .get("microbench_prefix") or {})
+    for mode in ("cache_off", "cache_on"):
+        val = _extra_field(mb.get(mode), "ttft_ms")
+        if val is not None:
+            flat[f"prefix_{mode}.ttft_ms"] = val
     return flat
 
 

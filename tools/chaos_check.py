@@ -110,12 +110,87 @@ def run_chaos(seed: int = 0, events: int = 5, smoke: bool = True,
     return out
 
 
+def run_kvcache_chaos(seed: int = 0, n_requests: int = 6,
+                      raises: int = 2) -> dict:
+    """ISSUE 5 satellite: serve a shared-prefix workload through the
+    prefix cache with seeded ``kvcache.evict`` faults armed (delays on
+    every eviction to widen race windows, plus a few raises — the site
+    fires before any state mutates, so the engine loop retries cleanly)
+    and assert greedy outputs are token-identical to the clean cache-on
+    run. The pool is sized small so eviction genuinely happens."""
+    import numpy as np
+
+    from bigdl_tpu import reliability as rel
+    from bigdl_tpu.llm.models.llama import LlamaConfig, LlamaForCausalLM
+    from bigdl_tpu.llm.serving import LLMServer
+
+    model = LlamaForCausalLM.from_config(LlamaConfig.tiny(), seed=0,
+                                         max_cache_len=128)
+    rs = np.random.RandomState(seed)
+    shared = rs.randint(0, 250, 12).astype(np.int32)
+    prompts = [np.concatenate([shared,
+                               rs.randint(0, 250, 2 + j % 5)
+                               .astype(np.int32)])
+               for j in range(n_requests)]
+
+    def serve_all():
+        srv = LLMServer(model, max_batch=2, max_seq_len=64, page_size=8,
+                        num_pages=7, kvcache=True).start()
+        try:
+            reqs = [srv.submit(p, max_new_tokens=4) for p in prompts]
+            return ([list(map(int, r.get(timeout=300))) for r in reqs],
+                    srv._kv.evictions)
+        finally:
+            srv.stop()
+
+    was_enabled = rel.enabled()
+    if not was_enabled:
+        rel.enable()
+    try:
+        clean, clean_evicts = serve_all()
+        plan = rel.FaultPlan(seed=seed)
+        # rules match first-wins: the bounded raises go first (skipping
+        # the first call), the unbounded delays mop up every other pass
+        plan.add("kvcache.evict", "raise", times=raises, after=1)
+        plan.add("kvcache.evict", "delay", times=None, delay=0.002)
+        rel.set_plan(plan)
+        try:
+            injected, injected_evicts = serve_all()
+        finally:
+            rel.set_plan(None)
+    finally:
+        if not was_enabled:
+            rel.disable()
+
+    match = injected == clean
+    out = {
+        "seed": seed,
+        "requests": n_requests,
+        "clean_evictions": clean_evicts,
+        "injected_evictions": injected_evicts,
+        "events_fired": [f"{s}:{a}" for s, a in plan.fired],
+        "match": match,
+    }
+    if not out["events_fired"]:
+        raise AssertionError(
+            "kvcache chaos armed but no kvcache.evict fault fired — "
+            "the pool was not under pressure; shrink it")
+    if not match:
+        raise AssertionError(
+            f"kvcache chaos divergence under eviction faults "
+            f"(fired: {out['events_fired']}): {clean} vs {injected}")
+    return out
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--events", type=int, default=5)
     ap.add_argument("--full", action="store_true",
                     help="bigger model/data than the smoke default")
+    ap.add_argument("--kvcache", action="store_true",
+                    help="run the kvcache.evict eviction-race pass "
+                         "instead of the training chaos run (ISSUE 5)")
     ap.add_argument("--cpu", action="store_true",
                     help="force the CPU backend (sitecustomize pins the "
                          "axon TPU platform; env vars are ineffective)")
@@ -123,8 +198,11 @@ def main():
     if args.cpu:
         import jax
         jax.config.update("jax_platforms", "cpu")
-    out = run_chaos(seed=args.seed, events=args.events,
-                    smoke=not args.full)
+    if args.kvcache:
+        out = run_kvcache_chaos(seed=args.seed)
+    else:
+        out = run_chaos(seed=args.seed, events=args.events,
+                        smoke=not args.full)
     print(json.dumps(out, indent=1))
 
 

@@ -1,0 +1,147 @@
+#!/usr/bin/env python
+"""Prefix-reuse microbench (ISSUE 5 satellite): TTFT and prefill-tokens
+saved on a shared-system-prompt workload, cache on vs off.
+
+Replays the canonical serving pattern prefix caching targets — every
+request is ``shared system prompt + small distinct user tail`` — against
+the LIVE engine (admission, radix lookup, partial prefill, decode,
+release; everything a deployment runs), once with
+``bigdl.llm.kvcache.enabled`` off and once on. What it reports:
+
+- ``ttft_ms`` per mode: mean/p50 submit→first-token wall (the always-on
+  ``Request.t_submit``/``t_first_token`` stamps) — prefix reuse shows up
+  here because the suffix-only prefill is a fraction of the full one;
+- ``prefill_tokens`` per mode and ``prefill_tokens_saved`` (the
+  engine's always-on tally): the compute the cache deleted;
+- ``hits``/``evictions`` so a mis-sized pool is visible in the record.
+
+Wired into ``bench.py``'s telemetry block (``telemetry.
+microbench_prefix``) and the compact northstar line (``prefix_cache``);
+``tools/bench_regress.py`` diffs the ``ttft_ms`` fields across rounds.
+Standalone:
+
+    python tools/microbench_prefix.py                 # tiny model
+    python tools/microbench_prefix.py --requests 16 --shared-len 96 \
+        --tail-len 8 --json
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import Dict, Optional
+
+# runnable both as `python tools/microbench_prefix.py` and as an import
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def run_prefix_bench(n_requests: int = 8, shared_len: int = 48,
+                     tail_len: int = 6, new_tokens: int = 4,
+                     page_size: int = 16, pipeline_depth: int = 2,
+                     model=None) -> Dict:
+    """Serve ``n_requests`` shared-prefix prompts sequentially (the
+    reuse-friendly arrival order: request N's prefill runs after the
+    shared pages exist) in both modes; report TTFT and tokens saved.
+    One untimed warmup request per mode absorbs the prefill/decode
+    compiles — partial-prefill buckets only exist in the cache-on mode,
+    so each mode warms its own path."""
+    import numpy as np
+
+    from bigdl_tpu.llm.models.llama import LlamaConfig, LlamaForCausalLM
+    from bigdl_tpu.llm.serving import LLMServer
+
+    if model is None:
+        model = LlamaForCausalLM.from_config(LlamaConfig.tiny(), seed=0,
+                                             max_cache_len=256)
+    rs = np.random.RandomState(0)
+    vocab = model.config.vocab_size
+    shared = rs.randint(0, vocab, shared_len).astype(np.int32)
+    prompts = [np.concatenate([shared,
+                               rs.randint(0, vocab, tail_len)
+                               .astype(np.int32)])
+               for _ in range(n_requests)]
+    max_seq = min(shared_len + tail_len + new_tokens + 2,
+                  model.config.max_position_embeddings)
+    # pool big enough to keep every request's chain warm: eviction
+    # thrash would make the cache-on numbers measure the wrong thing
+    # (a deliberately small pool is the hammer TEST, not the bench)
+    per_req = -(-(shared_len + tail_len + new_tokens) // page_size)
+    num_pages = 1 + (n_requests + 2) * per_req
+    out: Dict = {"requests": n_requests, "shared_len": shared_len,
+                 "tail_len": tail_len, "new_tokens": new_tokens,
+                 "page_size": page_size}
+    for mode, key in ((False, "cache_off"), (True, "cache_on")):
+        srv = LLMServer(model, max_batch=2, max_seq_len=max_seq,
+                        page_size=page_size, num_pages=num_pages,
+                        kvcache=mode,
+                        pipeline_depth=pipeline_depth).start()
+        try:
+            # warmup: one untimed pass over the WHOLE workload compiles
+            # every prefill bucket the timed pass will touch (cache-on
+            # matched lengths stabilize once the chains exist) and
+            # seeds the shared chains
+            for p in prompts:
+                srv.submit(p, max_new_tokens=new_tokens).get(timeout=600)
+            tokens0 = srv.prefill_tokens_total
+            saved0 = srv.prefix_tokens_saved
+            ttfts = []
+            for p in prompts:
+                req = srv.submit(p, max_new_tokens=new_tokens)
+                req.get(timeout=600)
+                ttfts.append((req.t_first_token - req.t_submit) * 1e3)
+            out[key] = {
+                "ttft_ms": round(float(np.mean(ttfts)), 3),
+                "ttft_p50_ms": round(float(np.median(ttfts)), 3),
+                "prefill_tokens": srv.prefill_tokens_total - tokens0,
+            }
+            if mode:
+                out[key]["hits"] = srv._kv.hits
+                out[key]["evictions"] = srv._kv.evictions
+                # timed-pass delta, like the sibling fields — the
+                # server-lifetime tally would double-count the warmup
+                out["prefill_tokens_saved"] = (srv.prefix_tokens_saved
+                                               - saved0)
+        finally:
+            srv.stop()
+    off, on = out["cache_off"], out["cache_on"]
+    out["prefill_tokens_saved_vs_off"] = (off["prefill_tokens"]
+                                          - on["prefill_tokens"])
+    if on["ttft_ms"]:
+        out["ttft_speedup"] = round(off["ttft_ms"] / on["ttft_ms"], 3)
+    return out
+
+
+def main(argv) -> int:
+    def flag(name: str, default: Optional[str] = None):
+        if name in argv:
+            return argv[argv.index(name) + 1]
+        return default
+
+    out = run_prefix_bench(
+        n_requests=int(flag("--requests", "8")),
+        shared_len=int(flag("--shared-len", "48")),
+        tail_len=int(flag("--tail-len", "6")),
+        new_tokens=int(flag("--new-tokens", "4")),
+        page_size=int(flag("--page-size", "16")),
+        pipeline_depth=int(flag("--depth", "2")))
+    if "--json" in argv:
+        print(json.dumps(out))
+        return 0
+    print(f"prefix microbench: {out['requests']} requests, shared "
+          f"{out['shared_len']} + tail {out['tail_len']} tokens")
+    for key in ("cache_off", "cache_on"):
+        d = out[key]
+        extra = (f"  hits={d['hits']} evict={d['evictions']}"
+                 if "hits" in d else "")
+        print(f"  {key:<10} ttft={d['ttft_ms']:>8.3f} ms  "
+              f"(p50 {d['ttft_p50_ms']:.3f})  "
+              f"prefill_tokens={d['prefill_tokens']}{extra}")
+    print(f"  prefill tokens saved: {out.get('prefill_tokens_saved', 0)}"
+          f"  ttft speedup: {out.get('ttft_speedup', 'n/a')}x")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
