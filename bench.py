@@ -873,6 +873,19 @@ def _compact_northstar(out: dict) -> dict:
             "tokens_saved": pb.get("prefill_tokens_saved"),
             "speedup": pb.get("ttft_speedup"),
         }
+    # ISSUE 6: host-tier headline — evicted chains served from the
+    # arena instead of re-prefilled on the oversized working set
+    tb = ((ex.get("telemetry") or {}).get("microbench_tier") or {})
+    if "error" in tb:
+        ns["kvtier"] = {"error": str(tb["error"])[:80]}
+    else:
+        ns["kvtier"] = {
+            "ttft_off_ms": (tb.get("tier_off") or {}).get("ttft_ms"),
+            "ttft_on_ms": (tb.get("tier_on") or {}).get("ttft_ms"),
+            "tokens_saved": tb.get("prefill_tokens_saved_vs_off"),
+            "fetches": (tb.get("tier_on") or {}).get("fetches"),
+            "hit_rate": (tb.get("tier_on") or {}).get("hit_rate"),
+        }
     return {"metric": out["metric"], "value": out["value"],
             "unit": out["unit"], "vs_baseline": out.get("vs_baseline"),
             "extra": {"northstar_summary": ns,
@@ -925,6 +938,14 @@ def _telemetry_block() -> dict:
         out["microbench_prefix"] = run_prefix_bench()
     except Exception as e:
         out["microbench_prefix"] = {"error": repr(e)}
+    try:
+        # ISSUE 6: working set sized past the HBM pool, tier off/on —
+        # host-arena fetches must reappear as deleted prefill tokens
+        # (bench_regress diffs the ttft_ms pair and the savings)
+        from tools.microbench_tier import run_tier_bench
+        out["microbench_tier"] = run_tier_bench()
+    except Exception as e:
+        out["microbench_tier"] = {"error": repr(e)}
     return out
 
 

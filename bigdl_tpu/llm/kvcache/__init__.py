@@ -46,10 +46,20 @@ class Admission:
     at EOS); ``shared_pages`` the adopted full-prefix pages (one pool
     ref + a possibly-shared pin each); ``tail_src`` the COW fork source
     page when the match ended mid-page (a transient ref/pin dropped as
-    soon as the partial prefill is dispatched)."""
+    soon as the partial prefill is dispatched).
+
+    Host-tier extension (ISSUE 6): when part of the matched prefix is
+    resident in the host arena, ``fetch`` names its ``(key, slot)``
+    chunks, ``fetch_job`` the in-flight migration uploading them, and
+    ``fetch_reserved`` the budget pre-charged for their future pool
+    pages. ``matched_len`` already INCLUDES the host chunks; if the
+    fetch fails, :meth:`KVCacheManager.degrade` rolls it back to
+    ``device_matched`` and converts the pre-charge into plain suffix
+    budget — a host miss, never a stall."""
 
     __slots__ = ("matched_len", "shared_pages", "tail_src", "tail_len",
-                 "charge")
+                 "charge", "fetch", "fetch_job", "fetch_reserved",
+                 "device_matched")
 
     def __init__(self, matched_len: int = 0,
                  shared_pages: Optional[List[int]] = None,
@@ -60,6 +70,10 @@ class Admission:
         self.tail_src = tail_src
         self.tail_len = tail_len
         self.charge = charge
+        self.fetch: List[Any] = []
+        self.fetch_job = None
+        self.fetch_reserved = 0
+        self.device_matched = matched_len
 
 
 class KVCacheManager:
@@ -76,6 +90,12 @@ class KVCacheManager:
         self.enabled = bool(enabled)
         self.index: Optional[RadixIndex] = (
             RadixIndex(self.pool) if self.enabled else None)
+        # host tier (ISSUE 6): attached by the engine when
+        # bigdl.llm.kvtier.enabled — None means every tier branch below
+        # is structurally absent (the PR 5 manager exactly)
+        self.tier = None
+        self._read_page = None     # engine: pid -> (k_dev, v_dev) gather
+        self._write_pages = None   # engine: (pids, k_devs, v_devs) scatter
         self._lock = threading.RLock()
         # always-on plain accounting (tools/microbench_prefix.py and
         # GET /debug/kvcache read these; metric series mirror them only
@@ -129,6 +149,96 @@ class KVCacheManager:
         ins["shared"].set(self.pool.shared_pages())
         ins["occupancy"].set(
             self.pool.allocated() / max(self.pool.num_pages - 1, 1))
+        if self.tier is not None:
+            self.tier.record_gauges()
+
+    # -- host tier (ISSUE 6) -------------------------------------------------
+    def attach_tier(self, tier, reader, writer):
+        """Arm the host spill tier. ``reader(pid)`` must DISPATCH a
+        per-page gather of the engine's pools and return the standalone
+        device arrays (engine thread only — eviction runs under the
+        engine lock, and engine-thread dispatch order is what keeps the
+        gather ahead of any reuse of the page id). ``writer(pids,
+        k_devs, v_devs)`` scatters fetched pages into the pools."""
+        if not self.enabled:
+            raise ValueError(
+                "the host tier extends the prefix cache: enable "
+                "bigdl.llm.kvcache first")
+        self.tier = tier
+        self._read_page = reader
+        self._write_pages = writer
+
+    def _spill(self, token_path, pid: int):
+        """Eviction hook: capture the page into the host arena before
+        its id is freed. Best-effort by contract — any failure here
+        (arena saturated, injected ``kvtier.spill``) leaves the
+        eviction a plain drop."""
+        if len(token_path) % self.page:
+            return              # partial tails re-prefill on miss
+        try:
+            slot = self.tier.arena.reserve(tuple(token_path))
+            if slot is None:
+                return          # every slot pinned: skip this spill
+            k_dev, v_dev = self._read_page(pid)
+            self.tier.migrator.submit_spill(tuple(token_path), slot,
+                                            k_dev, v_dev)
+            self.tier.count_spill()
+        except Exception:
+            pass
+
+    def materialize(self, adm: Admission, k_devs, v_devs):
+        """Land a completed fetch: allocate pool pages (pre-evicting if
+        needed — may raise the injected ``kvcache.evict``, in which
+        case the caller retries, nothing committed), scatter the
+        uploaded pages in, index the chunks, and convert the admission
+        pre-charge into ordinary pinned-shared adoption. After this the
+        admission is indistinguishable from a device prefix hit."""
+        with self._lock:
+            n = len(adm.fetch)
+            if n == 0:
+                return
+            self.ensure_free(n)             # retryable injected raise
+            pids = [self.pool.take_free() for _ in range(n)]
+            self._write_pages(pids, k_devs, v_devs)
+            # index under the chain identity: the device-matched chunks
+            # already have nodes (kept as-is), the fetched chunks take
+            # one index ref each. A chunk some concurrent request
+            # indexed meanwhile keeps ITS page; ours then stays a
+            # request-private ref that frees at EOS.
+            chain = list(adm.fetch[-1][0])
+            self.index.insert(chain, list(adm.shared_pages) + pids)
+            for pid in pids:
+                # take_free's ref becomes the request's adoption ref;
+                # the pin consumes the admission-time pre-charge
+                self.pool.pin_precharged(pid)
+            adm.shared_pages.extend(pids)
+            adm.fetch_reserved = 0
+            adm.fetch = []
+            adm.fetch_job = None
+            host_tokens = n * self.page
+            self.prefix_tokens_reused += host_tokens
+            self._count("reused", host_tokens)
+            self.tier.count_fetch(n)
+            self.record_gauges()
+
+    def degrade(self, adm: Admission):
+        """A failed / timed-out / cancelled fetch becomes a plain cache
+        miss: the matched prefix rolls back to the device-resident part
+        and the fetch pre-charge converts 1:1 into the suffix budget
+        the extra prefill pages need (the arena pins are the migration
+        worker's to release)."""
+        with self._lock:
+            if not adm.fetch:
+                return
+            if adm.fetch_job is not None:
+                adm.fetch_job.cancelled = True
+            adm.charge += adm.fetch_reserved
+            adm.fetch_reserved = 0
+            adm.fetch = []
+            adm.fetch_job = None
+            adm.matched_len = adm.device_matched
+            adm.tail_src, adm.tail_len = None, 0
+            self.tier.count_fetch_failure()
 
     def _count(self, name: str, n: int = 1):
         ins = self._instruments()
@@ -150,14 +260,27 @@ class KVCacheManager:
         no refs taken, no LRU touch, no counters."""
         with self._lock:
             matched = 0
+            matched_total = 0
             if self.enabled:
                 m = self.index.lookup(prompt_ids, touch=False)
                 matched = min(m.matched_len, len(prompt_ids) - 1)
+                matched_total = matched
+                if self.tier is not None:
+                    # host-resident chunks reduce prefill, not budget:
+                    # each fetched page still pre-charges one page, so
+                    # pages_needed stays the device-matched suffix cost
+                    base = len(m.full_pages) * self.page
+                    host = self.tier.arena.lookup_chunks(
+                        prompt_ids, base, len(prompt_ids) - 1,
+                        touch=False)
+                    if host:
+                        matched = base
+                        matched_total = base + len(host) * self.page
             return {
                 "pages_needed": self.suffix_budget(
                     len(prompt_ids), max_new, matched),
                 "pages_free": self.pool.budget_avail,
-                "matched_tokens": matched,
+                "matched_tokens": matched_total,
             }
 
     def admit(self, prompt_ids, max_new: int) -> Optional[Admission]:
@@ -177,6 +300,18 @@ class KVCacheManager:
                 self.pool.charge(charge)
                 return Admission(charge=charge)
             m = self.index.lookup(prompt_ids)
+            # host-tier extension (ISSUE 6): consecutive arena-resident
+            # chunks past the device full-page boundary extend the
+            # match; a host chunk always beats a device tail (>= one
+            # full page vs < one), so the tail is dropped un-adopted
+            host_chunks = []
+            if self.tier is not None:
+                base = len(m.full_pages) * self.page
+                host_chunks = self.tier.arena.lookup_chunks(
+                    prompt_ids, base, T - 1)
+                if host_chunks:
+                    m.matched_len = base + len(host_chunks) * self.page
+                    m.tail_src, m.tail_len = None, 0
             # a fully-cached prompt still runs >= 1 suffix token — the
             # engine needs its logits to start decoding
             if m.matched_len > T - 1:
@@ -192,19 +327,27 @@ class KVCacheManager:
                     m.tail_len = self.page - 1
             if not m.tail_len:
                 m.tail_src = None
+            n_fetch = len(host_chunks)
             charge = self.suffix_budget(T, max_new, m.matched_len)
             adopt = list(m.full_pages)
             if m.tail_src is not None:
                 adopt.append(m.tail_src)
-            need = charge + self.pool.pin_cost(adopt)
+            # each fetched chunk pre-charges the pool page it will
+            # occupy, so materialization can never overdraft — and a
+            # degraded fetch converts the pre-charge 1:1 into the
+            # suffix budget those extra prefill pages need
+            need = charge + n_fetch + self.pool.pin_cost(adopt)
             if need > self.pool.budget_avail:
                 return None
-            self.pool.charge(charge)
+            self.pool.charge(charge + n_fetch)
             for pid in adopt:
                 self.pool.incref(pid)
                 self.pool.pin(pid)
             adm = Admission(m.matched_len, m.full_pages, m.tail_src,
                             m.tail_len, charge)
+            adm.fetch_reserved = n_fetch
+            adm.device_matched = (len(m.full_pages) * self.page
+                                  if host_chunks else m.matched_len)
             try:
                 own_prompt = (_ceil_div(T, self.page)
                               - m.matched_len // self.page)
@@ -212,27 +355,48 @@ class KVCacheManager:
             except BaseException:
                 self.cancel(adm)
                 raise
+            # arm the fetch LAST: nothing below can raise, so cancel()
+            # never races the migration worker's arena unpins
+            if host_chunks:
+                for _key, slot in host_chunks:
+                    self.tier.arena.pin(slot)
+                adm.fetch = host_chunks
+                adm.fetch_job = self.tier.migrator.submit_fetch(
+                    host_chunks)
             if m.matched_len:
+                # host tokens count toward ``reused`` only once their
+                # fetch materializes — a degraded fetch must not have
+                # inflated the savings tally
+                dev_reused = adm.device_matched
                 self.hits += 1
-                self.prefix_tokens_reused += m.matched_len
+                self.prefix_tokens_reused += dev_reused
                 self._count("hits")
-                self._count("reused", m.matched_len)
+                if dev_reused:
+                    self._count("reused", dev_reused)
             else:
                 self.misses += 1
                 self._count("misses")
             return adm
 
     def cancel(self, adm: Admission):
-        """Roll an admission back (failed prefill / injected fault):
-        drop adoption refs+pins and the budget charge."""
+        """Roll an admission back (failed prefill / injected fault /
+        engine stop with a fetch still parked): drop adoption
+        refs+pins, the budget charge and any fetch pre-charge. Arena
+        pins belong to the migration worker — cancelling the job makes
+        it release them."""
         with self._lock:
             self.release_transient(adm)
             for pid in adm.shared_pages:
                 self.pool.decref(pid)
                 self.pool.unpin(pid)
             adm.shared_pages = []
-            self.pool.release(adm.charge)
+            if adm.fetch_job is not None:
+                adm.fetch_job.cancelled = True
+            self.pool.release(adm.charge + adm.fetch_reserved)
             adm.charge = 0
+            adm.fetch_reserved = 0
+            adm.fetch = []
+            adm.fetch_job = None
 
     def release_transient(self, adm: Admission):
         """Drop the COW fork source's transient ref/pin — safe as soon
@@ -266,6 +430,22 @@ class KVCacheManager:
             self.index.insert(tokens, pages)
             self.record_gauges()
 
+    def chain_locations(self, tokens):
+        """Where a chain's cached FULL pages live right now (the
+        handoff export walk): device page ids for the radix-resident
+        prefix, then ``(key, slot)`` arena chunks continuing it. The
+        caller (engine, under its lock) pulls the device pages while
+        eviction cannot run."""
+        with self._lock:
+            m = self.index.lookup(tokens)
+            dev = list(m.full_pages)
+            host = []
+            if self.tier is not None:
+                base = len(dev) * self.page
+                host = self.tier.arena.lookup_chunks(
+                    tokens, base, len(tokens))
+            return dev, host
+
     # -- physical pages ------------------------------------------------------
     def ensure_free(self, n: int):
         """Make ``n`` pages allocatable, LRU-evicting index-only chains
@@ -282,7 +462,9 @@ class KVCacheManager:
         from bigdl_tpu import reliability
         reliability.inject("kvcache.evict")
         with self._lock:
-            freed = self.index.evict_lru(short)
+            freed = self.index.evict_lru(
+                short, spill=self._spill if self.tier is not None
+                else None)
             self.evictions += len(freed)
             self._count("evictions", len(freed))
             self.record_gauges()
@@ -328,6 +510,8 @@ class KVCacheManager:
             }
             if self.index is not None:
                 out["index"] = self.index.stats()
+            if self.tier is not None:
+                out["tier"] = self.tier.debug_stats()
             return out
 
 

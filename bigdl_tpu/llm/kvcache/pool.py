@@ -170,6 +170,19 @@ class PagePool:
             seen.add(pid)
         return cost
 
+    def pin_precharged(self, pid: int):
+        """Pin consuming a reservation the caller already holds (the
+        host-tier fetch: admission pre-charges one page per fetched
+        chunk so materialization can never overdraft). If another
+        request pinned the page while the fetch was in flight, the 0→1
+        charge already happened — the caller's pre-charge is surplus
+        and is released here so the one-reservation-per-pinned-page
+        invariant holds."""
+        c = self._pins.get(pid, 0)
+        if c != 0:
+            self.release(1)
+        self._pins[pid] = c + 1
+
     def unpin(self, pid: int):
         c = self._pins.get(pid, 0)
         if c <= 0:

@@ -159,12 +159,32 @@ class RadixIndex:
         self._touch(node)
         return taken
 
+    def token_path(self, node: RadixNode) -> Tuple[int, ...]:
+        """Every token from the root through ``node``'s chunk — the
+        identity a spilled page carries into the host tier (ISSUE 6):
+        the arena keys entries on the full prefix, so the chunk alone
+        would be ambiguous."""
+        parts: List[Tuple[int, ...]] = []
+        while node is not None and node is not self.root:
+            parts.append(node.chunk)
+            node = node.parent
+        out: List[int] = []
+        for chunk in reversed(parts):
+            out.extend(chunk)
+        return tuple(out)
+
     # -- eviction ------------------------------------------------------------
-    def evict_lru(self, n_pages: int) -> List[int]:
+    def evict_lru(self, n_pages: int, spill=None) -> List[int]:
         """Drop least-recently-used evictable leaves until ``n_pages``
         page ids returned to the free list (or nothing evictable is
         left). Leaf-first: interior nodes become candidates only once
-        their subtree is gone, so chains evict back-to-front."""
+        their subtree is gone, so chains evict back-to-front.
+
+        ``spill`` (ISSUE 6) is called as ``spill(token_path, page_id)``
+        for each victim BEFORE its page is decref'd — the host tier's
+        chance to capture the page's bytes while the id still cannot be
+        reissued. Best-effort: a spill failure must not block the
+        eviction (the manager's hook swallows and degrades)."""
         freed: List[int] = []
         while len(freed) < n_pages:
             victim: Optional[RadixNode] = None
@@ -177,6 +197,8 @@ class RadixIndex:
                     victim = node
             if victim is None:
                 break
+            if spill is not None:
+                spill(self.token_path(victim), victim.page)
             self._remove(victim)
             self.pool.decref(victim.page)
             freed.append(victim.page)

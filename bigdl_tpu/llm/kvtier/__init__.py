@@ -1,0 +1,163 @@
+"""Tiered KV cache: host-RAM spill tier + disaggregated handoff
+(ISSUE 6 tentpole).
+
+``bigdl_tpu/llm/kvtier`` is the capacity tier behind the PR 5 prefix
+cache. Radix-evicted page chains spill to a pinned host-RAM arena
+instead of being freed, and an admission that hits a host-resident
+prefix schedules an async fetch back into HBM — both transfers ride a
+background migration thread so they hide behind in-flight decode
+steps (the PR 4 pipeline):
+
+- :mod:`~bigdl_tpu.llm.kvtier.arena` — the host page arena: slotted
+  pinned buffers + an exact token-prefix index, LRU within the tier;
+- :mod:`~bigdl_tpu.llm.kvtier.migrate` — the FIFO migration worker
+  (spill = device→host, fetch = host→device) with the
+  ``kvtier.{spill,fetch}`` fault sites; failures degrade to plain
+  eviction / plain miss, never a stall or crash;
+- :mod:`~bigdl_tpu.llm.kvtier.handoff` — serialized KV-chain blobs for
+  the disaggregated prefill/decode split (``bigdl.llm.role``): a
+  prefill worker exports a request's chain through the tier, a decode
+  worker imports it into its own arena and decodes with a ~1-token
+  prefill;
+- :class:`KVTier` (here) — what the engine's
+  :class:`~bigdl_tpu.llm.kvcache.KVCacheManager` holds: arena +
+  migrator + the ``bigdl_kvtier_*`` accounting.
+
+``bigdl.llm.kvtier.enabled=false`` (the default) constructs none of
+this: no arena, no migration thread, no ``bigdl_kvtier_*`` series, no
+``tier`` block on ``GET /debug/kvcache`` — and the engine is
+bit-identical to the PR 5 engine (asserted in tests/test_kvtier.py).
+
+See docs/KVCACHE.md ("Host tier") for the migration lifecycle and the
+disaggregated topology.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from bigdl_tpu.llm.kvtier.arena import HostArena, HostArenaError
+from bigdl_tpu.llm.kvtier.handoff import (HandoffError, deserialize_chain,
+                                          serialize_chain)
+from bigdl_tpu.llm.kvtier.migrate import MigrationJob, Migrator
+
+
+class KVTier:
+    """Arena + migrator + tier accounting, owned by the KVCacheManager
+    when ``bigdl.llm.kvtier.enabled`` (or the ``kvtier=`` ctor arg) is
+    on. Pure host-side object; every device touch goes through the
+    engine-registered reader/writer callbacks on the manager."""
+
+    def __init__(self, host_pages: int, page_size: int,
+                 synchronous: bool = False,
+                 fetch_timeout: float = 30.0):
+        self.arena = HostArena(host_pages, page_size)
+        self.migrator = Migrator(self.arena, synchronous=synchronous)
+        self.fetch_timeout = fetch_timeout
+        # always-on tallies (debug endpoint + microbench); the metric
+        # series below mirror them only while observability is enabled
+        self.spills = 0
+        self.fetches = 0
+        self.fetch_failures = 0
+        self.handoffs_out = 0
+        self.handoffs_in = 0
+        self.handoff_bytes = 0
+        self._ins: Optional[Dict[str, Any]] = None
+
+    # -- observability -------------------------------------------------------
+    def _instruments(self):
+        from bigdl_tpu import observability as obs
+        if not obs.enabled():
+            return None
+        if self._ins is None:
+            self._ins = {
+                "spills": obs.counter(
+                    "bigdl_kvtier_spills_total",
+                    "Pages spilled from HBM to the host arena"),
+                "fetches": obs.counter(
+                    "bigdl_kvtier_fetches_total",
+                    "Pages fetched from the host arena back into HBM"),
+                "fetch_failures": obs.counter(
+                    "bigdl_kvtier_fetch_failures_total",
+                    "Host-tier fetches that degraded to a cache miss"),
+                "handoffs": obs.counter(
+                    "bigdl_kvtier_handoffs_total",
+                    "KV-chain handoffs across the prefill/decode split",
+                    labelnames=("direction",)),
+                "handoff_bytes": obs.counter(
+                    "bigdl_kvtier_handoff_bytes_total",
+                    "Serialized KV bytes moved by handoffs"),
+                "host_used": obs.gauge(
+                    "bigdl_kvtier_host_pages_used",
+                    "Host arena slots currently holding a page"),
+                "host_capacity": obs.gauge(
+                    "bigdl_kvtier_host_pages",
+                    "Host arena capacity in page slots"),
+                "inflight": obs.gauge(
+                    "bigdl_kvtier_inflight_migrations",
+                    "Migration jobs queued or running"),
+            }
+        return self._ins
+
+    def record_gauges(self):
+        ins = self._instruments()
+        if ins is None:
+            return
+        ins["host_used"].set(self.arena.used())
+        ins["host_capacity"].set(self.arena.capacity)
+        ins["inflight"].set(self.migrator.inflight())
+
+    def count_spill(self, n: int = 1):
+        self.spills += n
+        ins = self._instruments()
+        if ins is not None:
+            ins["spills"].inc(n)
+            self.record_gauges()
+
+    def count_fetch(self, n: int):
+        self.fetches += n
+        ins = self._instruments()
+        if ins is not None:
+            ins["fetches"].inc(n)
+            self.record_gauges()
+
+    def count_fetch_failure(self, n: int = 1):
+        self.fetch_failures += n
+        ins = self._instruments()
+        if ins is not None:
+            ins["fetch_failures"].inc(n)
+
+    def count_handoff(self, direction: str, nbytes: int):
+        if direction == "export":
+            self.handoffs_out += 1
+        else:
+            self.handoffs_in += 1
+        self.handoff_bytes += nbytes
+        ins = self._instruments()
+        if ins is not None:
+            ins["handoffs"].labels(direction=direction).inc()
+            ins["handoff_bytes"].inc(nbytes)
+
+    # -- introspection -------------------------------------------------------
+    def debug_stats(self) -> Dict[str, Any]:
+        """The ``tier`` block of ``GET /debug/kvcache``."""
+        out = self.arena.stats()
+        out.update({
+            "spills": self.spills,
+            "fetches": self.fetches,
+            "fetch_failures": self.fetch_failures,
+            "spill_failures": self.migrator.spill_failures,
+            "inflight_migrations": self.migrator.inflight(),
+            "handoffs_out": self.handoffs_out,
+            "handoffs_in": self.handoffs_in,
+            "handoff_bytes": self.handoff_bytes,
+        })
+        return out
+
+    def close(self):
+        self.migrator.stop()
+
+
+__all__ = ["HandoffError", "HostArena", "HostArenaError", "KVTier",
+           "MigrationJob", "Migrator", "deserialize_chain",
+           "serialize_chain"]

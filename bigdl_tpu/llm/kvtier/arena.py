@@ -1,0 +1,248 @@
+"""Pinned host-RAM page arena (ISSUE 6 tentpole part 1).
+
+The PR 5 radix index drops evicted prefix chains on the floor, so the
+reusable KV working set is capped by a single chip's HBM. The arena is
+the capacity tier behind it: page-granularity K/V copies live in
+preallocated host buffers (one contiguous ``(capacity, L, H, page, D)``
+block per side — allocated once, so the host allocator never fragments
+the way per-page ``np.array`` churn would; on runtimes that pin
+transfer staging this is the pinned region DMA reads/writes).
+
+Entries are keyed by the **full token prefix** through the chunk
+(``tuple(tokens[:end])``, the same identity the radix tree encodes
+path-wise). An exact-match dict instead of a second tree keeps the
+host tier robust to arbitrary insertion order: device eviction is
+leaf-first, so chains spill back-to-front and the deepest chunk
+arrives *first* — a tree would need phantom interior nodes, the dict
+does not care. A dropped middle chunk merely truncates the usable
+prefix at lookup time (the walk stops at the first missing key);
+nothing structural can corrupt.
+
+Only FULL pages are admitted: a partially-filled tail page is still
+private to a live request's decode when it evicts, and its token key
+would collide with the full page that position range eventually
+holds. Tails simply re-prefill on a later miss (cheap: < one page of
+tokens).
+
+Thread-safe (its own lock): the engine thread reserves/looks up while
+the migration thread commits/aborts. **Pins** keep a slot's bytes
+immovable while a migration is in flight — a pinned slot is never
+LRU-evicted and its buffers are never handed to another key.
+
+Host-side only; no jax imports. Unit-testable with bare numpy.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+
+class HostArenaError(RuntimeError):
+    """Internal-invariant violation (double commit, unpin underflow)."""
+
+
+class HostArena:
+    """Slot allocator + token-prefix index over the host page buffers.
+
+    ``capacity`` is the number of host page slots
+    (``bigdl.llm.kvtier.host_pages``). Buffer shape/dtype are fixed by
+    the first :meth:`reserve` caller's page shape — the arena is owned
+    by one engine (one model config), so all pages are alike.
+    """
+
+    def __init__(self, capacity: int, page_size: int):
+        if capacity < 1:
+            raise ValueError("host arena needs at least one page slot")
+        self.capacity = capacity
+        self.page = page_size
+        self._lock = threading.Lock()
+        # slot ids pop low-first like the device pool (no parity
+        # requirement here — just the same debuggable convention)
+        self._free: List[int] = list(range(capacity - 1, -1, -1))
+        self._index: Dict[Tuple[int, ...], int] = {}   # key -> slot
+        self._slots: Dict[int, dict] = {}   # slot -> {key, ready, tick}
+        self._pins: Dict[int, int] = {}
+        self._tick = 0
+        self._k = None     # (capacity, L, H, page, D), lazily shaped
+        self._v = None
+        # plain tallies (debug endpoint + microbench)
+        self.host_evictions = 0
+        self.bytes_per_page = 0
+
+    # -- buffers -------------------------------------------------------------
+    def _ensure_buffers(self, page_shape, dtype):
+        import numpy as np
+        if self._k is None:
+            shape = (self.capacity,) + tuple(page_shape)
+            self._k = np.zeros(shape, dtype)
+            self._v = np.zeros(shape, dtype)
+            self.bytes_per_page = 2 * self._k[0].nbytes
+        elif self._k.shape[1:] != tuple(page_shape) or \
+                self._k.dtype != dtype:
+            raise HostArenaError(
+                f"arena shaped {self._k.shape[1:]}/{self._k.dtype} "
+                f"cannot hold a {tuple(page_shape)}/{dtype} page")
+
+    # -- allocation ----------------------------------------------------------
+    def reserve(self, key: Tuple[int, ...]) -> Optional[int]:
+        """Claim a slot for ``key`` (pinned, not yet readable) — the
+        spill/import side. An existing entry for the key is reused
+        (same tokens at the same positions hold identical KV — the
+        re-spill just refreshes it). Returns None when every slot is
+        pinned (arena saturated by in-flight migrations): the caller
+        drops the spill, which degrades to a plain eviction."""
+        with self._lock:
+            if len(key) % self.page:
+                raise HostArenaError(
+                    "arena holds full pages only (partial tails "
+                    "re-prefill on miss)")
+            slot = self._index.get(key)
+            if slot is None:
+                slot = self._take_slot_locked()
+                if slot is None:
+                    return None
+                self._index[key] = slot
+                self._slots[slot] = {"key": key, "ready": False,
+                                     "tick": self._bump()}
+            else:
+                self._slots[slot]["ready"] = False
+            self._pins[slot] = self._pins.get(slot, 0) + 1
+            return slot
+
+    def _take_slot_locked(self) -> Optional[int]:
+        if self._free:
+            return self._free.pop()
+        victim = None
+        for slot, meta in self._slots.items():
+            if slot in self._pins or not meta["ready"]:
+                continue
+            if victim is None or meta["tick"] < \
+                    self._slots[victim]["tick"]:
+                victim = slot
+        if victim is None:
+            return None
+        self._drop_locked(victim)
+        self.host_evictions += 1
+        return self._free.pop()
+
+    def _drop_locked(self, slot: int):
+        meta = self._slots.pop(slot)
+        self._index.pop(meta["key"], None)
+        self._free.append(slot)
+
+    def _bump(self) -> int:
+        self._tick += 1
+        return self._tick
+
+    # -- migration-side writes -----------------------------------------------
+    def commit(self, slot: int, k_page, v_page):
+        """Publish a reserved slot's bytes (migration/import thread):
+        write, mark ready, drop the reserve pin."""
+        with self._lock:
+            meta = self._slots.get(slot)
+            if meta is None:
+                raise HostArenaError(f"commit of unreserved slot {slot}")
+            self._ensure_buffers(k_page.shape, k_page.dtype)
+            self._k[slot] = k_page
+            self._v[slot] = v_page
+            meta["ready"] = True
+            meta["tick"] = self._bump()
+            self._unpin_locked(slot)
+
+    def abort(self, slot: int):
+        """A reserved slot whose bytes never arrived (failed/injected
+        spill): remove the entry entirely so lookups can never serve a
+        zero-filled page."""
+        with self._lock:
+            if slot in self._slots and not self._slots[slot]["ready"]:
+                self._unpin_locked(slot)
+                if slot not in self._pins:
+                    self._drop_locked(slot)
+            elif slot in self._slots:
+                self._unpin_locked(slot)
+
+    # -- lookup / fetch side -------------------------------------------------
+    def lookup_chunks(self, tokens, start: int, limit: int,
+                      *, touch: bool = True
+                      ) -> List[Tuple[Tuple[int, ...], int]]:
+        """Consecutive READY full-page chunks of ``tokens`` resident in
+        the arena, beginning at position ``start`` (a page multiple) and
+        never reaching past ``limit`` tokens (the caller passes
+        ``len(prompt) - 1`` so at least one suffix token always
+        prefills). Returns ``[(key, slot), ...]`` in chain order."""
+        toks = tuple(int(t) for t in tokens)
+        out: List[Tuple[Tuple[int, ...], int]] = []
+        with self._lock:
+            end = start + self.page
+            while end <= limit:
+                slot = self._index.get(toks[:end])
+                if slot is None or not self._slots[slot]["ready"]:
+                    break
+                out.append((toks[:end], slot))
+                if touch:
+                    self._slots[slot]["tick"] = self._bump()
+                end += self.page
+        return out
+
+    def pin(self, slot: int):
+        with self._lock:
+            if slot not in self._slots:
+                raise HostArenaError(f"pin of unknown slot {slot}")
+            self._pins[slot] = self._pins.get(slot, 0) + 1
+
+    def unpin(self, slot: int):
+        with self._lock:
+            self._unpin_locked(slot)
+
+    def _unpin_locked(self, slot: int):
+        c = self._pins.get(slot, 0)
+        if c <= 0:
+            raise HostArenaError(f"unpin of unpinned slot {slot}")
+        if c == 1:
+            del self._pins[slot]
+        else:
+            self._pins[slot] = c - 1
+
+    def read(self, slot: int):
+        """The slot's (k, v) page views — caller must hold a pin so the
+        slot cannot be evicted or rewritten mid-read."""
+        with self._lock:
+            meta = self._slots.get(slot)
+            if meta is None or not meta["ready"]:
+                raise HostArenaError(f"read of non-ready slot {slot}")
+            return self._k[slot], self._v[slot]
+
+    def read_keyed(self, slot: int, key: Tuple[int, ...]):
+        """COPIES of a slot's pages, validated against the key the
+        caller looked up — or None if the slot was re-keyed meanwhile
+        (a lookup→read gap with the lock released lets LRU eviction
+        hand the slot to another chain; a pin-less reader must not
+        export the wrong chain's bytes). The copy happens under the
+        lock, so no pin is needed at all."""
+        with self._lock:
+            meta = self._slots.get(slot)
+            if meta is None or not meta["ready"] or meta["key"] != key:
+                return None
+            return self._k[slot].copy(), self._v[slot].copy()
+
+    # -- introspection -------------------------------------------------------
+    def used(self) -> int:
+        with self._lock:
+            return len(self._slots)
+
+    def pinned(self) -> int:
+        with self._lock:
+            return len(self._pins)
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            ready = sum(1 for m in self._slots.values() if m["ready"])
+            return {
+                "capacity": self.capacity,
+                "used": len(self._slots),
+                "ready": ready,
+                "pinned": len(self._pins),
+                "evictions": self.host_evictions,
+                "bytes_used": ready * self.bytes_per_page,
+            }

@@ -1,0 +1,124 @@
+"""KV-chain handoff blobs for disaggregated prefill/decode (ISSUE 6).
+
+A prefill-role worker computes a prompt's KV once, then ships the full
+pages of that chain to a decode-role worker as one self-describing
+binary blob. The decode worker lands the pages in its **host arena**
+(never directly in HBM): the next admission of that prompt hits the
+host tier and the normal async fetch path uploads the pages behind
+in-flight decode steps — import is control-plane-only and the
+migration machinery stays the single door into the device pool.
+
+Wire format (version 1)::
+
+    magic  b"BDKV1\\n"
+    header u32 length + UTF-8 JSON {tokens, page_size, shape, dtype,
+                                    pages}
+    body   pages × (k_page ‖ v_page) raw bytes, C-order
+
+Raw bytes + a JSON header instead of ``np.savez``: the pools are often
+``bfloat16`` (an ml_dtypes extension type NpzFile round-trips
+unreliably across numpy versions), and bit-exactness is the whole
+point — the decode worker must produce the same greedy tokens the
+prefill worker's own decode would have.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from typing import Dict, List, Tuple
+
+MAGIC = b"BDKV1\n"
+
+
+class HandoffError(ValueError):
+    """Malformed or incompatible handoff blob."""
+
+
+def serialize_chain(tokens, k_pages: List, v_pages: List,
+                    page_size: int) -> bytes:
+    """Pack ``len(k_pages)`` full pages covering ``tokens`` (page ``j``
+    holds tokens ``[j*page, (j+1)*page)``) into a handoff blob.
+    ``k_pages[j]``/``v_pages[j]`` are same-shape/dtype numpy arrays
+    (the per-page ``(L, H, page, D)`` layout the arena holds)."""
+    import numpy as np
+    if len(k_pages) != len(v_pages):
+        raise HandoffError("k/v page count mismatch")
+    if len(tokens) < len(k_pages) * page_size:
+        raise HandoffError("fewer tokens than the pages cover")
+    header = {
+        "tokens": [int(t) for t in tokens[:len(k_pages) * page_size]],
+        "page_size": int(page_size),
+        "pages": len(k_pages),
+        "shape": [],
+        "dtype": "",
+    }
+    body = bytearray()
+    for k, v in zip(k_pages, v_pages):
+        k = np.ascontiguousarray(k)
+        v = np.ascontiguousarray(v)
+        if not header["dtype"]:
+            header["shape"] = list(k.shape)
+            header["dtype"] = str(k.dtype)
+        if list(k.shape) != header["shape"] or \
+                list(v.shape) != header["shape"] or \
+                str(v.dtype) != header["dtype"]:
+            raise HandoffError("inconsistent page shapes in chain")
+        body += k.tobytes()
+        body += v.tobytes()
+    hdr = json.dumps(header).encode()
+    return MAGIC + struct.pack("<I", len(hdr)) + hdr + bytes(body)
+
+
+def deserialize_chain(blob: bytes) -> Tuple[List[int], List, List, Dict]:
+    """Unpack a blob into ``(tokens, k_pages, v_pages, header)``. The
+    importer validates ``page_size``/``shape``/``dtype`` against its own
+    pool before landing anything."""
+    import numpy as np
+    if not blob.startswith(MAGIC):
+        raise HandoffError("not a KV handoff blob (bad magic)")
+    off = len(MAGIC)
+    if len(blob) < off + 4:
+        raise HandoffError("truncated handoff header")
+    (hlen,) = struct.unpack_from("<I", blob, off)
+    off += 4
+    try:
+        header = json.loads(blob[off:off + hlen].decode())
+    except Exception as e:
+        raise HandoffError(f"unreadable handoff header: {e}") from None
+    off += hlen
+    if not int(header["pages"]):
+        # a fully-evicted chain exports as an empty blob: the importer
+        # simply has nothing to land and the decode side re-prefills
+        return list(map(int, header["tokens"])), [], [], header
+    shape = tuple(header["shape"])
+    dtype = np.dtype(_resolve_dtype(header["dtype"]))
+    per = int(np.prod(shape)) * dtype.itemsize
+    n = int(header["pages"])
+    if len(blob) - off != 2 * per * n:
+        raise HandoffError(
+            f"handoff body holds {len(blob) - off} bytes, expected "
+            f"{2 * per * n} for {n} pages of {shape} {dtype}")
+    k_pages, v_pages = [], []
+    for _ in range(n):
+        k_pages.append(np.frombuffer(blob, dtype, count=per
+                                     // dtype.itemsize,
+                                     offset=off).reshape(shape))
+        off += per
+        v_pages.append(np.frombuffer(blob, dtype, count=per
+                                     // dtype.itemsize,
+                                     offset=off).reshape(shape))
+        off += per
+    return list(map(int, header["tokens"])), k_pages, v_pages, header
+
+
+def _resolve_dtype(name: str):
+    """Numpy dtype from its string name, including the ml_dtypes
+    extension types jax pools use (``bfloat16``)."""
+    import numpy as np
+    try:
+        return np.dtype(name)
+    except TypeError:
+        pass
+    import jax.numpy as jnp
+    return jnp.dtype(name)

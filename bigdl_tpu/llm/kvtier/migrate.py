@@ -1,0 +1,210 @@
+"""Async HBM↔host page migration (ISSUE 6 tentpole part 2).
+
+One background worker thread drains a FIFO of migration jobs so the
+blocking halves of a migration — ``np.asarray`` (device→host) on a
+spill, ``jax.device_put`` (host→device) on a fetch — never run on the
+engine thread. The engine's half is dispatch-only:
+
+- **spill**: the engine dispatches a per-page gather
+  (``pool[:, pid]``) at eviction time, which materializes the page
+  into its own device buffer *before* the page id can be reissued and
+  overwritten (engine-thread program order, the same donated-pool
+  dependency argument the partial prefill relies on). The worker then
+  pulls those standalone buffers to the host and commits them into the
+  arena — overlapped with whatever decode steps are in flight.
+- **fetch**: the worker uploads arena pages to fresh device buffers;
+  the engine polls ``job.done`` from its admission pass and scatters
+  the uploaded pages into the pool only once the upload exists — so a
+  host-tier hit hides its transfer behind the decode steps of the
+  requests already running.
+
+FIFO on a single worker also orders a fetch behind the spill that
+produced its bytes, so a freshly-spilled chunk is fetchable with no
+extra synchronization.
+
+Failure contract (the ``kvtier.{spill,fetch}`` fault sites fire here):
+a failed spill aborts its arena entry (the chunk is simply not
+cached); a failed fetch marks the job failed and the engine degrades
+the admission to a plain cache miss. Neither ever raises into the
+engine loop or leaves an arena pin behind.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Any, List, Optional, Tuple
+
+from bigdl_tpu.llm.kvtier.arena import HostArena
+
+
+class MigrationJob:
+    """One queued migration. ``done`` is set exactly once, after ``ok``
+    and the payload are final. ``cancelled`` (engine-set, e.g. fetch
+    timeout) tells the worker to skip the transfer; the arena pins are
+    released either way."""
+
+    __slots__ = ("kind", "done", "ok", "error", "cancelled",
+                 "entries", "k_dev", "v_dev", "submitted_at")
+
+    def __init__(self, kind: str, entries):
+        self.kind = kind
+        self.entries = entries        # [(key, slot, *payload)]
+        self.done = threading.Event()
+        self.ok = False
+        self.error: Optional[str] = None
+        self.cancelled = False
+        self.k_dev: List[Any] = []    # fetch results (device arrays)
+        self.v_dev: List[Any] = []
+        self.submitted_at = time.monotonic()
+
+
+class Migrator:
+    """The worker thread + job queue. ``synchronous=True`` executes
+    jobs inline at submit (no thread): deterministic unit tests and the
+    tier-1 suite's fake-clock budget use it; production runs async."""
+
+    def __init__(self, arena: HostArena, synchronous: bool = False):
+        self.arena = arena
+        self.synchronous = synchronous
+        self._queue: "queue.Queue[Optional[MigrationJob]]" = queue.Queue()
+        self._thread: Optional[threading.Thread] = None
+        self._idle = threading.Event()
+        self._idle.set()
+        self._lock = threading.Lock()
+        self._stopped = False
+        # plain tallies (tier metrics mirror them when obs is on)
+        self.spills_done = 0
+        self.spill_failures = 0
+        self.fetches_done = 0
+        self.fetch_failures = 0
+
+    # -- submission ----------------------------------------------------------
+    def _submit(self, job: MigrationJob) -> MigrationJob:
+        if self.synchronous:
+            self._run(job)
+            return job
+        with self._lock:
+            if self._stopped:
+                # a stopped migrator fails jobs instead of leaking pins
+                self._resolve_pins(job)
+                job.error = "migrator stopped"
+                job.done.set()
+                return job
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._loop, name="bigdl-kvtier-migrate",
+                    daemon=True)
+                self._thread.start()
+            self._idle.clear()
+        self._queue.put(job)
+        return job
+
+    def submit_spill(self, key, slot: int, k_dev, v_dev) -> MigrationJob:
+        """Device→host. ``k_dev``/``v_dev`` are the engine's standalone
+        per-page gather outputs; the arena slot is reserve-pinned."""
+        return self._submit(
+            MigrationJob("spill", [(key, slot, k_dev, v_dev)]))
+
+    def submit_fetch(self, entries: List[Tuple[Any, int]]) -> MigrationJob:
+        """Host→device for a chain of ``(key, slot)`` arena chunks (the
+        caller pinned each slot; the worker unpins when finished)."""
+        return self._submit(MigrationJob("fetch", list(entries)))
+
+    # -- worker --------------------------------------------------------------
+    def _loop(self):
+        while True:
+            job = self._queue.get()
+            if job is None:
+                return
+            try:
+                self._run(job)
+            finally:
+                if self._queue.empty():
+                    self._idle.set()
+
+    def _run(self, job: MigrationJob):
+        from bigdl_tpu import observability as obs
+        from bigdl_tpu import reliability
+        t0 = time.time()
+        try:
+            if job.cancelled:
+                raise RuntimeError("cancelled before transfer")
+            reliability.inject(f"kvtier.{job.kind}")
+            if job.kind == "spill":
+                self._run_spill(job)
+            else:
+                self._run_fetch(job)
+            job.ok = True
+        except BaseException as e:  # noqa: BLE001 — a migration must
+            # degrade (miss / plain eviction), never crash the worker
+            job.error = f"{type(e).__name__}: {e}"
+            if job.kind == "spill":
+                self.spill_failures += 1
+                for _, slot, *_ in job.entries:
+                    try:
+                        self.arena.abort(slot)
+                    except Exception:
+                        pass
+            else:
+                self.fetch_failures += 1
+                self._resolve_pins(job)
+        finally:
+            if job.ok:
+                obs.add_complete(
+                    "kvtier/migrate", t0, time.time() - t0,
+                    direction=job.kind, pages=len(job.entries))
+            job.done.set()
+
+    def _run_spill(self, job: MigrationJob):
+        import numpy as np
+        for key, slot, k_dev, v_dev in job.entries:
+            self.arena.commit(slot, np.asarray(k_dev), np.asarray(v_dev))
+            self.spills_done += 1
+
+    def _run_fetch(self, job: MigrationJob):
+        import jax
+        try:
+            for key, slot in job.entries:
+                k_np, v_np = self.arena.read(slot)
+                job.k_dev.append(jax.device_put(k_np))
+                job.v_dev.append(jax.device_put(v_np))
+            self.fetches_done += len(job.entries)
+        finally:
+            self._resolve_pins(job)
+
+    def _resolve_pins(self, job: MigrationJob):
+        if job.kind != "fetch":
+            return
+        for key, slot in job.entries:
+            try:
+                self.arena.unpin(slot)
+            except Exception:
+                pass
+
+    # -- lifecycle -----------------------------------------------------------
+    def inflight(self) -> int:
+        if self.synchronous:
+            return 0
+        return self._queue.qsize() + (0 if self._idle.is_set() else 1)
+
+    def drain(self, timeout: float = 30.0) -> bool:
+        """Wait for every queued job to finish (tests, stop())."""
+        if self.synchronous:
+            return True
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self._queue.empty() and self._idle.is_set():
+                return True
+            time.sleep(0.002)
+        return self._queue.empty() and self._idle.is_set()
+
+    def stop(self, timeout: float = 5.0):
+        self.drain(timeout)
+        with self._lock:
+            self._stopped = True
+            thread = self._thread
+        if thread is not None:
+            self._queue.put(None)
+            thread.join(timeout=timeout)

@@ -342,6 +342,24 @@ class LLMServer:
     to the old free-list (same allocation order, full-prompt budgets,
     no index, no extra metric series) — bit-identical to the
     pre-kvcache engine. See docs/KVCACHE.md.
+
+    **Host spill tier (ISSUE 6, ``bigdl.llm.kvtier.enabled`` /
+    ``kvtier=`` ctor arg; default off; requires the prefix cache).**
+    Radix-evicted full-page chains spill to a pinned host-RAM arena
+    instead of being dropped: eviction dispatches a per-page gather and
+    a background migration thread pulls the bytes to the host, so the
+    spill hides behind in-flight decode. An admission whose prefix is
+    host-resident charges only the still-uncached suffix (plus one
+    pre-charged pool page per fetched chunk), schedules an async
+    host→HBM upload, and is PARKED — later requests admit and decode
+    meanwhile; the landed pages then make it an ordinary prefix hit. A
+    failed or timed-out fetch degrades to a plain cache miss (never a
+    stall). The tier is also the door for disaggregated serving:
+    :meth:`export_chain` / :meth:`import_chain` move a request's KV
+    chain between a prefill-role and a decode-role worker as one
+    serialized blob (see llm/worker.py's router). Disabled, no arena,
+    no migration thread, no ``bigdl_kvtier_*`` series — bit-identical
+    to the PR 5 engine. See docs/KVCACHE.md ("Host tier").
     """
 
     def __init__(self, model, max_batch: int = 4, max_seq_len: int = 256,
@@ -351,7 +369,9 @@ class LLMServer:
                  pipeline_depth: Optional[int] = None,
                  temperature: float = 0.0, top_k: int = 0,
                  sample_seed: int = 0,
-                 kvcache: Optional[bool] = None):
+                 kvcache: Optional[bool] = None,
+                 kvtier: Optional[bool] = None,
+                 host_pages: Optional[int] = None):
         import inspect
 
         from bigdl_tpu.llm.models.llama import forward, init_cache
@@ -484,6 +504,33 @@ class LLMServer:
                     "entry point; the prefix cache needs one per family")
             self._kv = KVCacheManager(self._num_pages, page_size,
                                       enabled=bool(kv_on))
+            # host spill tier (ISSUE 6): constructed ONLY when enabled —
+            # disabled mode must be structurally absent (no arena, no
+            # migration thread, no bigdl_kvtier_* series)
+            tier_on = (kvtier if kvtier is not None else
+                       conf.get_bool("bigdl.llm.kvtier.enabled", False))
+            self._tier = None
+            if tier_on:
+                if not kv_on:
+                    raise ValueError(
+                        "bigdl.llm.kvtier extends the prefix cache: "
+                        "enable bigdl.llm.kvcache too")
+                from bigdl_tpu.llm.kvtier import KVTier
+                hp = (host_pages if host_pages is not None else
+                      conf.get_int("bigdl.llm.kvtier.host_pages", 0))
+                self._tier = KVTier(
+                    hp or 4 * self._num_pages, page_size,
+                    synchronous=conf.get_bool(
+                        "bigdl.llm.kvtier.sync", False),
+                    fetch_timeout=conf.get_float(
+                        "bigdl.llm.kvtier.fetch.timeout", 30.0))
+                self._kv.attach_tier(self._tier,
+                                     reader=self._read_page_kv,
+                                     writer=self._write_pages_kv)
+            # host-tier admissions parked while their pages upload, and
+            # the landed ones waiting for a slot (engine thread only)
+            self._fetch_wait: List[dict] = []
+            self._fetch_ready: List[tuple] = []
             self._bt = np.zeros((max_batch, self._pages_cap), np.int32)
             self._lens = np.zeros(max_batch, np.int32)
             # device-resident twins (ISSUE 4): the step reads/advances
@@ -499,7 +546,12 @@ class LLMServer:
             # shared pages) — release decrements refcounts at EOS
             self._slot_adm: List[Optional[Any]] = [None] * max_batch
         else:
+            if kvtier:
+                raise ValueError("the host tier is page-pool only; "
+                                 "the slot-static cache has no pages")
             self._kv = None       # the slot-static cache has no pages
+            self._tier = None
+            self._fetch_wait, self._fetch_ready = [], []
             self._cache = init_cache(self.cfg, max_batch, self.max_seq_len,
                                      dtype=model.cache_dtype)
             # per-slot write positions (the shared scalar cache["pos"] is
@@ -582,6 +634,75 @@ class LLMServer:
             raise err from None
         return req
 
+    def export_chain(self, tokens) -> bytes:
+        """Serialize the cached FULL pages of ``tokens`` into a handoff
+        blob (ISSUE 6 disaggregation: the prefill-role side). Device
+        pages are pulled under the engine lock — eviction cannot run
+        concurrently, and the blocking fetch doubles as the dispatch
+        fence; host-resident chunks are read straight from the arena.
+        Pages already evicted from both tiers are simply absent: the
+        importer's decode worker re-prefills whatever is missing."""
+        from bigdl_tpu.llm.kvtier.handoff import serialize_chain
+        if self._tier is None:
+            raise RuntimeError(
+                "KV handoff needs bigdl.llm.kvtier.enabled")
+        with self._lock:
+            dev, host = self._kv.chain_locations(tokens)
+            k_pages = [np.asarray(self._k_pages[:, pid]) for pid in dev]
+            v_pages = [np.asarray(self._v_pages[:, pid]) for pid in dev]
+            for key, slot in host:
+                # keyed copy-read: a concurrent import can LRU-re-key
+                # the slot between lookup and here — a mismatch
+                # truncates the export (contiguity ends at the first
+                # missing chunk) instead of shipping wrong bytes
+                pages = self._tier.arena.read_keyed(slot, key)
+                if pages is None:
+                    break
+                k_pages.append(pages[0])
+                v_pages.append(pages[1])
+        blob = serialize_chain(
+            np.asarray(tokens, np.int64)[:len(k_pages) * self._page],
+            k_pages, v_pages, self._page)
+        self._tier.count_handoff("export", len(blob))
+        return blob
+
+    def import_chain(self, blob: bytes) -> int:
+        """Land a handoff blob's pages in the HOST ARENA (the
+        decode-role side). Control-plane only — no engine lock, no
+        device writes: the next admission of this prompt hits the host
+        tier and the ordinary async fetch uploads the pages behind
+        in-flight decode. Returns the number of pages imported."""
+        from bigdl_tpu.llm.kvtier.handoff import (HandoffError,
+                                                  deserialize_chain)
+        if self._tier is None:
+            raise RuntimeError(
+                "KV handoff needs bigdl.llm.kvtier.enabled")
+        toks, k_pages, v_pages, header = deserialize_chain(blob)
+        if not k_pages:
+            return 0
+        cfg = self.cfg
+        want_shape = (cfg.num_hidden_layers, cfg.num_key_value_heads,
+                      self._page, cfg.head_dim)
+        want_dtype = str(jnp.dtype(self.model.cache_dtype))
+        if int(header["page_size"]) != self._page or \
+                tuple(header["shape"]) != want_shape or \
+                header["dtype"] != want_dtype:
+            raise HandoffError(
+                f"handoff pages {header['shape']}/{header['dtype']}"
+                f"/page={header['page_size']} do not fit this pool "
+                f"{want_shape}/{want_dtype}/page={self._page}")
+        arena = self._tier.arena
+        n = 0
+        for j in range(len(k_pages)):
+            key = tuple(toks[:(j + 1) * self._page])
+            slot = arena.reserve(key)
+            if slot is None:
+                break              # arena saturated: partial import
+            arena.commit(slot, k_pages[j], v_pages[j])
+            n += 1
+        self._tier.count_handoff("import", len(blob))
+        return n
+
     def start(self) -> "LLMServer":
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
@@ -599,6 +720,8 @@ class LLMServer:
                 with self._lock:
                     idle = (self._queue.empty()
                             and getattr(self, "_pending_head", None) is None
+                            and not self._fetch_wait
+                            and not self._fetch_ready
                             and all(r is None for r in self._slots))
                 if idle:
                     break
@@ -623,6 +746,22 @@ class LLMServer:
                 pass
             for args in rec.pop("kv_release", ()):
                 self._kv.release_slot(*args)
+        # fetch-parked admissions hold budget but no slot: with
+        # drain=True the loop already landed them all (the idle check
+        # above includes both lists), so anything left here is a
+        # drain=False abandonment — return the grants, unblock clients
+        for ent in self._fetch_wait:
+            self._kv.cancel(ent["adm"])
+            ent["req"].error = "server stopped before its KV fetch landed"
+            ent["req"].done.set()
+        self._fetch_wait = []
+        for req, adm in self._fetch_ready:
+            self._kv.cancel(adm)
+            req.error = "server stopped before the request took a slot"
+            req.done.set()
+        self._fetch_ready = []
+        if self._tier is not None:
+            self._tier.close()
         if self._pending_release:
             # bookkeeping scatters enqueued AFTER the newest step have
             # no later fence — bound them via their own outputs (the
@@ -647,24 +786,108 @@ class LLMServer:
         released buffer can be recycled for concurrent jax work while
         the enqueued computation still reads it)."""
         self._pending_release.extend(arrays)
+    def _read_page_kv(self, pid: int):
+        """Spill-side gather (ISSUE 6): one page's K/V as standalone
+        device arrays. Engine thread only — the gather is dispatched
+        before any later dispatch can reissue and overwrite the page
+        id, so engine-thread program order is the lifetime argument
+        (the same one the partial prefill's tail gather relies on)."""
+        return self._k_pages[:, pid], self._v_pages[:, pid]
+
+    def _write_pages_kv(self, pids, k_devs, v_devs):
+        """Fetch-side scatter (ISSUE 6): land uploaded host-tier pages
+        in the pool. Incremental — same pin/barrier contract as the
+        prefill scatters."""
+        idx = jnp.asarray(np.asarray(pids, np.int32))
+        k_new = jnp.stack(k_devs, axis=1).astype(self._k_pages.dtype)
+        v_new = jnp.stack(v_devs, axis=1).astype(self._v_pages.dtype)
+        self._pin(self._k_pages, self._v_pages, k_new, v_new, idx)
+        self._k_pages = self._k_pages.at[:, idx].set(k_new)
+        self._v_pages = self._v_pages.at[:, idx].set(v_new)
+        if self.pipeline_depth == 1:
+            _sync_barrier(self._k_pages, self._v_pages)
+            self._pending_release.clear()
+
+    def _poll_fetches(self):
+        """Land completed host-tier fetches (ISSUE 6): a finished
+        upload is scattered into the pool (the admission then looks
+        exactly like a device prefix hit); a failed, cancelled or
+        timed-out one degrades to a plain cache miss. An injected
+        ``kvcache.evict`` raise during materialization leaves the entry
+        parked — the resilient engine loop retries the pass."""
+        timeout = self._tier.fetch_timeout
+        k = 0
+        while k < len(self._fetch_wait):
+            ent = self._fetch_wait[k]
+            req, adm = ent["req"], ent["adm"]
+            job = adm.fetch_job
+            done = job is None or job.done.is_set()
+            if not done and time.perf_counter() - ent["t0"] <= timeout:
+                k += 1
+                continue
+            if done and job is not None and job.ok \
+                    and not job.cancelled:
+                self._kv.materialize(adm, job.k_dev, job.v_dev)
+            else:
+                self._kv.degrade(adm)   # failure/timeout → plain miss
+            del self._fetch_wait[k]
+            wait_s = time.perf_counter() - ent["t0"]
+            if req.trace:
+                obs.add_complete(
+                    "kvtier/fetch_wait", time.time() - wait_s, wait_s,
+                    trace=req.trace["trace_id"], request=req.id,
+                    pages=len(adm.shared_pages),
+                    degraded=adm.matched_len == adm.device_matched
+                    and job is not None and not job.ok)
+            self._fetch_ready.append((req, adm))
+
     def _admit(self):
         """Fill free slots from the queue; per-slot prefill. Paged mode
         additionally requires the request's worst-case page budget
         (prompt + max_new, the conservative vLLM-style reservation) to be
         available — head-of-line: if the next request doesn't fit, no
-        later one is admitted either."""
+        later one is admitted either. Host-tier hits (ISSUE 6) are
+        PARKED while their pages upload — they hold their budget but no
+        slot, so later requests admit and decode meanwhile; completed
+        fetches re-enter here first."""
+        if self._fetch_wait:
+            self._poll_fetches()
         for i in range(self.max_batch):
             if self._slots[i] is not None:
                 continue
+            if not self._admit_into(i):
+                return
+
+    def _admit_into(self, i: int) -> bool:
+        """Admit one request into free slot ``i``. False stops the slot
+        sweep: queue exhausted, or the head is budget-blocked
+        (head-of-line holds)."""
+        while True:
+            if self._fetch_ready:
+                req, adm = self._fetch_ready[0]
+                # physical headroom for the pages prefill will own,
+                # ensured HERE (not at the poll): the entry ahead in
+                # this very pass may have consumed what the poll saw
+                # free. Peek-then-pop so an injected kvcache.evict
+                # raise leaves the entry for the loop's retry.
+                own = (-(-len(req.prompt_ids) // self._page)
+                       - adm.matched_len // self._page)
+                if own > 0:
+                    self._kv.ensure_free(own)
+                self._fetch_ready.pop(0)
+                self._slot_adm[i] = adm
+                self._prefill_admitted(i, req, adm)
+                return True
             # a budget-blocked head is HELD here (not re-queued: put()
-            # appends, and clients submit concurrently, so drain-and-requeue
-            # would let a late submit overtake the whole waiting line)
+            # appends, and clients submit concurrently, so
+            # drain-and-requeue would let a late submit overtake the
+            # whole waiting line)
             req = getattr(self, "_pending_head", None)
             if req is None:
                 try:
                     req = self._queue.get_nowait()
                 except queue.Empty:
-                    return
+                    return False
             self._pending_head = None
             adm = None
             if self.paged:
@@ -696,46 +919,59 @@ class LLMServer:
                         req.done.set()
                         continue
                     self._pending_head = req   # retry next loop pass
-                    return
-                self._slot_adm[i] = adm
+                    return False
                 if self._kv.enabled:
                     wall = time.perf_counter() - t_lk
                     obs.add_complete(
                         "kvcache/lookup", time.time() - wall, wall,
                         request=req.id, matched_tokens=adm.matched_len,
                         prompt_tokens=len(req.prompt_ids))
-            ctx = rc.from_wire(req.trace)
-            if ctx is not None and req.submitted_at:
-                # engine-side admission wait, parented to the submitter
-                args = ({"parent_span": ctx.span_id}
-                        if ctx.span_id else {})
-                obs.add_complete(
-                    "llm/queue_wait", req.submitted_at,
-                    time.time() - req.submitted_at, trace=ctx.trace_id,
-                    stage="queue", request=req.id, **args)
-            t0 = time.perf_counter()
-            try:
-                with rc.activate(ctx), \
-                        obs.span("llm/prefill", slot=i,
-                                 tokens=len(req.prompt_ids),
-                                 stage="llm_server", request=req.id):
-                    (self._prefill_paged if self.paged
-                     else self._prefill_slot)(i, req)
-            except BaseException as e:
-                # a failing prefill must not leak its admission budget
-                # or adoption refcounts (the resilient _loop would
-                # otherwise shrink the pool forever) nor leave the
-                # client blocked until timeout
-                if self.paged and adm is not None:
-                    self._kv.cancel(adm)
-                    self._slot_adm[i] = None
-                req.error = f"{type(e).__name__}: {e}"
-                req.done.set()
-                raise
-            req.decode_started_at = time.time()
-            suffix = len(req.prompt_ids) - (adm.matched_len if adm
-                                            else 0)
-            self._record_prefill(suffix, time.perf_counter() - t0)
+                if adm.fetch:
+                    # host-tier hit: park until the upload lands; keep
+                    # filling this slot from the queue meanwhile
+                    self._fetch_wait.append(
+                        {"req": req, "adm": adm,
+                         "t0": time.perf_counter()})
+                    continue
+                self._slot_adm[i] = adm
+            self._prefill_admitted(i, req, adm)
+            return True
+
+    def _prefill_admitted(self, i: int, req: Request, adm):
+        """Prefill a request whose cache grant is already held (shared
+        tail of direct and fetch-parked admissions)."""
+        ctx = rc.from_wire(req.trace)
+        if ctx is not None and req.submitted_at:
+            # engine-side admission wait, parented to the submitter
+            args = ({"parent_span": ctx.span_id}
+                    if ctx.span_id else {})
+            obs.add_complete(
+                "llm/queue_wait", req.submitted_at,
+                time.time() - req.submitted_at, trace=ctx.trace_id,
+                stage="queue", request=req.id, **args)
+        t0 = time.perf_counter()
+        try:
+            with rc.activate(ctx), \
+                    obs.span("llm/prefill", slot=i,
+                             tokens=len(req.prompt_ids),
+                             stage="llm_server", request=req.id):
+                (self._prefill_paged if self.paged
+                 else self._prefill_slot)(i, req)
+        except BaseException as e:
+            # a failing prefill must not leak its admission budget
+            # or adoption refcounts (the resilient _loop would
+            # otherwise shrink the pool forever) nor leave the
+            # client blocked until timeout
+            if self.paged and adm is not None:
+                self._kv.cancel(adm)
+                self._slot_adm[i] = None
+            req.error = f"{type(e).__name__}: {e}"
+            req.done.set()
+            raise
+        req.decode_started_at = time.time()
+        suffix = len(req.prompt_ids) - (adm.matched_len if adm
+                                        else 0)
+        self._record_prefill(suffix, time.perf_counter() - t0)
 
     def _instruments(self):
         """None when observability is off; declared on first use so

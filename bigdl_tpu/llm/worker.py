@@ -29,6 +29,24 @@ submit (``OverloadError``) the worker sheds with **503 + Retry-After**
 instead of queueing unboundedly; per-request deadlines propagate via
 ``X-BigDL-Deadline-Ms`` and cap the blocking wait.
 
+Disaggregated serving (ISSUE 6): ``role`` (``bigdl.llm.role``) splits
+workers into **prefill** and **decode** pools with KV handoff through
+the host tier:
+
+- ``POST /worker_prefill``       {"prompt_ids": [...]} → runs the
+  prompt once (one decoded token), exports the KV chain as a
+  base64 handoff blob (prefill role; decode-role workers answer 403)
+- ``POST /worker_import_chain``  {"handoff": "<b64>"} → lands the
+  blob's pages in this worker's host arena (decode role; prefill-role
+  workers answer 403)
+- :class:`LLMRouter` — the thin placement scheduler over both pools:
+  per-backend circuit breakers, 503 + Retry-After shed when no decode
+  backend is admittable, trace-header propagation so
+  ``GET /debug/trace/<id>`` stitches the request across router →
+  prefill worker → decode worker, and graceful degradation (a failed
+  prefill stage routes the request to the decode pool without a blob
+  — it simply prefills itself).
+
 Token-level API by design: tokenization happens client-side (the
 environment ships no tokenizer assets; the reference worker accepts text
 because it bundles the HF tokenizer).
@@ -36,10 +54,12 @@ because it bundles the HF tokenizer).
 
 from __future__ import annotations
 
+import base64
+import http.client
 import json
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
+from typing import List, Optional, Tuple
 
 import numpy as np
 
@@ -48,14 +68,41 @@ from bigdl_tpu import reliability
 from bigdl_tpu.observability import request_context as rc
 from bigdl_tpu.observability import tracing
 
+ROLES = ("", "prefill", "decode")
+
+
+def _send_json(handler, code: int, obj, headers=()):
+    """Shared JSON response for the worker and router handlers: body,
+    custom headers, and the request's trace-id echo (absent in disabled
+    mode). Keep-alive reuses handlers — ``_trace`` is reset at the top
+    of every do_GET/do_POST, so no cross-request leak."""
+    body = json.dumps(obj).encode()
+    handler.send_response(code)
+    handler.send_header("Content-Type", "application/json")
+    for k, v in headers:
+        handler.send_header(k, v)
+    trace_id = getattr(handler, "_trace", None)
+    if trace_id:
+        handler.send_header(rc.TRACE_HEADER, trace_id)
+    handler.send_header("Content-Length", str(len(body)))
+    handler.end_headers()
+    handler.wfile.write(body)
+
 
 class LLMWorker:
     def __init__(self, server, model_name: str = "bigdl-tpu-llm",
                  host: str = "127.0.0.1", port: int = 0,
-                 request_timeout: float = 600.0):
+                 request_timeout: float = 600.0,
+                 role: Optional[str] = None):
+        from bigdl_tpu.utils.conf import conf
         self.server = server
         self.model_name = model_name
         self.request_timeout = request_timeout
+        self.role = (role if role is not None
+                     else conf.get("bigdl.llm.role", "") or "")
+        if self.role not in ROLES:
+            raise ValueError(f"bigdl.llm.role must be one of {ROLES}, "
+                             f"got {self.role!r}")
         self._t0 = time.time()
         self._tokens_out = 0
         worker = self
@@ -67,20 +114,7 @@ class LLMWorker:
                 pass
 
             def _json(self, code: int, obj, headers=()):
-                body = json.dumps(obj).encode()
-                self.send_response(code)
-                self.send_header("Content-Type", "application/json")
-                for k, v in headers:
-                    self.send_header(k, v)
-                # echo the request's trace id (absent in disabled mode).
-                # keep-alive reuses this handler: _trace is reset at the
-                # top of every do_GET/do_POST, so no cross-request leak
-                trace_id = getattr(self, "_trace", None)
-                if trace_id:
-                    self.send_header(rc.TRACE_HEADER, trace_id)
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+                _send_json(self, code, obj, headers)
 
             def _read_req(self):
                 n = int(self.headers.get("Content-Length", 0))
@@ -144,6 +178,7 @@ class LLMWorker:
                     dt = max(time.time() - worker._t0, 1e-9)
                     self._json(200, {
                         "model": worker.model_name,
+                        "role": worker.role,
                         "queue_length": worker.server._queue.qsize(),
                         "steps": worker.server.steps,
                         "speed": round(worker._tokens_out / dt, 2)})
@@ -168,6 +203,7 @@ class LLMWorker:
                         "status": ("ok" if healthy else
                                    "draining" if draining else
                                    "unhealthy"),
+                        "role": worker.role,
                         "engine_alive": alive,
                         "queue_length": worker.server._queue.qsize(),
                         "checks": report})
@@ -178,12 +214,91 @@ class LLMWorker:
                 self._trace = None
                 ctx = None
                 if self.path in ("/worker_generate",
-                                 "/worker_generate_stream"):
+                                 "/worker_generate_stream",
+                                 "/worker_prefill",
+                                 "/worker_import_chain"):
                     # case-insensitive trace extraction (or a fresh
                     # root); None in disabled mode — no headers emitted
                     ctx = rc.server_context(self.headers)
                     if ctx is not None:
                         self._trace = ctx.trace_id
+                # role gating (ISSUE 6): a prefill-pool worker never
+                # decodes full requests, a decode-pool worker never
+                # serves the prefill/export side — misrouted calls are
+                # the router's bug and answer 403, not a silent detour
+                if worker.role == "prefill" and self.path in (
+                        "/worker_generate", "/worker_generate_stream"):
+                    self._json(403, {"error": "prefill-role worker: "
+                                     "use /worker_prefill"})
+                    return
+                if worker.role == "decode" and \
+                        self.path == "/worker_prefill":
+                    self._json(403, {"error": "decode-role worker "
+                                     "does not prefill"})
+                    return
+                if worker.role == "prefill" and \
+                        self.path == "/worker_import_chain":
+                    self._json(403, {"error": "prefill-role worker "
+                                     "does not import chains"})
+                    return
+                if self.path == "/worker_prefill":
+                    # run the prompt once (one decoded token pins the
+                    # chain in the index), then export its KV pages as
+                    # the handoff blob (ISSUE 6 disaggregation)
+                    try:
+                        ids, _ = self._read_req()
+                    except Exception as e:  # noqa: BLE001
+                        self._json(400, {"error": f"bad request: {e}"})
+                        return
+                    with rc.activate(ctx), \
+                            obs.span("llm/handoff_export",
+                                     stage="llm_worker",
+                                     tokens=len(ids)):
+                        req = self._submit(ids, 1)
+                        if req is None:
+                            return
+                        try:
+                            toks = req.get(timeout=self._wait_timeout())
+                        except TimeoutError:
+                            self._json(504,
+                                       {"error": "prefill timed out"})
+                            return
+                        except RuntimeError as e:
+                            self._json(500, {"error": str(e)})
+                            return
+                        try:
+                            blob = worker.server.export_chain(ids)
+                        except RuntimeError as e:   # tier disabled
+                            self._json(501, {"error": str(e)})
+                            return
+                    worker._tokens_out += len(toks)
+                    self._json(200, {
+                        "handoff": base64.b64encode(blob).decode(),
+                        "handoff_bytes": len(blob),
+                        "output_ids": list(map(int, toks))})
+                    return
+                if self.path == "/worker_import_chain":
+                    try:
+                        n = int(self.headers.get("Content-Length", 0))
+                        body = json.loads(self.rfile.read(n))
+                        blob = base64.b64decode(body["handoff"])
+                    except Exception as e:  # noqa: BLE001
+                        self._json(400, {"error": f"bad request: {e}"})
+                        return
+                    with rc.activate(ctx), \
+                            obs.span("llm/handoff_import",
+                                     stage="llm_worker",
+                                     bytes=len(blob)):
+                        try:
+                            pages = worker.server.import_chain(blob)
+                        except RuntimeError as e:   # tier disabled
+                            self._json(501, {"error": str(e)})
+                            return
+                        except ValueError as e:     # malformed blob
+                            self._json(422, {"error": str(e)})
+                            return
+                    self._json(200, {"imported_pages": pages})
+                    return
                 if self.path == "/worker_generate":
                     try:
                         ids, mnt = self._read_req()
@@ -280,6 +395,238 @@ class LLMWorker:
         self._thread: Optional[object] = None
 
     def start(self) -> "LLMWorker":
+        import threading
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+def _post_json(addr: Tuple[str, int], path: str, body: dict,
+               headers=(), timeout: float = 600.0):
+    """One JSON POST to a backend worker → (status, parsed body,
+    response trace header). Connection errors raise — the router's
+    breaker accounting wants them loud."""
+    conn = http.client.HTTPConnection(addr[0], addr[1], timeout=timeout)
+    try:
+        payload = json.dumps(body)
+        hdrs = {"Content-Type": "application/json"}
+        for k, v in headers:
+            hdrs[k] = v
+        conn.request("POST", path, payload, hdrs)
+        resp = conn.getresponse()
+        data = resp.read()
+        try:
+            parsed = json.loads(data.decode())
+        except ValueError:
+            parsed = {"error": data.decode(errors="replace")[:200]}
+        return resp.status, parsed, resp.getheader(rc.TRACE_HEADER)
+    finally:
+        conn.close()
+
+
+class LLMRouter:
+    """Thin placement scheduler over disaggregated worker pools
+    (ISSUE 6): prefill-role workers compute prompt KV once, decode-role
+    workers stream tokens, and the request's chain crosses between them
+    as a handoff blob through the host tier.
+
+    ``POST /worker_generate`` routes one request end-to-end:
+
+    1. pick a prefill backend (round-robin over the pool, skipping
+       open circuit breakers) → ``/worker_prefill`` → handoff blob;
+    2. pick a decode backend the same way → ``/worker_import_chain``
+       (best-effort) then ``/worker_generate`` → relay the answer.
+
+    Reused machinery, not re-invented (ISSUE 6 contract): per-backend
+    :class:`~bigdl_tpu.reliability.CircuitBreaker` trips on connection
+    failures/5xx, overload sheds with **503 + Retry-After** through
+    ``reliability.count_shed``, deadlines propagate via
+    ``X-BigDL-Deadline-Ms``, and the trace context rides
+    ``X-BigDL-Trace-Id`` into both backends so ``GET
+    /debug/trace/<id>`` shows the stitched router → prefill → decode
+    waterfall. A failed prefill stage degrades gracefully: the decode
+    backend gets the request without a blob and prefills it itself.
+    """
+
+    def __init__(self, prefill_workers: List[Tuple[str, int]],
+                 decode_workers: List[Tuple[str, int]],
+                 host: str = "127.0.0.1", port: int = 0,
+                 request_timeout: float = 600.0,
+                 breaker_threshold: int = 3,
+                 breaker_reset: float = 10.0):
+        if not decode_workers:
+            raise ValueError("the router needs at least one "
+                             "decode-role backend")
+        self.prefill_workers = [tuple(a) for a in prefill_workers]
+        self.decode_workers = [tuple(a) for a in decode_workers]
+        self.request_timeout = request_timeout
+        self._rr = {"prefill": 0, "decode": 0}
+        self._breakers = {
+            addr: reliability.CircuitBreaker(
+                f"llm_router:{addr[0]}:{addr[1]}",
+                failure_threshold=breaker_threshold,
+                reset_timeout=breaker_reset)
+            for addr in self.prefill_workers + self.decode_workers}
+        self.requests_routed = 0
+        self.handoffs_routed = 0
+        self.prefill_degraded = 0
+        router = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _json(self, code: int, obj, headers=()):
+                _send_json(self, code, obj, headers)
+
+            def do_GET(self):
+                self._trace = None
+                debug = tracing.debug_endpoint(self.path)
+                if debug is not None:
+                    self._json(*debug)
+                elif self.path == "/healthz":
+                    ok, report = reliability.health_report()
+                    states = {f"{a[0]}:{a[1]}": router._breakers[a].state
+                              for a in router._breakers}
+                    decode_up = any(
+                        router._breakers[a].state != "open"
+                        for a in router.decode_workers)
+                    healthy = ok and decode_up
+                    self._json(200 if healthy else 503, {
+                        "status": "ok" if healthy else "unhealthy",
+                        "role": "router",
+                        "backends": states,
+                        "checks": report})
+                elif self.path == "/worker_get_status":
+                    self._json(200, {
+                        "role": "router",
+                        "prefill_workers": len(router.prefill_workers),
+                        "decode_workers": len(router.decode_workers),
+                        "requests_routed": router.requests_routed,
+                        "handoffs_routed": router.handoffs_routed,
+                        "prefill_degraded": router.prefill_degraded})
+                else:
+                    self._json(404, {"error": "unknown path"})
+
+            def do_POST(self):
+                self._trace = None
+                if self.path != "/worker_generate":
+                    self._json(404, {"error": "unknown path"})
+                    return
+                ctx = rc.server_context(self.headers)
+                if ctx is not None:
+                    self._trace = ctx.trace_id
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    body = json.loads(self.rfile.read(n))
+                    body["prompt_ids"] = [int(t)
+                                          for t in body["prompt_ids"]]
+                except Exception as e:  # noqa: BLE001
+                    self._json(400, {"error": f"bad request: {e}"})
+                    return
+                fwd = list(rc.to_headers(ctx))
+                deadline = self.headers.get(reliability.DEADLINE_HEADER)
+                if deadline:
+                    fwd.append((reliability.DEADLINE_HEADER, deadline))
+                with rc.activate(ctx), \
+                        obs.span("llm/route", stage="llm_router",
+                                 tokens=len(body["prompt_ids"])):
+                    router._route(self, body, fwd)
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.address = self._httpd.server_address
+        self._thread = None
+
+    # -- placement -----------------------------------------------------------
+    def _pick(self, kind: str) -> Optional[Tuple[str, int]]:
+        """Round-robin over the pool, skipping open breakers (the
+        half-open probe slot is granted like any call)."""
+        pool = (self.prefill_workers if kind == "prefill"
+                else self.decode_workers)
+        for off in range(len(pool)):
+            addr = pool[(self._rr[kind] + off) % len(pool)]
+            if self._breakers[addr].allow():
+                self._rr[kind] = (self._rr[kind] + off + 1) % len(pool)
+                return addr
+        return None
+
+    def _call(self, addr, path, body, headers):
+        """Backend call under its breaker; raises on transport errors
+        and 5xx so the breaker sees them. A 503 shed is NOT a failure:
+        the backend is alive and applying backpressure — it is relayed
+        to the caller (with Retry-After) instead of tripping the
+        breaker, else transient overload on a healthy worker would
+        escalate to the whole backend being circuit-broken out."""
+        breaker = self._breakers[addr]
+        try:
+            status, parsed, trace = _post_json(
+                addr, path, body, headers, self.request_timeout)
+        except Exception:
+            breaker.record_failure()
+            raise
+        if status >= 500 and status != 503:
+            breaker.record_failure()
+            raise RuntimeError(
+                f"{addr[0]}:{addr[1]}{path} answered {status}: "
+                f"{parsed.get('error', '')}")
+        breaker.record_success()
+        return status, parsed
+
+    def _route(self, handler, body, fwd_headers):
+        prompt_ids = body["prompt_ids"]
+        # stage 1: prefill + export (optional — losing it only costs
+        # the decode worker a full prefill)
+        handoff = None
+        addr = self._pick("prefill")
+        if addr is not None:
+            try:
+                status, parsed = self._call(
+                    addr, "/worker_prefill",
+                    {"prompt_ids": prompt_ids}, fwd_headers)
+                if status == 200:
+                    handoff = parsed.get("handoff")
+            except Exception:
+                pass
+        if handoff is None and self.prefill_workers:
+            self.prefill_degraded += 1
+        # stage 2: import + decode
+        addr = self._pick("decode")
+        if addr is None:
+            reliability.count_shed("llm_router")
+            handler._json(503, {"error": "no decode backend available "
+                                "(breakers open)"},
+                          headers=(("Retry-After", "1"),))
+            return
+        try:
+            if handoff:
+                try:
+                    self._call(addr, "/worker_import_chain",
+                               {"handoff": handoff}, fwd_headers)
+                    self.handoffs_routed += 1
+                except Exception:
+                    pass   # decode still works, just re-prefills
+            status, parsed = self._call(addr, "/worker_generate", body,
+                                        fwd_headers)
+        except Exception as e:  # noqa: BLE001
+            handler._json(502, {"error": f"decode backend failed: {e}"})
+            return
+        if status == 503:
+            reliability.count_shed("llm_router")
+            handler._json(503, parsed,
+                          headers=(("Retry-After", "1"),))
+            return
+        self.requests_routed += 1
+        handler._json(status, parsed)
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "LLMRouter":
         import threading
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         daemon=True)

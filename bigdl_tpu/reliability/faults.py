@@ -48,6 +48,8 @@ SITES = (
     "llm.submit",                  # LLMServer request admission
     "llm.step",                    # LLM engine decode step
     "kvcache.evict",               # prefix-cache LRU eviction (ISSUE 5)
+    "kvtier.spill",                # HBM->host page spill (ISSUE 6)
+    "kvtier.fetch",                # host->HBM page fetch (ISSUE 6)
 )
 
 

@@ -53,6 +53,16 @@ _DEFAULTS: Dict[str, str] = {
     # prefix-aware KV cache (ISSUE 5): radix-indexed page reuse with
     # refcounts + COW. false = the pre-kvcache engine exactly
     "bigdl.llm.kvcache.enabled": "false",
+    # tiered KV cache (ISSUE 6): evicted chains spill to a pinned
+    # host-RAM arena with async HBM<->host migration. Requires the
+    # prefix cache; false = structurally absent (PR 5 engine exactly)
+    "bigdl.llm.kvtier.enabled": "false",
+    "bigdl.llm.kvtier.host_pages": "0",       # 0 = auto (4x device pool)
+    "bigdl.llm.kvtier.fetch.timeout": "30.0", # stuck fetch -> plain miss
+    "bigdl.llm.kvtier.sync": "false",         # inline migration (tests)
+    # disaggregated serving (ISSUE 6): "" unified, "prefill" or
+    # "decode" restricts an LLMWorker to one side of the KV handoff
+    "bigdl.llm.role": "",
     "bigdl.train.prefetch": "true",           # stage batch N+1 during N
     "bigdl.train.prefetch.depth": "2",        # staged batches held ahead
 }

@@ -42,6 +42,10 @@ def pytest_configure(config):
         "markers",
         "kvcache: prefix-aware KV-cache subsystem tests (pool/radix "
         "units + engine parity; select with -m kvcache)")
+    config.addinivalue_line(
+        "markers",
+        "kvtier: tiered KV-cache tests (host arena / migration / "
+        "handoff units + spill-reload parity; select with -m kvtier)")
 
 
 @pytest.fixture(scope="session")

@@ -272,6 +272,46 @@ class TestEngineParity:
                                           err_msg=f"request {j}")
         assert srv._kv.evictions > 0           # pressure actually hit
 
+    def test_eviction_hammer_with_host_tier(self, model):
+        """ISSUE 6: the hammer workload again, but with the host tier
+        armed — evicted chains spill instead of dropping, re-requests
+        re-adopt them FROM THE ARENA (fetches > 0), outputs stay exact,
+        and the pool's refcount/pin/budget ledger survives the
+        migrations. (Depth 1/2 spill-reload parity lives in
+        tests/test_kvtier.py; this ties the tier into the kvcache
+        suite's own acceptance matrix.)"""
+        from bigdl_tpu.utils.conf import conf
+        rs = np.random.RandomState(23)
+        groups = [rs.randint(0, 250, 16).astype(np.int32)
+                  for _ in range(4)]
+        prompts = [np.concatenate(
+            [groups[j % 4], rs.randint(0, 250, 1 + j % 4)
+             .astype(np.int32)]) for j in range(8)]
+        lens = [int(rs.randint(1, 5)) for _ in prompts]
+        want = [_generate(model, p, n) for p, n in zip(prompts, lens)]
+        conf.set("bigdl.llm.kvtier.sync", "true")
+        try:
+            srv = LLMServer(model, max_batch=2, max_seq_len=64,
+                            page_size=PAGE, num_pages=9, kvcache=True,
+                            kvtier=True, host_pages=32).start()
+            try:
+                got = [r.get(timeout=600) for r in
+                       [srv.submit(p, max_new_tokens=n)
+                        for p, n in zip(prompts, lens)]]
+                spills, fetches = srv._tier.spills, srv._tier.fetches
+                st = srv._kv.debug_stats()
+            finally:
+                srv.stop()
+        finally:
+            conf.unset("bigdl.llm.kvtier.sync")
+        for j, (g, w) in enumerate(zip(got, want)):
+            np.testing.assert_array_equal(np.asarray(g), w,
+                                          err_msg=f"request {j}")
+        assert spills > 0 and fetches > 0
+        assert st["pages_pinned"] == 0
+        assert st["budget_avail"] == 9 - 1
+        assert st["tier"]["pinned"] == 0
+
     @pytest.mark.parametrize("family", ["gptneox", "starcoder"])
     def test_non_llama_families_share_prefixes(self, family):
         """Every paged family has a partial-prefill entry point: the

@@ -152,6 +152,19 @@ def _flatten_full(rec: dict) -> Dict[str, float]:
         val = _extra_field(mb.get(mode), "ttft_ms")
         if val is not None:
             flat[f"prefix_{mode}.ttft_ms"] = val
+    # ISSUE 6: the tier microbench's replay pair + the savings number —
+    # a tier that silently stops fetching would show up as
+    # tier_tokens_saved collapsing toward zero between rounds
+    tb = (((rec.get("extra") or {}).get("telemetry") or {})
+          .get("microbench_tier") or {})
+    for mode in ("tier_off", "tier_on"):
+        val = _extra_field(tb.get(mode), "ttft_ms")
+        if val is not None:
+            flat[f"{mode}.ttft_ms"] = val
+    for field in ("prefill_tokens_saved_vs_off", "ttft_speedup"):
+        val = tb.get(field)
+        if isinstance(val, (int, float)) and not isinstance(val, bool):
+            flat[f"tier.{field}"] = float(val)
     return flat
 
 

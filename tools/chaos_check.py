@@ -182,6 +182,92 @@ def run_kvcache_chaos(seed: int = 0, n_requests: int = 6,
     return out
 
 
+def run_kvtier_chaos(seed: int = 0, n_groups: int = 4,
+                     fetch_raises: int = 2, spill_raises: int = 1) -> dict:
+    """ISSUE 6 satellite: drive spill→reload traffic through the host
+    tier with seeded ``kvtier.spill``/``kvtier.fetch`` faults armed —
+    delays on every migration to widen the async windows, plus raises
+    on both directions — and assert greedy outputs are token-identical
+    to the clean tier-on run. The contract under failure: a failed
+    spill is a plain eviction, a failed fetch a plain cache miss —
+    never a stall, a crash, or a different token."""
+    import numpy as np
+
+    from bigdl_tpu import reliability as rel
+    from bigdl_tpu.llm.models.llama import LlamaConfig, LlamaForCausalLM
+    from bigdl_tpu.llm.serving import LLMServer
+
+    model = LlamaForCausalLM.from_config(LlamaConfig.tiny(), seed=0,
+                                         max_cache_len=128)
+    rs = np.random.RandomState(seed)
+    groups = [rs.randint(0, 250, 16).astype(np.int32)
+              for _ in range(n_groups)]
+    prompts = []
+    for rnd in range(2):          # two passes: seed chains, then reload
+        for g in range(n_groups):
+            prompts.append(np.concatenate(
+                [groups[g], rs.randint(0, 250, 2 + (rnd + g) % 3)
+                 .astype(np.int32)]))
+
+    def serve_all():
+        # pool fits ~2 of the 4 chains -> pass 2 must hit the arena
+        srv = LLMServer(model, max_batch=2, max_seq_len=64, page_size=8,
+                        num_pages=9, kvcache=True, kvtier=True,
+                        host_pages=32).start()
+        try:
+            got = [list(map(int,
+                            srv.submit(p, max_new_tokens=4)
+                            .get(timeout=300)))
+                   for p in prompts]
+            return got, srv._tier.spills, srv._tier.fetches
+        finally:
+            srv.stop()
+
+    was_enabled = rel.enabled()
+    if not was_enabled:
+        rel.enable()
+    try:
+        clean, clean_spills, clean_fetches = serve_all()
+        plan = rel.FaultPlan(seed=seed)
+        # first-match-wins: bounded raises first, unbounded delays mop
+        # up every other migration
+        plan.add("kvtier.fetch", "raise", times=fetch_raises, after=0)
+        plan.add("kvtier.spill", "raise", times=spill_raises, after=1)
+        plan.add("kvtier.*", "delay", times=None, delay=0.003)
+        rel.set_plan(plan)
+        try:
+            injected, inj_spills, inj_fetches = serve_all()
+        finally:
+            rel.set_plan(None)
+    finally:
+        if not was_enabled:
+            rel.disable()
+
+    match = injected == clean
+    out = {
+        "seed": seed,
+        "requests": len(prompts),
+        "clean_spills": clean_spills,
+        "clean_fetches": clean_fetches,
+        "injected_spills": inj_spills,
+        "injected_fetches": inj_fetches,
+        "events_fired": [f"{s}:{a}" for s, a in plan.fired],
+        "match": match,
+    }
+    if clean_fetches == 0:
+        raise AssertionError(
+            "kvtier chaos: the clean run never fetched from the host "
+            "arena — the pool is not under pressure; shrink it")
+    if not any(s.startswith("kvtier.") for s, _ in plan.fired):
+        raise AssertionError(
+            "kvtier chaos armed but no kvtier fault fired")
+    if not match:
+        raise AssertionError(
+            f"kvtier chaos divergence under migration faults "
+            f"(fired: {out['events_fired']}): {clean} vs {injected}")
+    return out
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--seed", type=int, default=0)
@@ -191,6 +277,10 @@ def main():
     ap.add_argument("--kvcache", action="store_true",
                     help="run the kvcache.evict eviction-race pass "
                          "instead of the training chaos run (ISSUE 5)")
+    ap.add_argument("--kvtier", action="store_true",
+                    help="run the host-tier migration-fault pass: "
+                         "delayed/failed spills and fetches must keep "
+                         "greedy outputs identical (ISSUE 6)")
     ap.add_argument("--cpu", action="store_true",
                     help="force the CPU backend (sitecustomize pins the "
                          "axon TPU platform; env vars are ineffective)")
@@ -198,7 +288,9 @@ def main():
     if args.cpu:
         import jax
         jax.config.update("jax_platforms", "cpu")
-    if args.kvcache:
+    if args.kvtier:
+        out = run_kvtier_chaos(seed=args.seed)
+    elif args.kvcache:
         out = run_kvcache_chaos(seed=args.seed)
     else:
         out = run_chaos(seed=args.seed, events=args.events,

@@ -1,0 +1,169 @@
+#!/usr/bin/env python
+"""Tiered-KV microbench (ISSUE 6 satellite): replay a shared-prefix
+working set sized PAST the HBM page pool, tier off vs on.
+
+The workload prefix caching alone cannot save: ``n_groups`` distinct
+system prompts whose chains together exceed the device pool, served in
+two passes. Pass 1 seeds every chain (later groups LRU-evict earlier
+ones); pass 2 re-requests each group with a fresh user tail. With the
+tier **off** the evicted chains are gone — pass 2 re-prefills them.
+With it **on** they spilled to the host arena and pass 2 admissions
+fetch them back asynchronously. What it reports per mode:
+
+- ``ttft_ms`` / ``ttft_p50_ms`` on the replay pass (the always-on
+  ``Request.t_submit``/``t_first_token`` stamps);
+- ``prefill_tokens`` on the replay pass — the compute the tier deleted;
+- ``hit_rate`` (admission hits / requests) on the replay pass;
+- tier on only: ``spills``/``fetches``/``fetch_failures`` and
+  ``prefill_tokens_saved`` (must be > 0 for the tier to have mattered —
+  the acceptance assertion rides these numbers).
+
+Wired into ``bench.py``'s telemetry block (``telemetry.
+microbench_tier``) and the compact northstar line (``kvtier``);
+``tools/bench_regress.py`` diffs the ``ttft_ms`` pair across rounds.
+Standalone:
+
+    python tools/microbench_tier.py                  # tiny model
+    python tools/microbench_tier.py --groups 8 --shared-len 64 --json
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import Dict, Optional
+
+# runnable both as `python tools/microbench_tier.py` and as an import
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def run_tier_bench(n_groups: int = 5, shared_len: int = 32,
+                   tail_len: int = 4, new_tokens: int = 3,
+                   page_size: int = 8, pipeline_depth: int = 2,
+                   model=None) -> Dict:
+    """Two-pass shared-prefix replay over a pool sized for ~2 of the
+    ``n_groups`` chains, tier off vs on. One untimed warmup request per
+    mode absorbs the compile cost of each prefill bucket."""
+    import numpy as np
+
+    from bigdl_tpu.llm.models.llama import LlamaConfig, LlamaForCausalLM
+    from bigdl_tpu.llm.serving import LLMServer
+
+    if model is None:
+        model = LlamaForCausalLM.from_config(LlamaConfig.tiny(), seed=0,
+                                             max_cache_len=256)
+    rs = np.random.RandomState(0)
+    vocab = model.config.vocab_size
+    groups = [rs.randint(0, vocab, shared_len).astype(np.int32)
+              for _ in range(n_groups)]
+
+    def prompt(g, j):
+        return np.concatenate([groups[g],
+                               rs.randint(0, vocab, tail_len)
+                               .astype(np.int32)])
+
+    max_seq = min(shared_len + tail_len + new_tokens + 2,
+                  model.config.max_position_embeddings)
+    per_chain = -(-(shared_len + tail_len + new_tokens) // page_size)
+    # the crux: room for ~2 chains, so n_groups > 2 forces eviction —
+    # the tier-on run must spill instead of dropping
+    num_pages = 1 + 2 * per_chain + 2
+    out: Dict = {"groups": n_groups, "shared_len": shared_len,
+                 "tail_len": tail_len, "new_tokens": new_tokens,
+                 "page_size": page_size, "num_pages": num_pages}
+    for mode, key in ((False, "tier_off"), (True, "tier_on")):
+        srv = LLMServer(model, max_batch=2, max_seq_len=max_seq,
+                        page_size=page_size, num_pages=num_pages,
+                        kvcache=True, kvtier=mode,
+                        host_pages=4 * num_pages if mode else None,
+                        pipeline_depth=pipeline_depth).start()
+        try:
+            # warmup compiles every bucket both passes will touch
+            srv.submit(prompt(0, -1),
+                       max_new_tokens=new_tokens).get(timeout=600)
+            # pass 1: seed every group's chain (evictions happen here)
+            for g in range(n_groups):
+                srv.submit(prompt(g, 0),
+                           max_new_tokens=new_tokens).get(timeout=600)
+            if mode:
+                # let in-flight spills land, then run ONE untimed
+                # fetch-path replay: the partial-prefill bucket a
+                # host-tier hit compiles (suffix length × fetched-page
+                # count) first appears here, and the timed pass must
+                # not carry that compile
+                srv._tier.migrator.drain()
+                srv.submit(prompt(0, -2),
+                           max_new_tokens=new_tokens).get(timeout=600)
+                srv._tier.migrator.drain()
+            tokens0 = srv.prefill_tokens_total
+            hits0 = srv._kv.hits
+            saved0 = srv.prefix_tokens_saved
+            # pass 2: replay each group with a fresh tail
+            ttfts = []
+            for g in range(n_groups):
+                req = srv.submit(prompt(g, 1),
+                                 max_new_tokens=new_tokens)
+                req.get(timeout=600)
+                ttfts.append((req.t_first_token - req.t_submit) * 1e3)
+            d = {
+                "ttft_ms": round(float(np.mean(ttfts)), 3),
+                "ttft_p50_ms": round(float(np.median(ttfts)), 3),
+                "prefill_tokens": srv.prefill_tokens_total - tokens0,
+                "hit_rate": round((srv._kv.hits - hits0) / n_groups, 3),
+                "evictions": srv._kv.evictions,
+            }
+            if mode:
+                d["spills"] = srv._tier.spills
+                d["fetches"] = srv._tier.fetches
+                d["fetch_failures"] = srv._tier.fetch_failures
+                d["host_pages_used"] = srv._tier.arena.used()
+                out["prefill_tokens_saved"] = (srv.prefix_tokens_saved
+                                               - saved0)
+            out[key] = d
+        finally:
+            srv.stop()
+    off, on = out["tier_off"], out["tier_on"]
+    out["prefill_tokens_saved_vs_off"] = (off["prefill_tokens"]
+                                          - on["prefill_tokens"])
+    if on["ttft_ms"]:
+        out["ttft_speedup"] = round(off["ttft_ms"] / on["ttft_ms"], 3)
+    return out
+
+
+def main(argv) -> int:
+    def flag(name: str, default: Optional[str] = None):
+        if name in argv:
+            return argv[argv.index(name) + 1]
+        return default
+
+    out = run_tier_bench(
+        n_groups=int(flag("--groups", "5")),
+        shared_len=int(flag("--shared-len", "32")),
+        tail_len=int(flag("--tail-len", "4")),
+        new_tokens=int(flag("--new-tokens", "3")),
+        page_size=int(flag("--page-size", "8")),
+        pipeline_depth=int(flag("--depth", "2")))
+    if "--json" in argv:
+        print(json.dumps(out))
+        return 0
+    print(f"tier microbench: {out['groups']} groups, shared "
+          f"{out['shared_len']} + tail {out['tail_len']} tokens, "
+          f"pool {out['num_pages']} pages")
+    for key in ("tier_off", "tier_on"):
+        d = out[key]
+        extra = (f"  spills={d['spills']} fetches={d['fetches']}"
+                 if "spills" in d else "")
+        print(f"  {key:<9} ttft={d['ttft_ms']:>8.3f} ms  "
+              f"(p50 {d['ttft_p50_ms']:.3f})  "
+              f"prefill_tokens={d['prefill_tokens']}  "
+              f"hit_rate={d['hit_rate']}{extra}")
+    print(f"  prefill tokens saved vs off: "
+          f"{out['prefill_tokens_saved_vs_off']}  "
+          f"ttft speedup: {out.get('ttft_speedup', 'n/a')}x")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
