@@ -917,10 +917,15 @@ def _telemetry_block() -> dict:
         "compiles": obs.compile_stats(),
     }
     try:
-        from tools.chaos_check import run_chaos
-        out["chaos_smoke"] = run_chaos(seed=0, events=3, smoke=True)
+        # ISSUE 7 satellite: every chaos suite in one block — train
+        # recovery, kvcache eviction races, kvtier migration faults,
+        # and the router kill-storm (zero lost requests, bit-identical
+        # resume). One record per pass; a failing pass lands as an
+        # error entry without hiding the others.
+        from tools.chaos_check import run_all_chaos
+        out["chaos_all"] = run_all_chaos(seed=0)
     except Exception as e:  # never lose the telemetry to the chaos run
-        out["chaos_smoke"] = {"error": repr(e)}
+        out["chaos_all"] = {"error": repr(e)}
     try:
         # ISSUE 4: live-engine decode latency across pipeline depths —
         # the host-overlap win (and its host/stall attribution) lands in

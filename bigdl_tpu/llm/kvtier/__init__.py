@@ -138,6 +138,16 @@ class KVTier:
             ins["handoffs"].labels(direction=direction).inc()
             ins["handoff_bytes"].inc(nbytes)
 
+    def cancel_fetch(self, job: Optional[MigrationJob]):
+        """Flag an in-flight fetch cancelled from OUTSIDE the engine
+        thread (ISSUE 7: the watchdog aborts parked fetches while the
+        engine is wedged). Flag-only by design — the migration worker
+        still resolves its arena pins, and the engine's next
+        ``_poll_fetches`` pass degrades the admission to a plain miss
+        under its own lock, so no budget bookkeeping happens here."""
+        if job is not None:
+            job.cancelled = True
+
     # -- introspection -------------------------------------------------------
     def debug_stats(self) -> Dict[str, Any]:
         """The ``tier`` block of ``GET /debug/kvcache``."""

@@ -261,6 +261,11 @@ class Request:
         self.tokens: List[int] = []
         self.error: Optional[str] = None
         self.done = threading.Event()
+        # cooperative cancellation (ISSUE 7): set by LLMServer.abort
+        # (hedge loser, client gone) or the watchdog (stalled engine) —
+        # the engine finishes the slot at its next drain instead of
+        # decoding tokens nobody will read
+        self.cancel_requested = False
         # distributed tracing (ISSUE 3): the submitter's ambient context
         # rides the handle into the engine thread (contextvars don't
         # cross threads); None when no trace / observability disabled
@@ -371,7 +376,8 @@ class LLMServer:
                  sample_seed: int = 0,
                  kvcache: Optional[bool] = None,
                  kvtier: Optional[bool] = None,
-                 host_pages: Optional[int] = None):
+                 host_pages: Optional[int] = None,
+                 watchdog_timeout: Optional[float] = None):
         import inspect
 
         from bigdl_tpu.llm.models.llama import forward, init_cache
@@ -472,6 +478,20 @@ class LLMServer:
         self._thread: Optional[threading.Thread] = None
         self.steps = 0
         self._ins = None     # declared lazily: see _instruments()
+        # engine watchdog (ISSUE 7): a device step stalled past the
+        # timeout flips /healthz to 503, aborts parked fetches and
+        # fails pending requests retriably instead of hanging clients
+        # forever. 0/None = structurally absent: no monitor thread, no
+        # watchdog series, no healthz key.
+        wd = (watchdog_timeout if watchdog_timeout is not None else
+              conf.get_float("bigdl.llm.watchdog.step_timeout", 0.0))
+        self.watchdog_timeout = float(wd or 0.0)
+        self.watchdog_enabled = self.watchdog_timeout > 0.0
+        self.watchdog_tripped = False
+        self.watchdog_trips = 0
+        self._hb = time.monotonic()
+        self._watchdog_stop = threading.Event()
+        self._watchdog_thread: Optional[threading.Thread] = None
 
         if paged:
             from bigdl_tpu.llm.kernels.paged_attention import LANE
@@ -610,6 +630,18 @@ class LLMServer:
             reliability.count_shed("llm_server")
             raise reliability.OverloadError(
                 "server is draining: not accepting new requests")
+        if self.watchdog_enabled and self.watchdog_tripped \
+                and time.monotonic() - self._hb > self.watchdog_timeout:
+            # the engine is wedged mid-pass RIGHT NOW (tripped flag AND
+            # a currently-stale heartbeat — the flag alone lags
+            # recovery by up to one monitor tick): anything queued
+            # would just hang behind the stalled step until the stream
+            # wait times out. Fail fast with the same retriable verdict
+            # the trip sweep gives — the stream's terminal chunk
+            # carries error+retriable, so a failover router resumes
+            # elsewhere (and the prober is already draining us).
+            self._watchdog_fail(req, self._watchdog_msg())
+            return req
         try:
             self._queue.put_nowait(req)
         except queue.Full:
@@ -703,9 +735,118 @@ class LLMServer:
         self._tier.count_handoff("import", len(blob))
         return n
 
+    def abort(self, req: Request, reason: str = "aborted by caller"):
+        """Cooperatively cancel an accepted request (ISSUE 7): the
+        hedge loser whose client hung up, or a request nobody will
+        read. Thread-safe flag-only — the engine thread finishes the
+        slot (releasing its pages through the normal refcounted path)
+        at its next drain, and admission skips it if it was still
+        queued or fetch-parked."""
+        req.cancel_requested = True
+        if not req.done.is_set():
+            req.error = req.error or f"request aborted: {reason}"
+            req.done.set()
+        # no metric here: the engine counts the reaped slot as
+        # requests{reason="cancelled"} at its next drain — an inc on
+        # both sides would double-count every hedge loser
+
+    # -- watchdog (ISSUE 7) --------------------------------------------------
+    def _watchdog_loop(self):
+        """Step-deadline monitor. The engine loop refreshes ``_hb`` at
+        the top of every pass (an idle loop spins every ~2 ms), so a
+        stale heartbeat means the engine thread is wedged INSIDE a pass
+        — a hung device step, a stuck fetch. Trip: mark unhealthy (the
+        worker's /healthz answers 503 and the router's prober drains
+        us), abort parked fetches, fail every pending request with a
+        retriable error. Recovery: the heartbeat resuming clears the
+        tripped flag, and /healthz flips back so the prober re-admits
+        this worker.
+
+        An XLA compile is indistinguishable from a hung step from the
+        host side, so ``step_timeout`` must sit ABOVE the worst-case
+        compile for the served shapes (or the engine warmed first) —
+        a cold-start compile longer than the timeout trips exactly
+        like a wedged device. The failed requests are retriable
+        either way; the cost of a false trip is a failover, not a
+        lost answer."""
+        interval = min(max(self.watchdog_timeout / 4.0, 0.01), 0.25)
+        while not self._watchdog_stop.wait(interval):
+            age = time.monotonic() - self._hb
+            if age <= self.watchdog_timeout:
+                if self.watchdog_tripped:
+                    self.watchdog_tripped = False   # engine recovered
+                continue
+            if self.watchdog_tripped:
+                # still wedged: keep sweeping — a request that raced
+                # past the submit() gate into the queue after the trip
+                # sweep must not hang behind the stalled pass (trip
+                # counters fire once per episode, the sweep every tick)
+                self._watchdog_sweep(self._watchdog_msg())
+                continue
+            self._watchdog_trip(age)
+
+    def _watchdog_msg(self) -> str:
+        return (f"engine stalled: step exceeded the "
+                f"{self.watchdog_timeout:g}s watchdog timeout "
+                "(retriable: resubmit to another backend)")
+
+    def _watchdog_trip(self, age: float):
+        self.watchdog_tripped = True
+        self.watchdog_trips += 1
+        failed = self._watchdog_sweep(self._watchdog_msg())
+        if obs.enabled():
+            obs.counter(
+                "bigdl_llm_watchdog_trips_total",
+                "Engine stalls detected by the step-deadline "
+                "watchdog").inc()
+            obs.add_complete("llm/watchdog_trip", time.time() - age, age,
+                             stage="llm_server", failed_requests=failed,
+                             timeout_s=self.watchdog_timeout)
+
+    def _watchdog_sweep(self, msg: str) -> int:
+        failed = 0
+        # the engine thread is wedged (possibly holding _lock), so only
+        # thread-safe surfaces are touched: the queue, Request handles,
+        # and migration-job cancel flags. Page/budget bookkeeping stays
+        # with the engine thread — it cleans up when (if) it wakes.
+        try:
+            while True:
+                failed += self._watchdog_fail(self._queue.get_nowait(),
+                                              msg)
+        except queue.Empty:
+            pass
+        head = getattr(self, "_pending_head", None)
+        if head is not None:
+            failed += self._watchdog_fail(head, msg)
+        for req in list(self._slots):
+            if req is not None:
+                failed += self._watchdog_fail(req, msg)
+        for ent in list(self._fetch_wait):
+            failed += self._watchdog_fail(ent["req"], msg)
+            if self._tier is not None:
+                self._tier.cancel_fetch(ent["adm"].fetch_job)
+        for req, _adm in list(self._fetch_ready):
+            failed += self._watchdog_fail(req, msg)
+        return failed
+
+    @staticmethod
+    def _watchdog_fail(req: Request, msg: str) -> int:
+        req.cancel_requested = True
+        if req.done.is_set():
+            return 0
+        req.error = msg
+        req.done.set()
+        return 1
+
     def start(self) -> "LLMServer":
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
+        if self.watchdog_enabled:
+            self._hb = time.monotonic()
+            self._watchdog_thread = threading.Thread(
+                target=self._watchdog_loop, name="bigdl-llm-watchdog",
+                daemon=True)
+            self._watchdog_thread.start()
         return self
 
     def stop(self, drain: bool = True, timeout: float = 30.0):
@@ -727,6 +868,9 @@ class LLMServer:
                     break
                 time.sleep(0.005)
         self._stop.set()
+        if self._watchdog_thread is not None:
+            self._watchdog_stop.set()
+            self._watchdog_thread.join(timeout=5)
         if self._thread:
             self._thread.join(timeout=30)
         if self._thread is not None and self._thread.is_alive():
@@ -865,6 +1009,12 @@ class LLMServer:
         while True:
             if self._fetch_ready:
                 req, adm = self._fetch_ready[0]
+                if req.done.is_set():
+                    # aborted / watchdog-failed while fetch-parked: the
+                    # grant goes back, nobody decodes for a dead handle
+                    self._fetch_ready.pop(0)
+                    self._kv.cancel(adm)
+                    continue
                 # physical headroom for the pages prefill will own,
                 # ensured HERE (not at the poll): the entry ahead in
                 # this very pass may have consumed what the poll saw
@@ -889,6 +1039,10 @@ class LLMServer:
                 except queue.Empty:
                     return False
             self._pending_head = None
+            if req.done.is_set():
+                # aborted (or watchdog-failed) while queued: skip —
+                # nothing was charged for it yet
+                continue
             adm = None
             if self.paged:
                 t_lk = time.perf_counter()
@@ -1284,7 +1438,8 @@ class LLMServer:
                             donate_argnums=(1, 2))
 
     def _record_decode(self, n_active: int, applied: int, host_s: float,
-                       stall_s: float, finished: int):
+                       stall_s: float, finished: int,
+                       cancelled: int = 0):
         """Per-step attribution (ISSUE 4 satellite): the old single wall
         number silently included the sync barrier and overstated device
         cost; host scheduling and the device-fence stall are now
@@ -1315,6 +1470,11 @@ class LLMServer:
         ins["active"].set(sum(r is not None for r in self._slots))
         if finished:
             ins["requests"].labels(reason="done").inc(finished)
+        if cancelled:
+            # aborted/watchdog-failed slots reaped this drain — counted
+            # HERE only (ISSUE 7): abort() itself does not increment,
+            # else every hedge loser would land twice
+            ins["requests"].labels(reason="cancelled").inc(cancelled)
         self._record_kv_gauges(ins)
 
     def _emit_decode_span(self, req: Request):
@@ -1373,10 +1533,17 @@ class LLMServer:
         rec["pinned"] = rec["refs"] = None
         for args in rec.pop("kv_release", ()):
             self._kv.release_slot(*args)
-        finished = applied = 0
+        finished = applied = cancelled = 0
         for i, req in rec["pairs"]:
             if self._slots[i] is not req:
                 continue   # speculative token for a finished request
+            if req.cancel_requested:
+                # aborted mid-decode (hedge loser, watchdog, client
+                # gone): release the slot and its pages now — the
+                # drained token is discarded like any speculative one
+                self._finish_slot(i, req)
+                cancelled += 1
+                continue
             tok = int(vals[i])
             req.tokens.append(tok)
             if len(req.tokens) == 1:
@@ -1387,7 +1554,7 @@ class LLMServer:
                     or len(req.tokens) >= req.max_new_tokens:
                 self._finish_slot(i, req)
                 finished += 1
-        if finished and self.pipeline_depth == 1:
+        if (finished or cancelled) and self.pipeline_depth == 1:
             # strict synchrony at depth 1: the freed-row resets above
             # must resolve before their consumed buffers drop (exactly
             # the old engine's per-step barrier cadence)
@@ -1400,7 +1567,8 @@ class LLMServer:
         if ins is not None:
             ins["inflight"].set(len(self._inflight))
         self._record_decode(len(rec["pairs"]), applied,
-                            rec.get("host_s", 0.0), stall, finished)
+                            rec.get("host_s", 0.0), stall, finished,
+                            cancelled)
 
     def _finish_slot(self, i: int, req: Request):
         self._emit_decode_span(req)
@@ -1625,6 +1793,14 @@ class LLMServer:
     def _step(self):
         """Decode one token for every active slot."""
         reliability.inject("llm.step")
+        # ISSUE 7 fault site: a ``delay`` rule here wedges the engine
+        # thread inside its locked pass — exactly what a hung device
+        # step looks like to the watchdog (a ``raise`` is just another
+        # failing step for the resilient loop). Gated on live slots so
+        # idle passes don't burn a seeded plan's bounded stall events
+        # before any request is actually mid-step.
+        if any(r is not None for r in self._slots):
+            reliability.inject("worker.stall")
         if self.paged:
             return self._step_paged()
         return self._step_slotted()
@@ -1634,7 +1810,8 @@ class LLMServer:
                                           base_delay=0.005, max_delay=0.5)
         delays = None
         while not self._stop.is_set():
-            try:
+            self._hb = time.monotonic()   # watchdog heartbeat: stale =
+            try:                          # wedged INSIDE this pass
                 with self._lock:
                     self._admit()
                     busy = self._step()

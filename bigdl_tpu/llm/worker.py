@@ -47,6 +47,19 @@ the host tier:
   prefill stage routes the request to the decode pool without a blob
   — it simply prefills itself).
 
+Request-level failover (ISSUE 7, ``bigdl.llm.failover.enabled`` /
+``bigdl.llm.hedge.enabled``, both default off — see
+docs/RELIABILITY.md "Request-level failover"): the router journals
+in-flight requests and resumes ``prompt + generated_so_far`` on
+another backend after a decode failure, a background prober feeds
+live pool membership (``POST /backends`` joins/leaves members), slow
+calls hedge to a twin backend after a p95-based delay, and ``GET
+/metrics`` exports per-backend breaker-state gauges. The worker side
+grows a watchdog-aware ``/healthz`` (a stalled engine answers 503
+``"stalled"``) and terminal stream chunks that carry the engine's
+error + ``retriable`` flag so the router can fail over with the
+tokens drained so far.
+
 Token-level API by design: tokenization happens client-side (the
 environment ships no tokenizer assets; the reference worker accepts text
 because it bundles the HF tokenizer).
@@ -57,6 +70,7 @@ from __future__ import annotations
 import base64
 import http.client
 import json
+import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional, Tuple
@@ -69,6 +83,22 @@ from bigdl_tpu.observability import request_context as rc
 from bigdl_tpu.observability import tracing
 
 ROLES = ("", "prefill", "decode")
+
+
+class _QuietHTTPServer(ThreadingHTTPServer):
+    """Abandoned client connections are ROUTINE on these surfaces
+    (ISSUE 7): the loser of a hedge race is cancelled mid-stream, and a
+    failover re-dispatch closes the dead attempt's socket — the default
+    stderr traceback for a peer reset is pure noise. Real handler
+    errors still print."""
+
+    def handle_error(self, request, client_address):
+        import sys
+        exc = sys.exc_info()[1]
+        if isinstance(exc, (ConnectionResetError, BrokenPipeError,
+                            ConnectionAbortedError)):
+            return
+        super().handle_error(request, client_address)
 
 
 def _send_json(handler, code: int, obj, headers=()):
@@ -141,8 +171,15 @@ class LLMWorker:
                         val = getattr(e, key, None)
                         if val is not None:
                             body[key] = int(val)
-                    self._json(503, body,
-                               headers=(("Retry-After", "1"),))
+                    # Retry-After derived from observed queue depth
+                    # (ISSUE 7 satellite) — a deep backlog tells
+                    # clients to back off longer, jitter decorrelates
+                    # the retry herd
+                    q = getattr(worker.server, "_queue", None)
+                    depth = q.qsize() if q is not None else 0
+                    self._json(503, body, headers=(
+                        ("Retry-After",
+                         reliability.retry_after_seconds(depth)),))
                     return None
                 except ValueError as e:
                     self._json(422, {"error": str(e)})
@@ -198,15 +235,31 @@ class LLMWorker:
                     alive = engine is not None and engine.is_alive()
                     draining = worker.server._draining.is_set() \
                         if hasattr(worker.server, "_draining") else False
-                    healthy = ok and alive and not draining
-                    self._json(200 if healthy else 503, {
+                    # watchdog (ISSUE 7): a stalled engine answers 503
+                    # so the router's prober drains this worker; the
+                    # key is structurally absent when the watchdog is
+                    # off (disabled-mode byte-compat)
+                    tripped = bool(getattr(worker.server,
+                                           "watchdog_tripped", False))
+                    healthy = ok and alive and not draining \
+                        and not tripped
+                    body = {
                         "status": ("ok" if healthy else
                                    "draining" if draining else
+                                   "stalled" if tripped else
                                    "unhealthy"),
                         "role": worker.role,
                         "engine_alive": alive,
                         "queue_length": worker.server._queue.qsize(),
-                        "checks": report})
+                        "checks": report}
+                    if getattr(worker.server, "watchdog_enabled",
+                               False):
+                        body["watchdog"] = {
+                            "tripped": tripped,
+                            "trips": worker.server.watchdog_trips,
+                            "step_timeout_s":
+                                worker.server.watchdog_timeout}
+                    self._json(200 if healthy else 503, body)
                 else:
                     self._json(404, {"error": "unknown path"})
 
@@ -369,28 +422,103 @@ class LLMWorker:
                     seen = 0
                     done = False
                     deadline = time.time() + self._wait_timeout()
-                    while time.time() < deadline:
-                        done = req.done.wait(0.02)
-                        cur = list(req.tokens)
-                        if len(cur) > seen or done:
-                            seen = len(cur)
-                            chunk({"output_ids": list(map(int, cur)),
-                                   "done": bool(done)})
-                        if done:
-                            break
-                    if not done:
-                        # timed out: a stream must never end with
-                        # done:false — clients reading until done:true
-                        # would see a silent truncation (ADVICE r4)
-                        chunk({"output_ids": list(map(int, req.tokens)),
-                               "done": True, "finish_reason": "timeout"})
-                    worker._tokens_out += seen
-                    self.wfile.write(b"0\r\n\r\n")
-                    self.wfile.flush()
+                    try:
+                        while time.time() < deadline:
+                            done = req.done.wait(0.02)
+                            cur = list(req.tokens)
+                            eos = worker.server.eos_token_id
+                            if not done and req.error is None \
+                                    and eos is not None and cur \
+                                    and cur[-1] == eos:
+                                # a chunk ending in EOS is ALWAYS
+                                # terminal (ISSUE 7): the engine is
+                                # about to finish this request with
+                                # "stop". A done:false chunk carrying
+                                # EOS would let a mid-stream failover
+                                # journal it, resume past it on
+                                # another backend, and generate
+                                # spurious post-EOS tokens.
+                                done = True
+                            if len(cur) > seen or done:
+                                seen = len(cur)
+                                payload = {
+                                    "output_ids": list(map(int, cur)),
+                                    "done": bool(done)}
+                                if done:
+                                    # terminal chunk carries the same
+                                    # verdict the blocking endpoint
+                                    # returns (ISSUE 7): either the
+                                    # finish reason, or the engine's
+                                    # error so the router can fail
+                                    # over with the tokens so far
+                                    if req.error is not None:
+                                        payload["error"] = req.error
+                                        payload["retriable"] = True
+                                    else:
+                                        eos = worker.server.eos_token_id
+                                        payload["finish_reason"] = (
+                                            "stop" if eos is not None
+                                            and cur and cur[-1] == eos
+                                            else "length")
+                                chunk(payload)
+                            if done:
+                                break
+                        if not done:
+                            # timed out: a stream must never end with
+                            # done:false — clients reading until
+                            # done:true would see a silent truncation
+                            # (ADVICE r4)
+                            cur = list(req.tokens)
+                            eos = worker.server.eos_token_id
+                            if eos is not None and cur \
+                                    and cur[-1] == eos:
+                                # the engine appended EOS in the
+                                # window between the last wait-loop
+                                # snapshot and deadline expiry: this
+                                # is a FINISHED answer, not a stall.
+                                # Labeling it "timeout" (retriable)
+                                # would let failover resume past EOS
+                                # and append spurious tokens — the
+                                # same corruption the in-loop EOS
+                                # guard exists to prevent.
+                                chunk({"output_ids":
+                                       list(map(int, cur)),
+                                       "done": True,
+                                       "finish_reason": "stop"})
+                            else:
+                                chunk({"output_ids":
+                                       list(map(int, cur)),
+                                       "done": True,
+                                       "finish_reason": "timeout"})
+                                # the router treats "timeout" as
+                                # retriable and resumes elsewhere —
+                                # abort the orphan so a merely-slow
+                                # engine frees its slot and KV pages
+                                # instead of double-computing tokens
+                                # nobody will read
+                                abort = getattr(worker.server,
+                                                "abort", None)
+                                if abort is not None:
+                                    abort(req, reason="stream wait "
+                                          "expired")
+                        worker._tokens_out += seen
+                        self.wfile.write(b"0\r\n\r\n")
+                        self.wfile.flush()
+                    except OSError:
+                        # client gone mid-stream — the loser of a hedge
+                        # race, cancelled (ISSUE 7): abort the request
+                        # so its slot and KV pages free instead of
+                        # decoding tokens nobody will read
+                        abort = getattr(worker.server, "abort", None)
+                        if abort is not None:
+                            abort(req, reason="client disconnected "
+                                  "mid-stream")
+                        worker._tokens_out += seen
+                        self.close_connection = True
                 else:
                     self._json(404, {"error": "unknown path"})
 
-        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd = _QuietHTTPServer((host, port), Handler)
         self.address = self._httpd.server_address
         self._thread: Optional[object] = None
 
@@ -402,16 +530,22 @@ class LLMWorker:
         return self
 
     def stop(self):
-        self._httpd.shutdown()
+        if self._thread is not None:
+            # shutdown() handshakes with serve_forever — calling it on
+            # a never-started server would wait forever
+            self._httpd.shutdown()
         self._httpd.server_close()
 
 
 def _post_json(addr: Tuple[str, int], path: str, body: dict,
-               headers=(), timeout: float = 600.0):
+               headers=(), timeout: float = 600.0, canceller=None):
     """One JSON POST to a backend worker → (status, parsed body,
-    response trace header). Connection errors raise — the router's
-    breaker accounting wants them loud."""
+    response headers dict). Connection errors raise — the router's
+    breaker accounting wants them loud. ``canceller`` (ISSUE 7) lets a
+    hedge race close this connection from another thread."""
     conn = http.client.HTTPConnection(addr[0], addr[1], timeout=timeout)
+    if canceller is not None:
+        canceller.attach(conn)
     try:
         payload = json.dumps(body)
         hdrs = {"Content-Type": "application/json"}
@@ -424,33 +558,89 @@ def _post_json(addr: Tuple[str, int], path: str, body: dict,
             parsed = json.loads(data.decode())
         except ValueError:
             parsed = {"error": data.decode(errors="replace")[:200]}
-        return resp.status, parsed, resp.getheader(rc.TRACE_HEADER)
+        # resp.msg is the parsed HTTPMessage: case-insensitive .get,
+        # still readable after the connection closes
+        return resp.status, parsed, resp.msg
     finally:
         conn.close()
 
 
+class _BackendShed(Exception):
+    """503 from a backend: alive, applying backpressure. Relayed with
+    its own Retry-After — never retried, never a breaker failure."""
+
+    def __init__(self, parsed, retry_after):
+        super().__init__(parsed.get("error", "backend shedding"))
+        self.parsed = parsed
+        self.retry_after = retry_after
+
+
+class _BackendFatal(Exception):
+    """A 4xx from a backend: the *request* is bad (422 infeasible, 403
+    misroute), not the backend — relayed as-is, never failed over."""
+
+    def __init__(self, status, parsed):
+        super().__init__(parsed.get("error", f"backend answered {status}"))
+        self.status = status
+        self.parsed = parsed
+
+
+#: Prometheus encoding of breaker states (ISSUE 7 satellite):
+#: closed=0, half_open=1, open=2 — so an alerting rule is `> 1`.
+BREAKER_STATE_VALUES = {"closed": 0, "half_open": 1, "open": 2}
+
+
 class LLMRouter:
-    """Thin placement scheduler over disaggregated worker pools
-    (ISSUE 6): prefill-role workers compute prompt KV once, decode-role
-    workers stream tokens, and the request's chain crosses between them
-    as a handoff blob through the host tier.
+    """Placement scheduler over disaggregated worker pools (ISSUE 6),
+    grown into the reliability boundary of the serving stack (ISSUE 7).
 
     ``POST /worker_generate`` routes one request end-to-end:
 
-    1. pick a prefill backend (round-robin over the pool, skipping
-       open circuit breakers) → ``/worker_prefill`` → handoff blob;
+    1. pick a prefill backend (round-robin over the pool, skipping open
+       circuit breakers and prober-unhealthy backends) →
+       ``/worker_prefill`` → handoff blob;
     2. pick a decode backend the same way → ``/worker_import_chain``
-       (best-effort) then ``/worker_generate`` → relay the answer.
+       (best-effort) then decode → relay the answer.
 
-    Reused machinery, not re-invented (ISSUE 6 contract): per-backend
+    **Request-level failover (ISSUE 7 tentpole,
+    ``bigdl.llm.failover.enabled`` / ``failover=`` ctor arg; default
+    off).** When enabled the router drains decode through the worker's
+    *streaming* endpoint and journals every token as it arrives
+    (:class:`~bigdl_tpu.llm.failover.RequestJournal`). A connection
+    failure / 5xx / mid-generation engine error re-dispatches
+    ``prompt + generated_so_far`` to another backend with the remaining
+    token budget — greedy decoding is deterministic, so the spliced
+    output is bit-identical to an unfailed run, and the backend's radix
+    cache / host tier make the resume a cheap suffix re-prefill. Worker
+    loss costs latency, not answers (the Spark-lineage story, arXiv
+    1804.05839 §3). Alongside it:
+
+    - an active :class:`~bigdl_tpu.llm.failover.HealthProber` polls
+      worker ``/healthz`` so ``_pick`` routes on observed health, and
+      ``POST /backends`` joins/leaves pool members without a restart;
+    - **hedged dispatch** (``bigdl.llm.hedge.enabled``): a prefill or
+      decode call slower than the stage's observed p95 is duplicated to
+      a second backend — first success wins, the loser's connection is
+      closed and the worker aborts it, releasing its KV. Bounded by
+      ``bigdl.llm.hedge.budget``;
+    - every outgoing backend call re-derives the remaining
+      ``X-BigDL-Deadline-Ms`` from elapsed time, so retries and hedges
+      never overstate the budget (ISSUE 7 satellite).
+
+    Disabled (both knobs false, the default) the router is the PR 6
+    object byte-for-byte: blocking dispatch, no journal, no prober
+    thread, no failover/hedge metric series.
+
+    Reused machinery, not re-invented: per-backend
     :class:`~bigdl_tpu.reliability.CircuitBreaker` trips on connection
-    failures/5xx, overload sheds with **503 + Retry-After** through
-    ``reliability.count_shed``, deadlines propagate via
-    ``X-BigDL-Deadline-Ms``, and the trace context rides
-    ``X-BigDL-Trace-Id`` into both backends so ``GET
+    failures/5xx, overload sheds with **503 + Retry-After** (derived
+    from ``bigdl.llm.retry_after.*``; a backend's own Retry-After is
+    relayed unchanged), and the trace context rides
+    ``X-BigDL-Trace-Id`` into every backend so ``GET
     /debug/trace/<id>`` shows the stitched router → prefill → decode
-    waterfall. A failed prefill stage degrades gracefully: the decode
-    backend gets the request without a blob and prefills it itself.
+    waterfall, with ``router/failover``/``router/hedge`` spans marking
+    the recovery path. A failed prefill stage degrades gracefully: the
+    decode backend prefills itself.
     """
 
     def __init__(self, prefill_workers: List[Tuple[str, int]],
@@ -458,23 +648,71 @@ class LLMRouter:
                  host: str = "127.0.0.1", port: int = 0,
                  request_timeout: float = 600.0,
                  breaker_threshold: int = 3,
-                 breaker_reset: float = 10.0):
+                 breaker_reset: float = 10.0,
+                 failover: Optional[bool] = None,
+                 hedge: Optional[bool] = None,
+                 failover_attempts: Optional[int] = None,
+                 hedge_delay_ms: Optional[float] = None,
+                 prober_interval: Optional[float] = None,
+                 start_prober: bool = True):
+        from bigdl_tpu.utils.conf import conf
         if not decode_workers:
             raise ValueError("the router needs at least one "
                              "decode-role backend")
         self.prefill_workers = [tuple(a) for a in prefill_workers]
         self.decode_workers = [tuple(a) for a in decode_workers]
         self.request_timeout = request_timeout
+        self._breaker_threshold = breaker_threshold
+        self._breaker_reset = breaker_reset
+        self._pool_lock = threading.RLock()
         self._rr = {"prefill": 0, "decode": 0}
-        self._breakers = {
-            addr: reliability.CircuitBreaker(
-                f"llm_router:{addr[0]}:{addr[1]}",
-                failure_threshold=breaker_threshold,
-                reset_timeout=breaker_reset)
-            for addr in self.prefill_workers + self.decode_workers}
+        self._breakers = {}
+        for addr in self.prefill_workers + self.decode_workers:
+            self._breaker_for(addr)   # the one get-or-create path
         self.requests_routed = 0
         self.handoffs_routed = 0
         self.prefill_degraded = 0
+        # ISSUE 7: failover + hedging are constructed ONLY when enabled
+        # — the disabled router must be structurally the PR 6 object
+        self.failover_enabled = (
+            failover if failover is not None else
+            conf.get_bool("bigdl.llm.failover.enabled", False))
+        hedge_on = (hedge if hedge is not None else
+                    conf.get_bool("bigdl.llm.hedge.enabled", False))
+        self._active = self.failover_enabled or hedge_on
+        self.max_attempts = max(1, (
+            failover_attempts if failover_attempts is not None else
+            conf.get_int("bigdl.llm.failover.max.attempts", 3)))
+        self._journal = None
+        self._prober = None
+        self._hedge = None
+        self._latency = None
+        self._start_prober = False
+        if self._active:
+            from bigdl_tpu.llm.failover import (HealthProber, HedgePolicy,
+                                                LatencyTracker,
+                                                RequestJournal)
+            self._journal = RequestJournal()
+            self._hedge = HedgePolicy(
+                enabled=hedge_on,
+                delay_ms=(hedge_delay_ms if hedge_delay_ms is not None
+                          else conf.get_float("bigdl.llm.hedge.delay.ms",
+                                              0.0)),
+                min_delay_ms=conf.get_float(
+                    "bigdl.llm.hedge.min.delay.ms", 50.0),
+                budget=conf.get_float("bigdl.llm.hedge.budget", 0.1))
+            self._latency = {"prefill": LatencyTracker(),
+                             "decode": LatencyTracker()}
+            if self.failover_enabled:
+                self._prober = HealthProber(
+                    self._prober_targets,
+                    interval=(prober_interval if prober_interval
+                              is not None else
+                              conf.get_float("bigdl.llm.prober.interval",
+                                             0.5)),
+                    on_probe=self._on_probe)
+                self._start_prober = start_prober
+        self._ins = None
         router = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -492,31 +730,38 @@ class LLMRouter:
                 if debug is not None:
                     self._json(*debug)
                 elif self.path == "/healthz":
-                    ok, report = reliability.health_report()
-                    states = {f"{a[0]}:{a[1]}": router._breakers[a].state
-                              for a in router._breakers}
-                    decode_up = any(
-                        router._breakers[a].state != "open"
-                        for a in router.decode_workers)
-                    healthy = ok and decode_up
-                    self._json(200 if healthy else 503, {
-                        "status": "ok" if healthy else "unhealthy",
-                        "role": "router",
-                        "backends": states,
-                        "checks": report})
+                    self._json(*router._healthz())
+                elif self.path == "/metrics":
+                    router._record_breakers()
+                    body = obs.render().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", obs.CONTENT_TYPE)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
                 elif self.path == "/worker_get_status":
-                    self._json(200, {
-                        "role": "router",
-                        "prefill_workers": len(router.prefill_workers),
-                        "decode_workers": len(router.decode_workers),
-                        "requests_routed": router.requests_routed,
-                        "handoffs_routed": router.handoffs_routed,
-                        "prefill_degraded": router.prefill_degraded})
+                    self._json(200, router._status_body())
                 else:
                     self._json(404, {"error": "unknown path"})
 
             def do_POST(self):
                 self._trace = None
+                if self.path == "/backends":
+                    # live pool membership (ISSUE 7): part of the
+                    # active-health layer, 404 when failover is off
+                    # (the PR 6 router had no such surface)
+                    if not router.failover_enabled:
+                        self._json(404, {"error": "unknown path"})
+                        return
+                    try:
+                        n = int(self.headers.get("Content-Length", 0))
+                        body = json.loads(self.rfile.read(n))
+                        code, out = router._admin_backends(body)
+                    except Exception as e:  # noqa: BLE001
+                        self._json(400, {"error": f"bad request: {e}"})
+                        return
+                    self._json(code, out)
+                    return
                 if self.path != "/worker_generate":
                     self._json(404, {"error": "unknown path"})
                     return
@@ -531,54 +776,260 @@ class LLMRouter:
                 except Exception as e:  # noqa: BLE001
                     self._json(400, {"error": f"bad request: {e}"})
                     return
-                fwd = list(rc.to_headers(ctx))
-                deadline = self.headers.get(reliability.DEADLINE_HEADER)
-                if deadline:
-                    fwd.append((reliability.DEADLINE_HEADER, deadline))
+                # the deadline is parsed ONCE; every backend call
+                # re-derives the remaining budget from it (ISSUE 7
+                # satellite — a relayed original value would overstate
+                # the budget on any retry or hedge)
+                deadline = reliability.Deadline.from_header(
+                    self.headers.get(reliability.DEADLINE_HEADER))
+
+                def fwd_headers():
+                    hdrs = list(rc.to_headers(ctx))
+                    if deadline is not None:
+                        hdrs.append((reliability.DEADLINE_HEADER,
+                                     deadline.to_header()))
+                    return hdrs
+
                 with rc.activate(ctx), \
                         obs.span("llm/route", stage="llm_router",
                                  tokens=len(body["prompt_ids"])):
-                    router._route(self, body, fwd)
+                    if router._active:
+                        router._route_failover(self, body, fwd_headers,
+                                               deadline)
+                    else:
+                        router._route(self, body, fwd_headers)
 
-        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd = _QuietHTTPServer((host, port), Handler)
         self.address = self._httpd.server_address
         self._thread = None
 
+    # -- journal/prober views ------------------------------------------------
+    @property
+    def failovers(self) -> int:
+        return self._journal.failovers if self._journal else 0
+
+    @property
+    def tokens_resumed(self) -> int:
+        return self._journal.tokens_resumed if self._journal else 0
+
+    @property
+    def hedges_issued(self) -> int:
+        return self._hedge.hedges if self._hedge else 0
+
+    def _prober_targets(self):
+        with self._pool_lock:
+            return ([(a, "prefill") for a in self.prefill_workers]
+                    + [(a, "decode") for a in self.decode_workers])
+
+    def _on_probe(self, addr, role, healthy, body):
+        ins = self._instruments()
+        if ins is not None and "healthy" in ins:
+            ins["healthy"].labels(
+                backend=f"{addr[0]}:{addr[1]}", role=role).set(
+                    1 if healthy else 0)
+
+    # -- metrics -------------------------------------------------------------
+    def _instruments(self):
+        if not obs.enabled():
+            return None
+        if self._ins is None:
+            ins = {
+                "breaker_state": obs.gauge(
+                    "bigdl_router_breaker_state",
+                    "Per-backend circuit-breaker state "
+                    "(0=closed, 1=half_open, 2=open)",
+                    labelnames=("backend",)),
+            }
+            if self._active:
+                ins.update({
+                    "failovers": obs.counter(
+                        "bigdl_router_failovers_total",
+                        "Requests re-dispatched to another backend "
+                        "after a failure", labelnames=("stage",)),
+                    "hedges": obs.counter(
+                        "bigdl_router_hedges_total",
+                        "Hedged backend calls by outcome",
+                        labelnames=("stage", "outcome")),
+                    "journal": obs.gauge(
+                        "bigdl_router_journal_inflight",
+                        "Routed requests currently in the failover "
+                        "journal"),
+                    "healthy": obs.gauge(
+                        "bigdl_router_backend_healthy",
+                        "Prober verdict per backend (1 healthy)",
+                        labelnames=("backend", "role")),
+                })
+            self._ins = ins
+        return self._ins
+
+    def _record_breakers(self):
+        ins = self._instruments()
+        if ins is None:
+            return
+        with self._pool_lock:
+            items = list(self._breakers.items())
+        for addr, b in items:
+            ins["breaker_state"].labels(
+                backend=f"{addr[0]}:{addr[1]}").set(
+                    BREAKER_STATE_VALUES.get(b.state, 2))
+
+    # -- surfaces ------------------------------------------------------------
+    def _healthz(self):
+        ok, report = reliability.health_report()
+        with self._pool_lock:
+            states = {f"{a[0]}:{a[1]}": self._breakers[a].state
+                      for a in self._breakers}
+            decode_up = any(
+                self._breakers[a].state != "open"
+                and (self._prober is None or self._prober.healthy(a))
+                for a in self.decode_workers)
+        self._record_breakers()
+        healthy = ok and decode_up
+        body = {
+            "status": "ok" if healthy else "unhealthy",
+            "role": "router",
+            "backends": states,
+            "checks": report}
+        if self._active:
+            body["journal_inflight"] = self._journal.inflight()
+            body["failovers"] = self.failovers
+            body["hedges_issued"] = self.hedges_issued
+        if self._prober is not None:
+            body["prober"] = self._prober.status()
+        return (200 if healthy else 503), body
+
+    def _status_body(self):
+        with self._pool_lock:
+            body = {
+                "role": "router",
+                "prefill_workers": len(self.prefill_workers),
+                "decode_workers": len(self.decode_workers),
+                "requests_routed": self.requests_routed,
+                "handoffs_routed": self.handoffs_routed,
+                "prefill_degraded": self.prefill_degraded}
+            if self._active:
+                body.update({
+                    "prefill_pool": [f"{a[0]}:{a[1]}"
+                                     for a in self.prefill_workers],
+                    "decode_pool": [f"{a[0]}:{a[1]}"
+                                    for a in self.decode_workers],
+                    "failover_enabled": self.failover_enabled,
+                    "journal_inflight": self._journal.inflight(),
+                    "journal": self._journal.snapshot(),
+                    "failovers": self.failovers,
+                    "tokens_resumed": self.tokens_resumed,
+                    "hedges_issued": self.hedges_issued})
+        return body
+
+    def _admin_backends(self, body: dict):
+        """``POST /backends``: join/leave pool members without a
+        restart. {"action": "add"|"remove", "role": "prefill"|"decode",
+        "host": ..., "port": ...}"""
+        action = body.get("action")
+        role = body.get("role")
+        if action not in ("add", "remove") or \
+                role not in ("prefill", "decode"):
+            raise ValueError("need action add|remove and role "
+                             "prefill|decode")
+        addr = (str(body["host"]), int(body["port"]))
+        with self._pool_lock:
+            pool = (self.prefill_workers if role == "prefill"
+                    else self.decode_workers)
+            if action == "add":
+                if addr not in pool:
+                    pool.append(addr)
+                    self._breaker_for(addr)
+            else:
+                if role == "decode" and len(pool) == 1 \
+                        and addr in pool:
+                    raise ValueError("refusing to remove the last "
+                                     "decode backend")
+                if addr in pool:
+                    pool.remove(addr)
+                other = (self.decode_workers if role == "prefill"
+                         else self.prefill_workers)
+                if addr not in other:
+                    self._breakers.pop(addr, None)
+                if self._prober is not None:
+                    self._prober.forget(addr)
+            out = {"prefill_workers": [list(a) for a in
+                                       self.prefill_workers],
+                   "decode_workers": [list(a) for a in
+                                      self.decode_workers]}
+        return 200, out
+
     # -- placement -----------------------------------------------------------
-    def _pick(self, kind: str) -> Optional[Tuple[str, int]]:
+    def _pick(self, kind: str, exclude=frozenset()
+              ) -> Optional[Tuple[str, int]]:
         """Round-robin over the pool, skipping open breakers (the
-        half-open probe slot is granted like any call)."""
-        pool = (self.prefill_workers if kind == "prefill"
-                else self.decode_workers)
-        for off in range(len(pool)):
-            addr = pool[(self._rr[kind] + off) % len(pool)]
-            if self._breakers[addr].allow():
-                self._rr[kind] = (self._rr[kind] + off + 1) % len(pool)
-                return addr
+        half-open probe slot is granted like any call) and — with the
+        prober running — backends whose last ``/healthz`` failed.
+        ``exclude`` softly avoids backends that already failed this
+        request: if excluding them empties the pool, they are retried
+        rather than failing the request outright."""
+        with self._pool_lock:
+            pool = list(self.prefill_workers if kind == "prefill"
+                        else self.decode_workers)
+            if not pool:
+                return None
+            for skip_excluded in (True, False) if exclude else (False,):
+                for off in range(len(pool)):
+                    addr = pool[(self._rr[kind] + off) % len(pool)]
+                    if skip_excluded and addr in exclude:
+                        continue
+                    if not self._breakers[addr].allow():
+                        continue
+                    if self._prober is not None and \
+                            not self._prober.healthy(addr):
+                        continue
+                    self._rr[kind] = \
+                        (self._rr[kind] + off + 1) % len(pool)
+                    return addr
         return None
 
-    def _call(self, addr, path, body, headers):
+    def _breaker_for(self, addr):
+        with self._pool_lock:
+            b = self._breakers.get(addr)
+            if b is None:
+                b = self._breakers[addr] = reliability.CircuitBreaker(
+                    f"llm_router:{addr[0]}:{addr[1]}",
+                    failure_threshold=self._breaker_threshold,
+                    reset_timeout=self._breaker_reset)
+            return b
+
+    def _call(self, addr, path, body, headers, canceller=None):
         """Backend call under its breaker; raises on transport errors
         and 5xx so the breaker sees them. A 503 shed is NOT a failure:
         the backend is alive and applying backpressure — it is relayed
-        to the caller (with Retry-After) instead of tripping the
-        breaker, else transient overload on a healthy worker would
-        escalate to the whole backend being circuit-broken out."""
-        breaker = self._breakers[addr]
+        to the caller (with its own Retry-After, unchanged) instead of
+        tripping the breaker, else transient overload on a healthy
+        worker would escalate to the whole backend being circuit-broken
+        out."""
+        breaker = self._breaker_for(addr)
         try:
-            status, parsed, trace = _post_json(
-                addr, path, body, headers, self.request_timeout)
+            reliability.inject("router.dispatch")
+            status, parsed, hdrs = _post_json(
+                addr, path, body, headers, self.request_timeout,
+                canceller=canceller)
         except Exception:
-            breaker.record_failure()
+            # a cancelled hedge loser died because WE closed its
+            # socket, not because the backend failed — recording it
+            # would circuit-break the consistently-slower (but
+            # healthy) twin out of the pool
+            if canceller is None or not canceller.cancelled:
+                breaker.record_failure()
+                self._record_breakers()
             raise
         if status >= 500 and status != 503:
             breaker.record_failure()
+            self._record_breakers()
             raise RuntimeError(
                 f"{addr[0]}:{addr[1]}{path} answered {status}: "
                 f"{parsed.get('error', '')}")
         breaker.record_success()
-        return status, parsed
+        return status, parsed, hdrs
 
+    # -- legacy (PR 6) routing: failover + hedging disabled ------------------
     def _route(self, handler, body, fwd_headers):
         prompt_ids = body["prompt_ids"]
         # stage 1: prefill + export (optional — losing it only costs
@@ -587,9 +1038,9 @@ class LLMRouter:
         addr = self._pick("prefill")
         if addr is not None:
             try:
-                status, parsed = self._call(
+                status, parsed, _ = self._call(
                     addr, "/worker_prefill",
-                    {"prompt_ids": prompt_ids}, fwd_headers)
+                    {"prompt_ids": prompt_ids}, fwd_headers())
                 if status == 200:
                     handoff = parsed.get("handoff")
             except Exception:
@@ -602,37 +1053,340 @@ class LLMRouter:
             reliability.count_shed("llm_router")
             handler._json(503, {"error": "no decode backend available "
                                 "(breakers open)"},
-                          headers=(("Retry-After", "1"),))
+                          headers=(("Retry-After",
+                                    reliability.retry_after_seconds(0)),))
             return
         try:
             if handoff:
                 try:
                     self._call(addr, "/worker_import_chain",
-                               {"handoff": handoff}, fwd_headers)
+                               {"handoff": handoff}, fwd_headers())
                     self.handoffs_routed += 1
                 except Exception:
                     pass   # decode still works, just re-prefills
-            status, parsed = self._call(addr, "/worker_generate", body,
-                                        fwd_headers)
+            status, parsed, hdrs = self._call(addr, "/worker_generate",
+                                              body, fwd_headers())
         except Exception as e:  # noqa: BLE001
             handler._json(502, {"error": f"decode backend failed: {e}"})
             return
         if status == 503:
             reliability.count_shed("llm_router")
-            handler._json(503, parsed,
-                          headers=(("Retry-After", "1"),))
+            # the backend's own Retry-After rides through unchanged
+            # (ISSUE 7 satellite)
+            ra = hdrs.get("Retry-After") or \
+                reliability.retry_after_seconds(0)
+            handler._json(503, parsed, headers=(("Retry-After", ra),))
             return
         self.requests_routed += 1
         handler._json(status, parsed)
 
+    # -- failover routing (ISSUE 7) ------------------------------------------
+    def _prefill_stage(self, prompt_ids, fwd_headers):
+        """Hedged, best-effort prefill+export: returns the handoff blob
+        or None (the decode backend then prefills itself)."""
+        from bigdl_tpu.llm import failover as fo
+        addr = self._pick("prefill")
+        if addr is None:
+            return None
+
+        def attempt(a):
+            def run(canceller):
+                status, parsed, _ = self._call(
+                    a, "/worker_prefill", {"prompt_ids": prompt_ids},
+                    fwd_headers(), canceller=canceller)
+                if status != 200:
+                    raise RuntimeError(
+                        f"prefill backend answered {status}")
+                return parsed.get("handoff")
+            return run
+
+        hedge_fn = None
+        hedge_addr = None
+        if self._hedge.allow():
+            hedge_addr = self._pick("prefill", exclude={addr})
+            if hedge_addr is not None and hedge_addr != addr:
+                hedge_fn = attempt(hedge_addr)
+        delay = self._hedge.delay_for(self._latency["prefill"])
+        t0 = time.perf_counter()
+
+        def on_hedge():
+            self._hedge.note_hedge()
+            ins = self._instruments()
+            if ins is not None and "hedges" in ins:
+                ins["hedges"].labels(stage="prefill",
+                                     outcome="issued").inc()
+
+        try:
+            blob, outcome = fo.run_hedged(attempt(addr), hedge_fn,
+                                          delay, on_hedge)
+        except Exception:
+            return None
+        self._latency["prefill"].record(time.perf_counter() - t0)
+        if outcome != "primary":
+            self._note_hedge_outcome("prefill", outcome)
+        return blob
+
+    def _note_hedge_outcome(self, stage, outcome):
+        ins = self._instruments()
+        if ins is not None and "hedges" in ins:
+            ins["hedges"].labels(stage=stage, outcome=outcome).inc()
+
+    def _stream_decode(self, addr, body, headers, canceller, on_tokens):
+        """One decode attempt over ``/worker_generate_stream``: every
+        chunk's cumulative token list feeds ``on_tokens`` (the journal
+        update — tokens survive the attempt failing). Returns the
+        finish reason. Raises :class:`_BackendShed` (503),
+        :class:`_BackendFatal` (other 4xx) or a failover-eligible error
+        (transport / 5xx / mid-generation engine failure — the breaker
+        records those)."""
+        breaker = self._breaker_for(addr)
+        conn = http.client.HTTPConnection(addr[0], addr[1],
+                                          timeout=self.request_timeout)
+        if canceller is not None:
+            canceller.attach(conn)
+        try:
+            try:
+                reliability.inject("router.dispatch")
+                hdrs = {"Content-Type": "application/json"}
+                for k, v in headers:
+                    hdrs[k] = v
+                conn.request("POST", "/worker_generate_stream",
+                             json.dumps(body), hdrs)
+                resp = conn.getresponse()
+                if resp.status != 200:
+                    data = resp.read()
+                    try:
+                        parsed = json.loads(data.decode())
+                    except ValueError:
+                        parsed = {"error":
+                                  data.decode(errors="replace")[:200]}
+                    if resp.status == 503:
+                        breaker.record_success()
+                        raise _BackendShed(
+                            parsed, resp.getheader("Retry-After"))
+                    if resp.status >= 500:
+                        raise RuntimeError(
+                            f"{addr[0]}:{addr[1]} answered "
+                            f"{resp.status}: {parsed.get('error', '')}")
+                    breaker.record_success()
+                    raise _BackendFatal(resp.status, parsed)
+                last = None
+                while True:
+                    # mid-stream fault site: a raise here is a torn
+                    # connection AFTER tokens drained — exactly the
+                    # suffix-resume case the journal exists for
+                    reliability.inject("router.dispatch")
+                    line = resp.readline()
+                    if not line:
+                        break
+                    line = line.strip()
+                    if not line:
+                        continue
+                    obj = json.loads(line.decode())
+                    on_tokens(obj.get("output_ids", []))
+                    last = obj
+                    if obj.get("done"):
+                        break
+                if last is None or not last.get("done"):
+                    raise RuntimeError(
+                        f"{addr[0]}:{addr[1]} stream ended before "
+                        "done:true")
+                if last.get("error"):
+                    raise RuntimeError(
+                        f"{addr[0]}:{addr[1]} failed mid-generation: "
+                        f"{last['error']}")
+                if last.get("finish_reason") == "timeout":
+                    # the worker's stream wait expired with the request
+                    # still parked on a wedged engine (watchdog off, or
+                    # the request raced in after the trip sweep) — a
+                    # silent truncation, not an answer. Retriable: the
+                    # journal resumes the drained tokens elsewhere.
+                    raise RuntimeError(
+                        f"{addr[0]}:{addr[1]} timed out mid-generation "
+                        f"({len(last.get('output_ids', []))} tokens "
+                        "drained)")
+            except (_BackendShed, _BackendFatal):
+                raise
+            except Exception:
+                # same hedge-loser carve-out as _call: a socket we
+                # cancelled is not a backend failure
+                if canceller is None or not canceller.cancelled:
+                    breaker.record_failure()
+                    self._record_breakers()
+                raise
+            breaker.record_success()
+            return last.get("finish_reason") or "length"
+        finally:
+            conn.close()
+
+    def _decode_attempt(self, addr, ent, fwd_headers, tried=None):
+        """One (possibly hedged) decode dispatch resuming from the
+        journal entry's current state. Tokens land in the entry AS THEY
+        DRAIN; hedge twins run the same greedy resume so the longest
+        cumulative list is always a consistent prefix of the answer.
+        A launched hedge twin is added to ``tried`` so that when BOTH
+        attempts fail, the failover loop excludes it too instead of
+        burning the next attempt re-picking a known-bad backend."""
+        from bigdl_tpu.llm import failover as fo
+        body = {"prompt_ids": ent.resume_prompt(),
+                "max_new_tokens": ent.remaining}
+        base = len(ent.tokens)
+        lock = threading.Lock()
+
+        def absorb(cur):
+            with lock:
+                ent.drained(cur, base)
+
+        def attempt(a):
+            def run(canceller):
+                return self._stream_decode(a, body, fwd_headers(),
+                                           canceller, absorb)
+            return run
+
+        hedge_fn = None
+        hedge_addr = None
+        if self._hedge.allow():
+            hedge_addr = self._pick(
+                "decode", exclude={addr} | (tried or set()))
+            if hedge_addr is not None and hedge_addr != addr:
+                hedge_fn = attempt(hedge_addr)
+        delay = self._hedge.delay_for(self._latency["decode"])
+
+        def on_hedge():
+            self._hedge.note_hedge()
+            ent.hedges += 1
+            if tried is not None:
+                tried.add(hedge_addr)
+            ins = self._instruments()
+            if ins is not None and "hedges" in ins:
+                ins["hedges"].labels(stage="decode",
+                                     outcome="issued").inc()
+
+        t0 = time.perf_counter()
+        if hedge_fn is not None:
+            with obs.span("router/hedge", stage="llm_router",
+                          backend=f"{addr[0]}:{addr[1]}"):
+                # prefer= keeps a backend's 4xx/shed verdict from
+                # being masked by the twin's later transport error —
+                # those must relay, not burn failover attempts
+                reason, outcome = fo.run_hedged(
+                    attempt(addr), hedge_fn, delay, on_hedge,
+                    prefer=(_BackendShed, _BackendFatal))
+        else:
+            reason, outcome = fo.run_hedged(attempt(addr), None, delay)
+        self._latency["decode"].record(time.perf_counter() - t0)
+        if outcome != "primary":
+            self._note_hedge_outcome("decode", outcome)
+        return reason
+
+    def _route_failover(self, handler, body, fwd_headers, deadline):
+        prompt_ids = body["prompt_ids"]
+        try:
+            mnt = int(body.get("max_new_tokens", 32))
+        except (TypeError, ValueError):
+            handler._json(400, {"error": "bad max_new_tokens"})
+            return
+        ent = self._journal.add(prompt_ids, mnt)
+        self._hedge.note_request()
+        ins = self._instruments()
+        if ins is not None and "journal" in ins:
+            ins["journal"].set(self._journal.inflight())
+        try:
+            handoff = self._prefill_stage(prompt_ids, fwd_headers)
+            if handoff is None and self.prefill_workers:
+                self.prefill_degraded += 1
+            imported = set()
+            tried = set()
+            while True:
+                if deadline is not None and deadline.expired():
+                    handler._json(504, {
+                        "error": "deadline exceeded while routing",
+                        "tokens_drained": len(ent.tokens)})
+                    return
+                addr = self._pick("decode", exclude=tried)
+                if addr is None:
+                    reliability.count_shed("llm_router")
+                    handler._json(
+                        503, {"error": "no decode backend available "
+                              "(breakers open or unhealthy)"},
+                        headers=(("Retry-After",
+                                  reliability.retry_after_seconds(
+                                      self._journal.inflight())),))
+                    return
+                if handoff and addr not in imported:
+                    try:
+                        self._call(addr, "/worker_import_chain",
+                                   {"handoff": handoff}, fwd_headers())
+                        self.handoffs_routed += 1
+                    except Exception:
+                        pass   # decode still works, just re-prefills
+                    imported.add(addr)
+                ent.attempts += 1
+                try:
+                    ent.finish_reason = self._decode_attempt(
+                        addr, ent, fwd_headers, tried)
+                    break
+                except _BackendShed as e:
+                    reliability.count_shed("llm_router")
+                    ra = e.retry_after or \
+                        reliability.retry_after_seconds(0)
+                    handler._json(503, e.parsed,
+                                  headers=(("Retry-After", ra),))
+                    return
+                except _BackendFatal as e:
+                    handler._json(e.status, e.parsed)
+                    return
+                except Exception as e:  # noqa: BLE001 — failover
+                    tried.add(addr)
+                    if ent.remaining == 0:
+                        # the connection died delivering the final
+                        # token: the budget is already fulfilled
+                        ent.finish_reason = ent.finish_reason or "length"
+                        break
+                    if not self.failover_enabled or \
+                            ent.attempts >= self.max_attempts:
+                        handler._json(502, {
+                            "error": f"decode backend failed after "
+                                     f"{ent.attempts} attempt(s): {e}",
+                            "tokens_drained": len(ent.tokens)})
+                        return
+                    # journal → resume: re-dispatch prompt + generated
+                    # so far to another backend (the tentpole)
+                    self._journal.record_failover(ent)
+                    if ins is not None and "failovers" in ins:
+                        ins["failovers"].labels(stage="decode").inc()
+                    obs.add_complete(
+                        "router/failover", time.time(), 0.0,
+                        stage="llm_router",
+                        backend=f"{addr[0]}:{addr[1]}",
+                        tokens_resumed=len(ent.tokens),
+                        attempt=ent.attempts,
+                        **({"trace": rc.current().trace_id}
+                           if rc.current() is not None else {}))
+                    continue
+            self.requests_routed += 1
+            handler._json(200, {
+                "output_ids": [int(t) for t in ent.tokens],
+                "finish_reason": ent.finish_reason or "length"})
+        finally:
+            self._journal.complete(ent)
+            if ins is not None and "journal" in ins:
+                ins["journal"].set(self._journal.inflight())
+
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> "LLMRouter":
-        import threading
-        self._thread = threading.Thread(target=self._httpd.serve_forever,
-                                        daemon=True)
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True)
         self._thread.start()
+        if self._prober is not None and self._start_prober:
+            self._prober.start()
         return self
 
     def stop(self):
-        self._httpd.shutdown()
+        if self._prober is not None:
+            self._prober.stop()
+        if self._thread is not None:
+            # shutdown() handshakes with serve_forever — calling it on
+            # a never-started router would wait forever
+            self._httpd.shutdown()
         self._httpd.server_close()

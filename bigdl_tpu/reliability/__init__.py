@@ -35,7 +35,8 @@ from bigdl_tpu.reliability.faults import (
 from bigdl_tpu.reliability.policies import (
     DEADLINE_HEADER, CircuitBreaker, CircuitOpenError, Deadline,
     DeadlineExceeded, OverloadError, RetryPolicy, TrainingPreempted,
-    health_checks, health_report, register_health, unregister_health)
+    health_checks, health_report, register_health, retry_after_seconds,
+    unregister_health)
 
 
 def enabled() -> bool:
@@ -68,5 +69,6 @@ __all__ = [
     "TrainingPreempted",
     "active_plan", "armed_sites", "count_shed", "disable", "enable",
     "enabled", "health_checks", "health_report", "inject",
-    "register_health", "set_plan", "unregister_health",
+    "register_health", "retry_after_seconds", "set_plan",
+    "unregister_health",
 ]

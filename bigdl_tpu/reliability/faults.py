@@ -50,6 +50,8 @@ SITES = (
     "kvcache.evict",               # prefix-cache LRU eviction (ISSUE 5)
     "kvtier.spill",                # HBM->host page spill (ISSUE 6)
     "kvtier.fetch",                # host->HBM page fetch (ISSUE 6)
+    "router.dispatch",             # router->backend call/stream (ISSUE 7)
+    "worker.stall",                # hung engine decode step (ISSUE 7)
 )
 
 

@@ -107,6 +107,42 @@ class Deadline:
             return None
 
 
+#: Process-wide RNG for Retry-After jitter. Module-level (not per-call)
+#: so a shed storm decorrelates across requests within one process.
+_RETRY_AFTER_RNG = random.Random()
+
+
+def retry_after_seconds(queue_depth: int = 0,
+                        rng: Optional[random.Random] = None) -> str:
+    """Derive a ``Retry-After`` header value from observed queue depth
+    (ISSUE 7 satellite — replaces the hardcoded ``1``).
+
+    ``clamp(base + per_queued * depth, 1, max)`` stretched by up to
+    ``jitter`` fraction so a thundering herd of shed clients does not
+    retry in lockstep (at the cap the jitter spreads downward instead,
+    so saturation never re-synchronizes the herd). All knobs under
+    ``bigdl.llm.retry_after.*``.
+    Returns the integer-second string HTTP wants; an empty queue with
+    the default knobs still renders ``"1"`` (jitter stretches the value
+    by at most 20% before rounding), so existing clients see no change
+    until pressure actually builds."""
+    from bigdl_tpu.utils.conf import conf
+    base = conf.get_float("bigdl.llm.retry_after.base", 1.0)
+    per = conf.get_float("bigdl.llm.retry_after.per_queued", 0.25)
+    cap = conf.get_float("bigdl.llm.retry_after.max", 30.0)
+    jitter = conf.get_float("bigdl.llm.retry_after.jitter", 0.2)
+    r = (rng or _RETRY_AFTER_RNG).random()
+    val = base + per * max(int(queue_depth), 0)
+    if val >= cap:
+        # saturated: jitter DOWN from the cap — stretching upward and
+        # clamping would hand every shed client exactly the cap,
+        # re-synchronizing the herd precisely at the deepest backlog
+        val = cap * (1.0 - max(jitter, 0.0) * r)
+    else:
+        val = min(val * (1.0 + max(jitter, 0.0) * r), cap)
+    return str(max(1, int(round(val))))
+
+
 # ---------------------------------------------------------------------------
 # RetryPolicy
 # ---------------------------------------------------------------------------

@@ -63,6 +63,29 @@ _DEFAULTS: Dict[str, str] = {
     # disaggregated serving (ISSUE 6): "" unified, "prefill" or
     # "decode" restricts an LLMWorker to one side of the KV handoff
     "bigdl.llm.role": "",
+    # request-level failover (ISSUE 7): the router journals in-flight
+    # requests and resumes prompt+generated on another backend after a
+    # decode failure. false = PR 6 router byte-identical (no journal,
+    # no prober thread, blocking dispatch)
+    "bigdl.llm.failover.enabled": "false",
+    "bigdl.llm.failover.max.attempts": "3",   # dispatch tries/request
+    "bigdl.llm.prober.interval": "0.5",       # /healthz poll (seconds)
+    # hedged dispatch (ISSUE 7): duplicate a slow prefill/decode call
+    # to a second backend after a p95-based delay; first success wins
+    "bigdl.llm.hedge.enabled": "false",
+    "bigdl.llm.hedge.delay.ms": "0",          # 0 = p95-based (observed)
+    "bigdl.llm.hedge.min.delay.ms": "50",     # floor under the p95 rule
+    "bigdl.llm.hedge.budget": "0.1",          # hedges / requests cap
+    # engine watchdog (ISSUE 7): a device step stalled past the timeout
+    # flips /healthz to 503 and fails pending requests retriably.
+    # 0 = off (no watchdog thread, no series)
+    "bigdl.llm.watchdog.step_timeout": "0",
+    # derived Retry-After (ISSUE 7 satellite): seconds = clamp(base +
+    # per_queued * queue_depth, 1, max) stretched by up to `jitter`
+    "bigdl.llm.retry_after.base": "1.0",
+    "bigdl.llm.retry_after.per_queued": "0.25",
+    "bigdl.llm.retry_after.max": "30",
+    "bigdl.llm.retry_after.jitter": "0.2",
     "bigdl.train.prefetch": "true",           # stage batch N+1 during N
     "bigdl.train.prefetch.depth": "2",        # staged batches held ahead
 }

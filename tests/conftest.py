@@ -46,6 +46,11 @@ def pytest_configure(config):
         "markers",
         "kvtier: tiered KV-cache tests (host arena / migration / "
         "handoff units + spill-reload parity; select with -m kvtier)")
+    config.addinivalue_line(
+        "markers",
+        "failover: request-level failover / hedged dispatch / engine "
+        "watchdog tests (router journal+resume parity; select with "
+        "-m failover)")
 
 
 @pytest.fixture(scope="session")

@@ -12,6 +12,7 @@ harness exists to catch.
 
 Usage:
     python tools/chaos_check.py [--seed N] [--events K] [--full]
+        [--kvcache | --kvtier | --failover | --all]
 
 Wired into ``bench.py``'s telemetry block as a smoke invocation and into
 pytest as ``-m chaos`` (kept out of tier-1 by the ``slow`` marker).
@@ -268,6 +269,215 @@ def run_kvtier_chaos(seed: int = 0, n_groups: int = 4,
     return out
 
 
+def run_failover_chaos(seed: int = 0, n_requests: int = 4,
+                       kills: int = 2, stalls: int = 1,
+                       new_tokens: int = 5,
+                       smoke: bool = False) -> dict:
+    """ISSUE 7 acceptance: a kill storm against the disaggregated
+    router must cost latency, not answers. Two decode workers behind a
+    failover-enabled ``LLMRouter``; seeded ``router.dispatch`` raises
+    tear connections mid-stream (after tokens drained) and seeded
+    ``worker.stall`` hangs wedge an engine past its watchdog timeout —
+    every request must still complete with greedy output bit-identical
+    to ``model.generate``, with the journal resuming
+    ``prompt + generated_so_far`` on the surviving backend.
+
+    Also asserts the disabled-mode contract: with failover/hedging off
+    the router is structurally the PR 6 object — no journal, no prober
+    thread, no ``bigdl_router_failovers/hedges/journal`` metric series
+    from serving a request through it.
+
+    ``smoke=True`` shrinks the storm to one kill over two requests
+    (dominant costs are the per-shape warmup on both engines and the
+    watchdog stall) — the same contract, sized for ``run_all_chaos``
+    inside ``bench.py`` telemetry where the full storm's minutes of
+    wall-clock would distort a tool people compare numbers across."""
+    import threading
+
+    if smoke:
+        n_requests = min(n_requests, 2)
+        kills = min(kills, 1)
+        new_tokens = min(new_tokens, 4)
+
+    import numpy as np
+
+    from bigdl_tpu import observability as obs
+    from bigdl_tpu import reliability as rel
+    from bigdl_tpu.llm.models.llama import LlamaConfig, LlamaForCausalLM
+    from bigdl_tpu.llm.serving import LLMServer
+    from bigdl_tpu.llm.worker import LLMRouter, LLMWorker
+
+    model = LlamaForCausalLM.from_config(LlamaConfig.tiny(), seed=0,
+                                         max_cache_len=128)
+    rs = np.random.RandomState(seed)
+    prompts = [rs.randint(0, 250, 10 + 2 * j).astype(np.int32)
+               for j in range(n_requests)]
+    want = [list(map(int,
+                     model.generate(p[None],
+                                    max_new_tokens=new_tokens)
+                     [0, len(p):]))
+            for p in prompts]
+
+    def post(addr, path, body, timeout=600):
+        import http.client
+        import json as _json
+        conn = http.client.HTTPConnection(*addr, timeout=timeout)
+        try:
+            conn.request("POST", path, _json.dumps(body),
+                         {"Content-Type": "application/json"})
+            r = conn.getresponse()
+            return r.status, _json.loads(r.read().decode())
+        finally:
+            conn.close()
+
+    # --- disabled-mode structural absence (cheap, serves one request)
+    s0 = LLMServer(model, max_batch=2, max_seq_len=64, page_size=8) \
+        .start()
+    w0 = LLMWorker(s0, role="decode").start()
+    before = set(obs.render().splitlines()) if obs.enabled() else set()
+    r0 = LLMRouter([], [w0.address], start_prober=False).start()
+    try:
+        assert r0._journal is None and r0._prober is None \
+            and r0._hedge is None, "disabled router built failover state"
+        assert not s0.watchdog_enabled and s0._watchdog_thread is None
+        st, body = post(r0.address, "/worker_generate",
+                        {"prompt_ids": [int(t) for t in prompts[0]],
+                         "max_new_tokens": 2})
+        assert st == 200, body
+        if obs.enabled():
+            new = "\n".join(set(obs.render().splitlines()) - before)
+            for name in ("bigdl_router_failovers_total",
+                         "bigdl_router_hedges_total",
+                         "bigdl_router_journal_inflight",
+                         "bigdl_router_backend_healthy"):
+                assert name not in new, \
+                    f"disabled mode grew metric series {name}"
+        assert not [t for t in threading.enumerate()
+                    if t.name == "bigdl-router-prober"], \
+            "disabled mode started a prober thread"
+    finally:
+        r0.stop()
+        w0.stop()
+        s0.stop()
+
+    # --- the storm: kills mid-stream + a watchdog-tripping stall
+    was_enabled = rel.enabled()
+    if not was_enabled:
+        rel.enable()
+    # watchdog above the warmed per-step time but under the stall; the
+    # engines are warmed below so compiles don't masquerade as stalls
+    s1 = LLMServer(model, max_batch=2, max_seq_len=64, page_size=8,
+                   kvcache=True, watchdog_timeout=0.6).start()
+    s2 = LLMServer(model, max_batch=2, max_seq_len=64, page_size=8,
+                   kvcache=True, watchdog_timeout=0.6).start()
+    w1 = LLMWorker(s1, role="decode").start()
+    w2 = LLMWorker(s2, role="decode").start()
+    router = LLMRouter([], [w1.address, w2.address], failover=True,
+                       failover_attempts=8, start_prober=False).start()
+    try:
+        # warm EVERY shape the storm will hit on both engines: the
+        # first submit compiles the full prefill + decode steps, the
+        # second hits the radix index it just seeded and compiles the
+        # partial-prefill suffix shape — the same shape every
+        # journal resume (prompt + generated, suffix re-prefill) uses.
+        # An unwarmed compile stalls the heartbeat exactly like a hung
+        # step and would trip the watchdog on the compile instead of
+        # the injected stall (see LLMServer._watchdog_loop).
+        for srv in (s1, s2):
+            for p in prompts:
+                srv.submit(p, max_new_tokens=1).get(timeout=600)
+                srv.submit(p, max_new_tokens=1).get(timeout=600)
+        plan = rel.FaultPlan(seed=seed)
+        # mid-stream connection kills: each bounded raise tears the
+        # router->worker stream a few drained chunks in (llm.step is
+        # slowed so chunks arrive one token at a time, and the
+        # dispatch site fires once per drained chunk)
+        for k in range(kills):
+            plan.add("router.dispatch", "raise", times=1, after=3 + 2 * k)
+        # a wedged device step, longer than the 0.6 s watchdog: the
+        # victim engine trips mid-generation (the site only fires with
+        # live slots), fails its requests retriably, recovers
+        plan.add("worker.stall", "delay", times=stalls, after=2,
+                 delay=1.5)
+        plan.add("llm.step", "delay", times=None, delay=0.02)
+        rel.set_plan(plan)
+        got = []
+        failures = []
+        try:
+            for j, p in enumerate(prompts):
+                st, body = post(router.address, "/worker_generate",
+                                {"prompt_ids": [int(t) for t in p],
+                                 "max_new_tokens": new_tokens})
+                if st != 200:
+                    failures.append((j, st, body.get("error")))
+                    got.append(None)
+                else:
+                    got.append(body["output_ids"])
+        finally:
+            rel.set_plan(None)
+            if not was_enabled:
+                rel.disable()
+        out = {
+            "seed": seed,
+            "requests": n_requests,
+            "events_fired": [f"{s}:{a}" for s, a in plan.fired],
+            "failovers": router.failovers,
+            "tokens_resumed": router.tokens_resumed,
+            "watchdog_trips": s1.watchdog_trips + s2.watchdog_trips,
+            "lost_requests": len(failures),
+            "match": got == want,
+        }
+        if failures:
+            raise AssertionError(
+                f"failover chaos lost {len(failures)} request(s) "
+                f"(fired: {out['events_fired']}): {failures}")
+        if not any(s == "router.dispatch" for s, _ in plan.fired):
+            raise AssertionError(
+                "failover chaos armed but no router.dispatch kill "
+                "fired — widen the kill windows")
+        if router.failovers == 0:
+            raise AssertionError(
+                "failover chaos completed without a single failover — "
+                "the kills landed outside the streams")
+        if router.tokens_resumed == 0:
+            raise AssertionError(
+                "every failover restarted from scratch — no resume "
+                "carried drained tokens, so the journal's "
+                "suffix-resume path never ran")
+        if got != want:
+            raise AssertionError(
+                f"failover chaos divergence (fired: "
+                f"{out['events_fired']}): {got} vs {want}")
+        return out
+    finally:
+        router.stop()
+        w1.stop()
+        w2.stop()
+        s1.stop()
+        s2.stop()
+
+
+def run_all_chaos(seed: int = 0) -> dict:
+    """Every chaos suite, one record per pass (the ``chaos_all``
+    telemetry block in ``bench.py``). Each pass asserts its own
+    parity contract; a failing pass lands as an ``error`` entry
+    instead of killing the others."""
+    out = {}
+    for name, fn in (("train", lambda: run_chaos(seed=seed, events=3,
+                                                 smoke=True)),
+                     ("kvcache", lambda: run_kvcache_chaos(seed=seed)),
+                     ("kvtier", lambda: run_kvtier_chaos(seed=seed)),
+                     ("failover", lambda: run_failover_chaos(
+                         seed=seed, smoke=True))):
+        try:
+            out[name] = fn()
+        except Exception as e:  # noqa: BLE001 — one bad suite
+            out[name] = {"error": repr(e)}   # must not hide the rest
+    out["ok"] = all("error" not in v for v in out.values()
+                    if isinstance(v, dict))
+    return out
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--seed", type=int, default=0)
@@ -281,6 +491,15 @@ def main():
                     help="run the host-tier migration-fault pass: "
                          "delayed/failed spills and fetches must keep "
                          "greedy outputs identical (ISSUE 6)")
+    ap.add_argument("--failover", action="store_true",
+                    help="run the router kill-storm pass: mid-stream "
+                         "decode-worker kills and watchdog-tripping "
+                         "engine stalls must lose zero requests with "
+                         "greedy outputs bit-identical (ISSUE 7)")
+    ap.add_argument("--all", action="store_true",
+                    help="run every chaos suite (train, kvcache, "
+                         "kvtier, failover) and report one record per "
+                         "pass (the bench.py chaos_all block)")
     ap.add_argument("--cpu", action="store_true",
                     help="force the CPU backend (sitecustomize pins the "
                          "axon TPU platform; env vars are ineffective)")
@@ -288,7 +507,15 @@ def main():
     if args.cpu:
         import jax
         jax.config.update("jax_platforms", "cpu")
-    if args.kvtier:
+    if args.all:
+        out = run_all_chaos(seed=args.seed)
+        print(json.dumps(out, indent=1))
+        if not out["ok"]:
+            sys.exit(1)
+        return
+    if args.failover:
+        out = run_failover_chaos(seed=args.seed)
+    elif args.kvtier:
         out = run_kvtier_chaos(seed=args.seed)
     elif args.kvcache:
         out = run_kvcache_chaos(seed=args.seed)
