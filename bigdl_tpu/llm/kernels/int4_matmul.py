@@ -98,6 +98,8 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from bigdl_tpu.utils.jax_compat import tpu_compiler_params
+
 from bigdl_tpu.llm.ggml.quantize import QK
 
 HALF = QK // 2          # scale-group size within one nibble plane
@@ -272,7 +274,7 @@ def _int4_matmul_jit(x, q_t, scale_t, bm: int, bn: int,
             ],
             out_specs=pl.BlockSpec((bm, bn), lambda i, j: (i, j)),
             out_shape=jax.ShapeDtypeStruct((mp, np_), jnp.float32),
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=tpu_compiler_params(
                 dimension_semantics=("parallel", "parallel")),
             interpret=interpret,
         )(xe, xo, qc, sc)
@@ -319,7 +321,7 @@ def asym_int4_matmul(x, q_t, scale_t, zero_t, bm: int = 128, bn: int = 256,
             ],
             out_specs=pl.BlockSpec((bm, bn), lambda i, j: (i, j)),
             out_shape=jax.ShapeDtypeStruct((mp, np_), jnp.float32),
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=tpu_compiler_params(
                 dimension_semantics=("parallel", "parallel")),
             interpret=interpret,
         )(xe, xo, qc, sc, zc)
@@ -366,7 +368,7 @@ def int8_matmul(x, q_t, scale_t, bm: int = 128, bn: int = 256,
             ],
             out_specs=pl.BlockSpec((bm, bn), lambda i, j: (i, j)),
             out_shape=jax.ShapeDtypeStruct((mp, np_), jnp.float32),
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=tpu_compiler_params(
                 dimension_semantics=("parallel", "parallel")),
             interpret=interpret,
         )(xc, qc, sc)

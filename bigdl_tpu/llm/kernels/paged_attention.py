@@ -38,6 +38,8 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from bigdl_tpu.utils.jax_compat import tpu_compiler_params
+
 LANE = 128          # score-tile lane width: pages per block × page_size
 
 
@@ -326,7 +328,7 @@ def paged_attention_decode(q, k_pages, v_pages, block_tables, lengths,
                               scale=scale, window=sliding_window),
             grid_spec=grid_spec,
             out_shape=jax.ShapeDtypeStruct((b, hkv, gp, d), jnp.float32),
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=tpu_compiler_params(
                 dimension_semantics=("parallel", "arbitrary")),
             interpret=interpret,
         )(lengths.astype(jnp.int32),
@@ -360,7 +362,7 @@ def paged_attention_decode(q, k_pages, v_pages, block_tables, lengths,
                           window=sliding_window),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, hkv, gp, d), jnp.float32),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(lengths.astype(jnp.int32), block_tables.reshape(-1).astype(jnp.int32),
@@ -444,7 +446,7 @@ def paged_attention_decode_stats(q, k_pages, v_pages, block_tables,
                 jax.ShapeDtypeStruct((b, hkv, gp, d), jnp.float32),
                 jax.ShapeDtypeStruct((b, hkv, gp, LANE), jnp.float32),
                 jax.ShapeDtypeStruct((b, hkv, gp, LANE), jnp.float32)],
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=tpu_compiler_params(
                 dimension_semantics=("parallel", "arbitrary")),
             interpret=interpret,
         )(lengths.astype(jnp.int32),
@@ -487,7 +489,7 @@ def paged_attention_decode_stats(q, k_pages, v_pages, block_tables,
         out_shape=[jax.ShapeDtypeStruct((b, hkv, gp, d), jnp.float32),
                    jax.ShapeDtypeStruct((b, hkv, gp, LANE), jnp.float32),
                    jax.ShapeDtypeStruct((b, hkv, gp, LANE), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(lengths.astype(jnp.int32), block_tables.reshape(-1).astype(jnp.int32),
@@ -497,13 +499,39 @@ def paged_attention_decode_stats(q, k_pages, v_pages, block_tables,
             l[:, :, :g, 0].reshape(b, hq))
 
 
+def _sliced_tables(block_tables, lengths, page: int,
+                   max_live_tokens: Optional[int] = None):
+    """Slice the table columns to the LIVE page span before the dense
+    gather. The references gather every ``pages_max × page`` slot, but
+    tables are bucketed to the engine's worst case — on CPU (tier-1
+    tests, the non-TPU serving path) that pads the gather with capacity
+    nobody owns. When ``lengths`` is concrete (tests, tools, host-side
+    callers) or the caller passes a static ``max_live_tokens`` bound,
+    the gather shrinks to ``ceil(max_live / page)`` columns; under a
+    jit trace with no bound, the full table is kept (shapes must stay
+    static). Masking is untouched: every valid position is below the
+    live span by construction."""
+    pages_max = block_tables.shape[1]
+    if max_live_tokens is not None:
+        live = -(-int(max_live_tokens) // page)
+    else:
+        try:
+            live = -(-int(np.max(np.asarray(lengths))) // page)
+        except Exception:       # traced lengths: keep the static shape
+            return block_tables
+    return block_tables[:, :max(1, min(live, pages_max))]
+
+
 def paged_attention_reference_stats(q, k_pages, v_pages, block_tables,
                                     lengths,
-                                    sliding_window: Optional[int] = None):
+                                    sliding_window: Optional[int] = None,
+                                    max_live_tokens: Optional[int] = None):
     """XLA twin of :func:`paged_attention_decode_stats` (same contract)."""
     b, hq, d = q.shape
     p_, hkv, page, _ = k_pages.shape
     g = hq // hkv
+    block_tables = _sliced_tables(block_tables, lengths, page,
+                                  max_live_tokens)
     pages_max = block_tables.shape[1]
     s_max = pages_max * page
     k_all = (k_pages[block_tables].transpose(0, 1, 3, 2, 4)
@@ -575,13 +603,18 @@ def merge_attention_partial(acc, m, l, q, k_new, v_new):
 
 
 def paged_attention_reference(q, k_pages, v_pages, block_tables, lengths,
-                              sliding_window: Optional[int] = None):
+                              sliding_window: Optional[int] = None,
+                              max_live_tokens: Optional[int] = None):
     """XLA gather + masked attention — golden for the kernel and the
     execution path on non-TPU backends. Same contract as
-    :func:`paged_attention_decode`."""
+    :func:`paged_attention_decode`. The gather is sliced to the live
+    page span when the lengths are concrete (see
+    :func:`_sliced_tables`)."""
     b, hq, d = q.shape
     p_, hkv, page, _ = k_pages.shape
     g = hq // hkv
+    block_tables = _sliced_tables(block_tables, lengths, page,
+                                  max_live_tokens)
     pages_max = block_tables.shape[1]
     s_max = pages_max * page
     # gather: (B, maxp, Hkv, page, D) -> (B, S, Hkv, D)

@@ -1,4 +1,20 @@
-"""Partial prefill over a pre-populated block-table prefix (ISSUE 5).
+"""Partial prefill over a pre-populated block-table prefix (ISSUE 5/8).
+
+Two implementations live here:
+
+- the **ragged in-place path** (ISSUE 8, the default): shared closures
+  (:func:`ragged_prefill_attend`, :func:`fork_tail_pages`,
+  :func:`scatter_suffix_kv`) that each family's ``paged_prefill_ragged``
+  composes with its own layer math — the suffix attends the prefix
+  pages WHERE THEY SIT via the Mosaic ragged kernel
+  (llm/kernels/ragged_prefill.py), the COW tail fork is one
+  page-to-page copy inside the same dispatch, and ONE post-scan scatter
+  writes the suffix K/V into the request's pages. No dense temp cache,
+  and the prefix page count is runtime block-table data — the compile
+  grid is O(suffix-buckets) only;
+- the **dense staging path** (:func:`make_partial_prefill`, the ISSUE 5
+  original): kept as the fallback for families without a ragged entry
+  point and for the ``bigdl.llm.prefill.ragged=false`` escape hatch.
 
 When admission finds a cached prefix, only the uncached suffix must run
 through the model — but the suffix's attention still needs the prefix's
@@ -39,8 +55,72 @@ entry point per family, zero per-family math here.
 
 from __future__ import annotations
 
+from typing import Optional
+
 import jax
 import jax.numpy as jnp
+
+
+# ---------------------------------------------------------------------------
+# ragged in-place prefill (ISSUE 8): shared closures for the per-family
+# ``paged_prefill_ragged`` entry points
+# ---------------------------------------------------------------------------
+
+def fork_tail_pages(k_pages, v_pages, fork_dst, fork_src):
+    """COW tail fork, fused into the prefill dispatch: copy the adopted
+    partial tail page (``fork_src``, shared — never written in place)
+    into the page the request owns (``fork_dst``). Runs BEFORE the
+    layer scan so the ragged kernel reads the forked slots through the
+    request's own block table; the suffix scatter then overwrites the
+    slots from ``offset`` on. With no tail both ids are 0 — a trash-
+    page self-copy, semantically a no-op."""
+    k_pages = k_pages.at[:, fork_dst].set(k_pages[:, fork_src])
+    v_pages = v_pages.at[:, fork_dst].set(v_pages[:, fork_src])
+    return k_pages, v_pages
+
+
+def ragged_prefill_attend(k_pages, v_pages, bt_row, offset, seq_len, *,
+                          page: int,
+                          sliding_window: Optional[int] = None,
+                          interpret: Optional[bool] = None):
+    """Shared ragged-attention closure for every family's prefill.
+
+    Mirrors ``serving.paged_attend``'s conventions: the pools are
+    viewed as one flat ``(L·P, H, page, D)`` page array, the block
+    table is offset by ``l·P`` inside the layer scan (layer ``l``'s
+    trash page is ``l·P``), and the kernel reads only prefix positions
+    ``< offset`` (the suffix's own K/V rides in densely — it is not in
+    the pool until the post-scan scatter). Returns
+    ``attend(l, q, k, v) -> (1, Tq, Hq, D) f32`` for suffix-shaped
+    ``(1, Tq, H*, D)`` projections."""
+    from bigdl_tpu.llm.kernels.ragged_prefill import ragged_prefill
+    L, P = k_pages.shape[0], k_pages.shape[1]
+    kp_flat = k_pages.reshape((L * P,) + k_pages.shape[2:])
+    vp_flat = v_pages.reshape((L * P,) + v_pages.shape[2:])
+    bt = bt_row.reshape(1, -1)
+    offs = jnp.reshape(offset, (1,)).astype(jnp.int32)
+    lens = jnp.reshape(seq_len, (1,)).astype(jnp.int32)
+
+    def attend(l, q, k, v):
+        return ragged_prefill(q, k, v, kp_flat, vp_flat, bt + l * P,
+                              offs, lens, page_size=page,
+                              sliding_window=sliding_window,
+                              interpret=interpret)
+
+    return attend
+
+
+def scatter_suffix_kv(k_pages, v_pages, phys, slots, k_new, v_new):
+    """ONE vectorized scatter of every layer's suffix K/V into the
+    (donated) pools — the write half of the old dense sandwich, kept;
+    the gather half is gone. ``k_new``/``v_new`` are the layer-scan ys
+    ``(L, Tq, Hkv, D)``; token ``j`` lands in ``(phys[j], slots[j])``
+    (entries the request must not write route to trash page 0)."""
+    k_pages = k_pages.at[:, phys, :, slots].set(
+        k_new.transpose(1, 0, 2, 3).astype(k_pages.dtype))
+    v_pages = v_pages.at[:, phys, :, slots].set(
+        v_new.transpose(1, 0, 2, 3).astype(v_pages.dtype))
+    return k_pages, v_pages
 
 
 def make_partial_prefill(forward_fn, init_cache_fn):

@@ -891,6 +891,72 @@ from bigdl_tpu.llm.kvcache.prefill import make_partial_prefill  # noqa: E402
 paged_prefill_partial = make_partial_prefill(forward, init_cache)
 
 
+def paged_prefill_ragged(params, cfg, k_pages, v_pages, toks, length,
+                         offset, bt_row, phys, slots, fork_dst,
+                         fork_src, *, page: int):
+    """Ragged in-place prefill (ISSUE 8): the suffix tokens run through
+    the llama layer math while attention reads the cached prefix
+    DIRECTLY from the page pool (llm/kernels/ragged_prefill.py) — no
+    dense temp cache, no prefix gather. Same structure as
+    :func:`serving.paged_decode_step`: rolled layer scan, read-only
+    pools inside the scan, one post-scan scatter into the donated
+    pools; the COW tail fork is a single page copy fused ahead of the
+    scan. ``bt_row`` (pages_cap,), ``offset``/``length`` and the
+    ``phys``/``slots`` scatter targets are all runtime data — the only
+    compile-relevant shape is the suffix bucket ``toks.shape[1]``.
+    Returns ``(k_pages, v_pages, last_logits (V,) f32)``."""
+    from bigdl_tpu.llm.kvcache.prefill import (fork_tail_pages,
+                                               ragged_prefill_attend,
+                                               scatter_suffix_kv)
+    b, bucket = toks.shape                                  # b == 1
+    L = cfg.num_hidden_layers
+    k_pages, v_pages = fork_tail_pages(k_pages, v_pages, fork_dst,
+                                       fork_src)
+    positions = (offset
+                 + jnp.arange(bucket, dtype=jnp.int32))[None]  # (1, Tq)
+    x = params["embed_tokens"][toks]                        # (1, Tq, H)
+    attend = ragged_prefill_attend(k_pages, v_pages, bt_row, offset,
+                                   length, page=page,
+                                   sliding_window=cfg.sliding_window)
+
+    def layer_step(carry, inputs):
+        x, = carry
+        lp, l = inputs
+        h = rms_norm(x, lp["input_layernorm"], cfg.rms_norm_eps)
+        q, k, v = attention_qkv(lp, h, cfg)
+        q = rope_cfg(q, positions, cfg)
+        k = rope_cfg(k, positions, cfg)
+        # attend the suffix K/V at POOL precision — the dense sandwich
+        # attends them from the cache_dtype temp cache, and a later
+        # suffix re-prefill reads them back from the pages, so greedy
+        # bit-parity needs the cast BEFORE attention, not just at the
+        # scatter
+        k = k.astype(k_pages.dtype)
+        v = v.astype(v_pages.dtype)
+        attn = attend(l, q, k, v).astype(x.dtype)
+        x = x + _linear(lp["o_proj"], attn.reshape(b, bucket, -1))
+        h2 = rms_norm(x, lp["post_attention_layernorm"], cfg.rms_norm_eps)
+        if cfg.num_experts:
+            x = x + _moe_ffn(lp, h2, cfg)
+        else:
+            x = x + mlp(lp, h2, x.dtype)
+        return (x,), (k[0], v[0])
+
+    (x,), (k_new, v_new) = jax.lax.scan(
+        layer_step, (x,), (params["layers"], jnp.arange(L)))
+    x = rms_norm(x, params["norm"], cfg.rms_norm_eps)
+    head = params.get("lm_head")
+    if head is None:
+        logits = x @ params["embed_tokens"].T.astype(x.dtype)
+    else:
+        logits = _linear(head, x)
+    k_pages, v_pages = scatter_suffix_kv(k_pages, v_pages, phys, slots,
+                                         k_new, v_new)
+    last = jax.lax.dynamic_index_in_dim(logits[0], length - 1, 0,
+                                        keepdims=False)
+    return k_pages, v_pages, last.astype(jnp.float32)
+
+
 # ---------------------------------------------------------------------------
 # generation facade
 # ---------------------------------------------------------------------------

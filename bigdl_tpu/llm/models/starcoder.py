@@ -242,6 +242,63 @@ from bigdl_tpu.llm.kvcache.prefill import make_partial_prefill  # noqa: E402
 paged_prefill_partial = make_partial_prefill(forward, init_cache)
 
 
+def paged_prefill_ragged(params, cfg, k_pages, v_pages, toks, length,
+                         offset, bt_row, phys, slots, fork_dst,
+                         fork_src, *, page: int):
+    """Ragged in-place prefill (ISSUE 8) — StarCoder's layer math
+    (learned position embeddings, MQA via the kernel's GQA grouping,
+    sequential residual, tied head) over the suffix tokens, attention
+    reading the cached prefix in place; COW fork + one post-scan
+    scatter fused into the same dispatch (see llama.paged_prefill_ragged
+    for the structure)."""
+    from bigdl_tpu.llm.kvcache.prefill import (fork_tail_pages,
+                                               ragged_prefill_attend,
+                                               scatter_suffix_kv)
+    b, bucket = toks.shape                                  # b == 1
+    L = cfg.num_hidden_layers
+    nh, hd = cfg.num_attention_heads, cfg.head_dim
+    kvh = cfg.num_key_value_heads
+    k_pages, v_pages = fork_tail_pages(k_pages, v_pages, fork_dst,
+                                       fork_src)
+    positions = (offset
+                 + jnp.arange(bucket, dtype=jnp.int32))[None]  # (1, Tq)
+    x = (params["wte"][toks]
+         + params["wpe"][positions].astype(params["wte"].dtype))
+    attend = ragged_prefill_attend(k_pages, v_pages, bt_row, offset,
+                                   length, page=page)
+
+    def layer_step(carry, inputs):
+        x, = carry
+        lp, l = inputs
+        h1 = _layer_norm(x, lp["input_layernorm"], cfg.layer_norm_epsilon)
+        q = _linear_b(lp["q_proj"], h1).reshape(b, bucket, nh, hd)
+        k = _linear_b(lp["k_proj"], h1).reshape(b, bucket, kvh, hd)
+        v = _linear_b(lp["v_proj"], h1).reshape(b, bucket, kvh, hd)
+        # pool-precision K/V before attention (bit-parity with the
+        # dense temp-cache path — see llama.paged_prefill_ragged)
+        k = k.astype(k_pages.dtype)
+        v = v.astype(v_pages.dtype)
+        attn = attend(l, q, k, v).astype(x.dtype)
+        x = x + _linear_b(lp["o_proj"], attn.reshape(b, bucket, -1))
+        h2 = _layer_norm(x, lp["post_attention_layernorm"],
+                         cfg.layer_norm_epsilon)
+        mlp = _linear_b(lp["fc_out"], jax.nn.gelu(
+            _linear_b(lp["fc_in"], h2).astype(jnp.float32),
+            approximate=True).astype(x.dtype))
+        x = x + mlp
+        return (x,), (k[0], v[0])
+
+    (x,), (k_new, v_new) = jax.lax.scan(
+        layer_step, (x,), (params["layers"], jnp.arange(L)))
+    x = _layer_norm(x, params["ln_f"], cfg.layer_norm_epsilon)
+    logits = x @ params["wte"].T.astype(x.dtype)
+    k_pages, v_pages = scatter_suffix_kv(k_pages, v_pages, phys, slots,
+                                         k_new, v_new)
+    last = jax.lax.dynamic_index_in_dim(logits[0], length - 1, 0,
+                                        keepdims=False)
+    return k_pages, v_pages, last.astype(jnp.float32)
+
+
 class StarCoderForCausalLM(CausalLMFacade):
     """Generation facade — shared driver (see models._facade)."""
 

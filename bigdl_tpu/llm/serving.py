@@ -377,7 +377,8 @@ class LLMServer:
                  kvcache: Optional[bool] = None,
                  kvtier: Optional[bool] = None,
                  host_pages: Optional[int] = None,
-                 watchdog_timeout: Optional[float] = None):
+                 watchdog_timeout: Optional[float] = None,
+                 ragged_prefill: Optional[bool] = None):
         import inspect
 
         from bigdl_tpu.llm.models.llama import forward, init_cache
@@ -397,6 +398,7 @@ class LLMServer:
             self._fam_paged_step = paged_decode_step
             self._fam_sampled_step = paged_decode_step_sampled
             self._fam_partial_prefill = _llama_mod.paged_prefill_partial
+            self._fam_ragged_prefill = _llama_mod.paged_prefill_ragged
             self._family = "llama"
         else:
             self._fam_forward = fam_forward
@@ -412,6 +414,8 @@ class LLMServer:
                     self._fam_paged_step)
             self._fam_partial_prefill = getattr(
                 fam_mod, "paged_prefill_partial", None)
+            self._fam_ragged_prefill = getattr(
+                fam_mod, "paged_prefill_ragged", None)
             self._family = fam_mod.__name__.rsplit(".", 1)[-1]
             if paged and self._fam_paged_step is None:
                 raise NotImplementedError(
@@ -468,6 +472,11 @@ class LLMServer:
         self.host_seconds = 0.0
         self.stall_seconds = 0.0
         self.prefill_tokens_total = 0
+        # tokens that round-tripped through a dense temp cache during
+        # prefill (the ISSUE 8 staging cost: gathered prefix + slack +
+        # suffix bucket). The ragged in-place path adds ZERO here —
+        # tools/microbench_ragged.py asserts exactly that.
+        self.prefill_dense_staged_tokens = 0
         # ISSUE 3 flight recorder: every jit entry point of the engine
         # is wrapped so compiles/recompiles (the per-length prefill
         # buckets, a batch-width drift on the decode step) are counted,
@@ -522,6 +531,26 @@ class LLMServer:
                 raise NotImplementedError(
                     f"{type(model).__name__} has no partial-prefill "
                     "entry point; the prefix cache needs one per family")
+            # ragged in-place prefill (ISSUE 8): prefill attends cached
+            # prefix pages where they sit via the ragged kernel instead
+            # of staging the context through a dense temp cache. The
+            # default is "auto": ON where the Mosaic kernel runs (TPU),
+            # dense elsewhere — under jit the XLA twin would gather the
+            # full worst-case table per layer, which the dense paths
+            # never did. true/false (conf or ctor) force a path; the
+            # dense path also stays as the per-family fallback
+            # (docs/PERFORMANCE.md "Ragged paged prefill")
+            if ragged_prefill is not None:
+                rag = bool(ragged_prefill)
+            else:
+                rag_conf = str(conf.get("bigdl.llm.prefill.ragged",
+                                        "auto")).lower()
+                if rag_conf == "auto":
+                    import jax as _jax
+                    rag = _jax.default_backend() == "tpu"
+                else:
+                    rag = conf.get_bool("bigdl.llm.prefill.ragged")
+            self._ragged = rag and self._fam_ragged_prefill is not None
             self._kv = KVCacheManager(self._num_pages, page_size,
                                       enabled=bool(kv_on))
             # host spill tier (ISSUE 6): constructed ONLY when enabled —
@@ -1253,10 +1282,49 @@ class LLMServer:
         return obs.compiled(build, name="llm/prefill_paged",
                             donate_argnums=(1, 2))
 
+    def _finish_prefill(self, i: int, req: Request, row_pages, own,
+                        last, pins, adm=None):
+        """Shared epilogue of the three paged prefill paths (full /
+        dense-partial / ragged): pin every buffer the dispatch consumed
+        (the PR 4 buffer-lifetime invariant, docs/PERFORMANCE.md), land
+        the slot's block table + length host- and device-side,
+        reproduce the synchronous cadence at depth 1, drop the
+        admission's transient tail ref (consumed in program order by
+        the dispatch), then hand the slot to the request. ONE copy so a
+        fix to the pin set or barrier cadence cannot drift between the
+        paths."""
+        self._pin(*pins, last, self._last, self._bt_dev, self._lens_dev)
+        self._last = self._last.at[i].set(last)
+        T = len(req.prompt_ids)
+        npages = len(row_pages)
+        self._bt[i, :] = 0
+        self._bt[i, :npages] = row_pages
+        self._lens[i] = T
+        row = np.zeros(self._pages_cap, np.int32)
+        row[:npages] = row_pages
+        row_d = jnp.asarray(row)
+        self._pin(row_d)
+        self._bt_dev = self._bt_dev.at[i].set(row_d)
+        self._lens_dev = self._lens_dev.at[i].set(T)
+        if self.pipeline_depth == 1:
+            _sync_barrier(self._k_pages, self._v_pages, self._last,
+                          self._bt_dev, self._lens_dev)
+            self._pending_release.clear()
+        if adm is not None:
+            self._kv.release_transient(adm)
+        self._slot_pages[i] = own
+        self._slots[i] = req
+        self._remaining[i] = req.max_new_tokens
+        self._index_prompt(i, req)
+
     def _prefill_paged(self, i: int, req: Request):
-        # the slot's admission grant was stored by _admit; a cached
-        # prefix routes to the suffix-only partial prefill
+        # the slot's admission grant was stored by _admit; the ragged
+        # in-place path (ISSUE 8) serves BOTH the full and the
+        # partial-prefix case — offset is runtime data there; the
+        # dense-staging paths below are the fallback
         adm = self._slot_adm[i]
+        if self._ragged:
+            return self._prefill_ragged(i, req, adm)
         if adm is not None and adm.matched_len:
             return self._prefill_paged_partial(i, req, adm)
         t = len(req.prompt_ids)
@@ -1280,32 +1348,13 @@ class LLMServer:
             self._k_pages, self._v_pages, last = fn(
                 self.model.params, self._k_pages, self._v_pages,
                 toks_d, t_d, pids_d)
+            self.prefill_dense_staged_tokens += bucket
         except BaseException:
             self._kv.free_owned(ids)   # physical pages must not leak
             raise
-        # same async-dispatch buffer-lifetime contract as _prefill_slot:
-        # pin everything the prefill + scatter dispatches consume, then
-        # barrier only at depth 1 (the synchronous engine's behavior)
-        self._pin(toks_d, t_d, pids_d, last, self._last, self._bt_dev,
-                  self._lens_dev)
-        self._last = self._last.at[i].set(last)
-        self._bt[i, :] = 0
-        self._bt[i, :npages] = ids
-        self._lens[i] = t
-        row = np.zeros(self._pages_cap, np.int32)
-        row[:npages] = ids
-        row_d = jnp.asarray(row)
-        self._pin(row_d)
-        self._bt_dev = self._bt_dev.at[i].set(row_d)
-        self._lens_dev = self._lens_dev.at[i].set(t)
-        if self.pipeline_depth == 1:
-            _sync_barrier(self._k_pages, self._v_pages, self._last,
-                          self._bt_dev, self._lens_dev)
-            self._pending_release.clear()
-        self._slot_pages[i] = ids
-        self._slots[i] = req
-        self._remaining[i] = req.max_new_tokens
-        self._index_prompt(i, req)
+        # shared epilogue: pin + slot bookkeeping + depth-1 barrier
+        self._finish_prefill(i, req, ids, ids, last,
+                             (toks_d, t_d, pids_d))
 
     def _build_partial_prefill(self, n_pp: int, bucket: int):
         """Compile the family's partial prefill for one (prefix-pages,
@@ -1375,34 +1424,100 @@ class LLMServer:
             self._k_pages, self._v_pages, last = fn(
                 self.model.params, self._k_pages, self._v_pages,
                 toks_d, len_d, off_d, pids_d, phys_d, slots_d)
+            # the dense sandwich staged the gathered prefix + one page
+            # of slack + the suffix bucket through a temp cache
+            self.prefill_dense_staged_tokens += n_pp * page + page \
+                + bucket
         except BaseException:
             self._kv.free_owned(own)
             raise
-        self._pin(toks_d, len_d, off_d, pids_d, phys_d, slots_d, last,
-                  self._last, self._bt_dev, self._lens_dev)
-        self._last = self._last.at[i].set(last)
-        npages = len(row_pages)
-        self._bt[i, :] = 0
-        self._bt[i, :npages] = row_pages
-        self._lens[i] = T
-        row = np.zeros(self._pages_cap, np.int32)
-        row[:npages] = row_pages
-        row_d = jnp.asarray(row)
-        self._pin(row_d)
-        self._bt_dev = self._bt_dev.at[i].set(row_d)
-        self._lens_dev = self._lens_dev.at[i].set(T)
-        if self.pipeline_depth == 1:
-            _sync_barrier(self._k_pages, self._v_pages, self._last,
-                          self._bt_dev, self._lens_dev)
-            self._pending_release.clear()
-        # the dispatch consumed the tail source in order; its transient
-        # ref/pin can drop now (the donated-pool dependency chain orders
-        # any later overwrite after the gather)
-        self._kv.release_transient(adm)
-        self._slot_pages[i] = own
-        self._slots[i] = req
-        self._remaining[i] = req.max_new_tokens
-        self._index_prompt(i, req)
+        # shared epilogue; the dispatch consumed the tail source in
+        # order, so _finish_prefill drops its transient ref/pin (the
+        # donated-pool dependency chain orders any later overwrite
+        # after the gather)
+        self._finish_prefill(i, req, row_pages, own, last,
+                             (toks_d, len_d, off_d, pids_d, phys_d,
+                              slots_d), adm=adm)
+
+    def _build_ragged_prefill(self, bucket: int):
+        """Compile the family's ragged in-place prefill for ONE suffix
+        bucket (ISSUE 8). Prefix pages, the position offset and the
+        scatter targets are all runtime arguments — unlike the dense
+        partial prefill there is no ``n_pp`` in the static shape, so
+        the compile grid is O(suffix-buckets) (guarded by the
+        compile-recorder regression test)."""
+        cfg, page = self.cfg, self._page
+        fam = self._fam_ragged_prefill
+
+        def build(params, k_pages, v_pages, toks, length, offset,
+                  bt_row, phys, slots, fork_dst, fork_src):
+            return fam(params, cfg, k_pages, v_pages, toks, length,
+                       offset, bt_row, phys, slots, fork_dst, fork_src,
+                       page=page)
+
+        return obs.compiled(build, name="llm/prefill_ragged",
+                            donate_argnums=(1, 2))
+
+    def _prefill_ragged(self, i: int, req: Request, adm):
+        """Prefill in place on the page pool (ISSUE 8): the suffix runs
+        at position offset ``matched_len`` while attention reads the
+        adopted prefix pages through the block table — no dense temp
+        cache, no prefix gather/scatter. One program serves the full-
+        prefill (offset 0) and every partial-prefix case, including
+        tier re-prefills (a materialized fetch is indistinguishable
+        from a device prefix hit by the time prefill runs). The COW
+        tail fork is a single page copy fused ahead of the layer scan."""
+        page = self._page
+        T = len(req.prompt_ids)
+        off = adm.matched_len if adm is not None else 0
+        koff = off // page
+        shared = list(adm.shared_pages) if adm is not None else []
+        own = self._kv.alloc(-(-T // page) - koff)
+        try:
+            row_pages = shared + own
+            tail = adm is not None and adm.tail_src is not None
+            t_suf = T - off
+            bucket = max(page, 1 << (t_suf - 1).bit_length())  # pow2
+            key = self._step_cache_key() + ("prefill_ragged", bucket)
+            fn = _PAGED_STEP_CACHE.get(key)
+            if fn is None:
+                fn = _PAGED_STEP_CACHE[key] = \
+                    self._build_ragged_prefill(bucket)
+            toks = np.zeros((1, bucket), np.int32)
+            toks[0, :t_suf] = req.prompt_ids[off:]
+            bt_row = np.zeros(self._pages_cap, np.int32)
+            bt_row[:len(row_pages)] = row_pages
+            # scatter targets for the suffix window [off, off+bucket):
+            # token j lands in (phys[j], slots[j]); positions past the
+            # true prompt route to trash page 0
+            pos = off + np.arange(bucket)
+            phys = np.where(pos < T,
+                            bt_row[np.minimum(pos // page,
+                                              self._pages_cap - 1)],
+                            0).astype(np.int32)
+            slots = (pos % page).astype(np.int32)
+            toks_d = jnp.asarray(toks)
+            len_d = jnp.asarray(t_suf, jnp.int32)
+            off_d = jnp.asarray(off, jnp.int32)
+            bt_d = jnp.asarray(bt_row)
+            phys_d = jnp.asarray(phys)
+            slots_d = jnp.asarray(slots)
+            fork_dst = jnp.asarray(own[0] if tail else 0, jnp.int32)
+            fork_src = jnp.asarray(adm.tail_src if tail else 0,
+                                   jnp.int32)
+            self._k_pages, self._v_pages, last = fn(
+                self.model.params, self._k_pages, self._v_pages,
+                toks_d, len_d, off_d, bt_d, phys_d, slots_d, fork_dst,
+                fork_src)
+        except BaseException:
+            self._kv.free_owned(own)
+            raise
+        # shared epilogue; the fork copy consumed the tail source in
+        # dispatch order, so the transient ref/pin drops there (same
+        # argument as the dense path's gather)
+        self._finish_prefill(i, req, row_pages, own, last,
+                             (toks_d, len_d, off_d, bt_d, phys_d,
+                              slots_d, fork_dst, fork_src), adm=adm)
 
     def _index_prompt(self, i: int, req: Request):
         """Make this request's FULL prompt pages reusable immediately

@@ -53,6 +53,14 @@ _DEFAULTS: Dict[str, str] = {
     # prefix-aware KV cache (ISSUE 5): radix-indexed page reuse with
     # refcounts + COW. false = the pre-kvcache engine exactly
     "bigdl.llm.kvcache.enabled": "false",
+    # ragged in-place prefill (ISSUE 8): prefill attends cached prefix
+    # pages where they sit (Mosaic ragged kernel) instead of staging
+    # the context through a dense temp cache. auto = on where the
+    # Mosaic kernel runs (TPU), dense elsewhere (the XLA twin would
+    # gather the full worst-case table per layer under jit); true/false
+    # force a path on any backend. false = the dense-staging prefill
+    # paths exactly
+    "bigdl.llm.prefill.ragged": "auto",
     # tiered KV cache (ISSUE 6): evicted chains spill to a pinned
     # host-RAM arena with async HBM<->host migration. Requires the
     # prefix cache; false = structurally absent (PR 5 engine exactly)

@@ -23,3 +23,12 @@ def axis_size(axis_name: str):
     if fn is not None:
         return fn(axis_name)
     return lax.psum(1, axis_name)
+
+
+def tpu_compiler_params(**kwargs):
+    """``pltpu.CompilerParams`` on current jax, ``TPUCompilerParams`` on
+    the 0.4.x line (same fields — the class was renamed in place)."""
+    from jax.experimental.pallas import tpu as pltpu
+    cls = getattr(pltpu, "CompilerParams", None) \
+        or pltpu.TPUCompilerParams
+    return cls(**kwargs)

@@ -51,6 +51,11 @@ def pytest_configure(config):
         "failover: request-level failover / hedged dispatch / engine "
         "watchdog tests (router journal+resume parity; select with "
         "-m failover)")
+    config.addinivalue_line(
+        "markers",
+        "kernels: Pallas/Mosaic kernel family tests (paged decode + "
+        "ragged prefill interpret-mode parity vs the XLA references; "
+        "select with -m kernels)")
 
 
 @pytest.fixture(scope="session")

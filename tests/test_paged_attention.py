@@ -1,7 +1,9 @@
-"""Paged KV-cache attention kernel (ref: the vLLM paged-attention row of
-SURVEY.md §2.2/§2.8 — serving's ragged attention). Golden parity: the
-Mosaic kernel (interpret mode on CPU) and the XLA gather reference are
-both checked against an independent numpy softmax."""
+"""Paged KV-cache attention kernels (ref: the vLLM paged-attention row
+of SURVEY.md §2.2/§2.8 — serving's ragged attention). Golden parity: the
+Mosaic kernels (interpret mode on CPU) and the XLA references are both
+checked against independent numpy softmaxes — decode here since PR 5,
+the ISSUE 8 ragged paged-PREFILL kernel below
+(:class:`TestRaggedPrefill`)."""
 
 import numpy as np
 import pytest
@@ -10,6 +12,10 @@ import jax.numpy as jnp
 
 from bigdl_tpu.llm.kernels.paged_attention import (
     LANE, paged_attention_decode, paged_attention_reference)
+from bigdl_tpu.llm.kernels.ragged_prefill import (
+    ragged_prefill_attention, ragged_prefill_reference)
+
+pytestmark = pytest.mark.kernels
 
 
 def _naive(q, k_pages, v_pages, bt, lens, bi, window=None):
@@ -154,3 +160,166 @@ class TestPagedAttention:
                 jnp.asarray(bt), jnp.asarray(lens), page_size=16,
                 interpret=True)
         assert LANE == 128
+
+    def test_reference_gather_sliced_to_live_span(self):
+        """The ISSUE 8 small fix: with concrete lengths the reference
+        gathers only the live page span, not the padded table capacity
+        — and the sliced result matches the full gather (to float
+        rounding: the softmax reduction width shrinks with the slice)."""
+        from bigdl_tpu.llm.kernels.paged_attention import _sliced_tables
+        rs = np.random.RandomState(6)
+        q, kp, vp, bt, lens = _setup(rs, 2, 4, 4, 128, 16, 64, 16)
+        lens = np.minimum(lens, 40)        # live span: 3 of 16 pages
+        sliced = _sliced_tables(jnp.asarray(bt), jnp.asarray(lens), 16)
+        assert sliced.shape[1] == -(-int(lens.max()) // 16)
+        args = (jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp))
+        full = np.asarray(paged_attention_reference(
+            *args, jnp.asarray(bt), jnp.asarray(lens),
+            max_live_tokens=16 * 16))      # bound = capacity: no slice
+        got = np.asarray(paged_attention_reference(
+            *args, jnp.asarray(bt), jnp.asarray(lens)))
+        np.testing.assert_allclose(got, full, rtol=1e-6, atol=1e-6)
+        # traced lengths keep the static shape (jit safety)
+        import jax
+        traced = jax.eval_shape(
+            lambda t: _sliced_tables(jnp.asarray(bt), t, 16),
+            jax.ShapeDtypeStruct(lens.shape, jnp.int32))
+        assert traced.shape == bt.shape
+
+
+# ---------------------------------------------------------------------------
+# ragged paged-PREFILL kernel (ISSUE 8)
+# ---------------------------------------------------------------------------
+
+def _naive_ragged(q, k_suf, v_suf, kp, vp, bt, offs, lens, bi,
+                  window=None):
+    """Independent numpy golden: row ``bi``'s suffix queries attend the
+    prefix gathered from pages (positions < offs) plus the dense suffix
+    K/V, causally at positions offs + j."""
+    P, Hkv, page, D = kp.shape
+    B, Tq, Hq, _ = q.shape
+    off, sl = int(offs[bi]), int(lens[bi])
+    maxp = bt.shape[1]
+    ks = kp[bt[bi]].transpose(0, 2, 1, 3).reshape(maxp * page, Hkv, D)
+    vs = vp[bt[bi]].transpose(0, 2, 1, 3).reshape(maxp * page, Hkv, D)
+    k_all = np.concatenate([ks[:off], k_suf[bi, :sl]], 0)
+    v_all = np.concatenate([vs[:off], v_suf[bi, :sl]], 0)
+    out = np.zeros((sl, Hq, D))
+    for j in range(sl):
+        qpos = off + j
+        lo = max(0, qpos + 1 - window) if window else 0
+        for h in range(Hq):
+            hk = h // (Hq // Hkv)
+            kh, vh = k_all[lo:qpos + 1, hk], v_all[lo:qpos + 1, hk]
+            sc = (q[bi, j, h] @ kh.T) / np.sqrt(D)
+            w = np.exp(sc - sc.max())
+            w /= w.sum()
+            out[j, h] = w @ vh
+    return out
+
+
+def _setup_ragged(rs, B, Tq, Hq, Hkv, D, page, P, maxp, offs, lens):
+    q = rs.randn(B, Tq, Hq, D).astype(np.float32)
+    k_suf = rs.randn(B, Tq, Hkv, D).astype(np.float32)
+    v_suf = rs.randn(B, Tq, Hkv, D).astype(np.float32)
+    kp = rs.randn(P, Hkv, page, D).astype(np.float32)
+    vp = rs.randn(P, Hkv, page, D).astype(np.float32)
+    bt = rs.permutation(P)[:B * maxp].reshape(B, maxp).astype(np.int32)
+    args = tuple(jnp.asarray(a) for a in
+                 (q, k_suf, v_suf, kp, vp, bt,
+                  np.asarray(offs, np.int32), np.asarray(lens, np.int32)))
+    return (q, k_suf, v_suf, kp, vp, bt, np.asarray(offs, np.int32),
+            np.asarray(lens, np.int32)), args
+
+
+class TestRaggedPrefill:
+    # offsets mix a page boundary (32), mid-page (17) and zero (the
+    # full-prefill case: no page block contributes); lens are ragged
+    OFFS = (32, 17, 0)
+    LENS = (12, 7, 9)
+
+    def test_reference_matches_naive(self):
+        # GQA (Hq=4, Hkv=2) subsumes the MHA head mapping in the naive
+        # check; the kernel test below keeps both combos
+        Hq, Hkv = 4, 2
+        rs = np.random.RandomState(10)
+        raw, args = _setup_ragged(rs, 3, 12, Hq, Hkv, 128, 16, 32, 8,
+                                  self.OFFS, self.LENS)
+        ref = np.asarray(ragged_prefill_reference(*args))
+        for bi in range(3):
+            sl = self.LENS[bi]
+            np.testing.assert_allclose(
+                ref[bi, :sl], _naive_ragged(*raw, bi),
+                rtol=2e-5, atol=2e-5)
+
+    @pytest.mark.parametrize("Hq,Hkv", [(4, 4), (4, 2)])
+    def test_kernel_interpret_matches_reference(self, Hq, Hkv):
+        rs = np.random.RandomState(11)
+        _, args = _setup_ragged(rs, 3, 12, Hq, Hkv, 128, 16, 32, 8,
+                                self.OFFS, self.LENS)
+        ker = np.asarray(ragged_prefill_attention(
+            *args, page_size=16, interpret=True))
+        ref = np.asarray(ragged_prefill_reference(*args))
+        for bi in range(3):
+            sl = self.LENS[bi]
+            np.testing.assert_allclose(ker[bi, :sl], ref[bi, :sl],
+                                       rtol=2e-3, atol=2e-3)
+
+    def test_sliding_window(self):
+        rs = np.random.RandomState(12)
+        win = 24
+        raw, args = _setup_ragged(rs, 2, 12, 4, 4, 128, 16, 32, 8,
+                                  (48, 21), (12, 5))
+        ref = np.asarray(ragged_prefill_reference(
+            *args, sliding_window=win))
+        for bi in range(2):
+            sl = int(raw[7][bi])
+            np.testing.assert_allclose(
+                ref[bi, :sl], _naive_ragged(*raw, bi, window=win),
+                rtol=2e-5, atol=2e-5)
+        ker = np.asarray(ragged_prefill_attention(
+            *args, page_size=16, interpret=True, sliding_window=win))
+        for bi in range(2):
+            sl = int(raw[7][bi])
+            np.testing.assert_allclose(ker[bi, :sl], ref[bi, :sl],
+                                       rtol=2e-3, atol=2e-3)
+
+    def test_head_dim_padding(self):
+        """d = 64 < 128: the kernel zero-pads the minor dim for the
+        Mosaic DMA alignment and slices it back off."""
+        rs = np.random.RandomState(13)
+        raw, args = _setup_ragged(rs, 2, 8, 4, 2, 64, 16, 32, 8,
+                                  (16, 5), (8, 3))
+        ker = ragged_prefill_attention(*args, page_size=16,
+                                       interpret=True)
+        assert ker.shape[-1] == 64
+        ref = np.asarray(ragged_prefill_reference(*args))
+        for bi in range(2):
+            sl = int(raw[7][bi])
+            np.testing.assert_allclose(
+                np.asarray(ker)[bi, :sl], ref[bi, :sl],
+                rtol=2e-3, atol=2e-3)
+            np.testing.assert_allclose(
+                ref[bi, :sl], _naive_ragged(*raw, bi),
+                rtol=2e-5, atol=2e-5)
+
+    def test_all_masked_rows_finite(self):
+        """Query rows past ``seq_lens`` (incl. a fully idle row with
+        len 0) see every score masked — the contract is finite garbage,
+        never NaN, so the engine can slice without sanitizing."""
+        rs = np.random.RandomState(14)
+        _, args = _setup_ragged(rs, 2, 8, 4, 4, 128, 16, 32, 8,
+                                (32, 0), (3, 0))
+        for out in (ragged_prefill_attention(*args, page_size=16,
+                                             interpret=True),
+                    ragged_prefill_reference(*args)):
+            assert bool(jnp.all(jnp.isfinite(out)))
+
+    def test_pages_max_contract(self):
+        rs = np.random.RandomState(15)
+        _, args = _setup_ragged(rs, 1, 8, 4, 4, 128, 16, 32, 6,
+                                (16,), (8,))
+        with pytest.raises(ValueError, match="multiple"):
+            # pages_max=6 is not a multiple of LANE//16 = 8
+            ragged_prefill_attention(*args, page_size=16,
+                                     interpret=True)

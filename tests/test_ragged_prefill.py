@@ -1,0 +1,271 @@
+"""Ragged in-place prefill through the ENGINE (ISSUE 8): greedy
+bit-parity ragged vs the dense-staging path vs the plain ``generate``
+golden — pipeline depths 1/2/4, prefix cache on/off, the COW tail fork,
+tier re-prefills — plus the compile-grid regression the ragged path
+exists to buy: partial-prefill signatures are O(suffix-buckets),
+independent of how many prefix-page buckets the traffic mixes.
+(Kernel-level interpret parity lives in tests/test_paged_attention.py.)
+"""
+
+import numpy as np
+import pytest
+
+from bigdl_tpu.llm.models.llama import LlamaConfig, LlamaForCausalLM
+from bigdl_tpu.llm.serving import LLMServer
+
+pytestmark = pytest.mark.kernels
+
+PAGE = 8
+
+
+@pytest.fixture(scope="module")
+def model():
+    return LlamaForCausalLM.from_config(LlamaConfig.tiny(), seed=0,
+                                        max_cache_len=128)
+
+
+def _generate(model, p, n):
+    return model.generate(np.asarray(p)[None], max_new_tokens=n)[0, len(p):]
+
+
+def _serve(model, prompts, lens, *, ragged, replay=1, max_seq_len=64,
+           **kw):
+    """Run the workload ``replay`` times through one server; return the
+    LAST pass's outputs plus the staging/prefix counters."""
+    srv = LLMServer(model, max_batch=2, max_seq_len=max_seq_len,
+                    page_size=PAGE, ragged_prefill=ragged, **kw).start()
+    try:
+        for _ in range(replay):
+            got = [r.get(timeout=600) for r in
+                   [srv.submit(p, max_new_tokens=n)
+                    for p, n in zip(prompts, lens)]]
+        return got, srv.prefill_dense_staged_tokens, srv
+    finally:
+        srv.stop()
+
+
+def _workload():
+    rs = np.random.RandomState(8)
+    shared = rs.randint(0, 250, 20).astype(np.int32)      # 2.5 pages:
+    prompts = [np.concatenate(                            # COW tail fork
+        [shared, rs.randint(0, 250, 1 + j).astype(np.int32)])
+        for j in range(4)]
+    prompts.append(rs.randint(0, 250, 7).astype(np.int32))  # disjoint
+    return prompts, [4, 3, 5, 2, 4]
+
+
+# computed once and shared across the parametrized matrix (dense-engine
+# behavior does not vary with the ragged flag, and its depth coverage
+# already lives in tests/test_kvcache.py / test_llm_serving.py — only
+# the RAGGED side needs the full depth sweep here)
+_REF_CACHE = {}
+
+
+def _references(model, kvcache):
+    if kvcache not in _REF_CACHE:
+        prompts, lens = _workload()
+        golden = [_generate(model, p, n) for p, n in zip(prompts, lens)]
+        dense, staged_dense, _ = _serve(
+            model, prompts, lens, ragged=False, replay=2,
+            kvcache=kvcache, pipeline_depth=1)
+        assert staged_dense > 0        # the sandwich really staged
+        _REF_CACHE[kvcache] = (golden, dense)
+    return _REF_CACHE[kvcache]
+
+
+class TestEngineParity:
+    """The acceptance matrix: ragged outputs must be bit-identical to
+    the dense-staging engine AND the plain generate golden, and the
+    ragged path must stage ZERO tokens through a dense temp cache."""
+
+    # tier-1 keeps the full depth sweep with the cache ON (the ragged
+    # path's reason to exist) plus the cache-off representative at
+    # depth 1; the cache-off × pipelined corners ride the slow suite
+    @pytest.mark.parametrize("kvcache,depth", [
+        pytest.param(True, 1), pytest.param(True, 2),
+        pytest.param(True, 4), pytest.param(False, 1),
+        pytest.param(False, 2, marks=pytest.mark.slow),
+        pytest.param(False, 4, marks=pytest.mark.slow)])
+    def test_parity_vs_dense_and_golden(self, model, depth, kvcache):
+        prompts, lens = _workload()
+        want, dense = _references(model, kvcache)
+        rag, staged_rag, srv = _serve(
+            model, prompts, lens, ragged=True, replay=2,
+            kvcache=kvcache, pipeline_depth=depth)
+        for j, (r, d, w) in enumerate(zip(rag, dense, want)):
+            np.testing.assert_array_equal(np.asarray(r), np.asarray(d),
+                                          err_msg=f"request {j}")
+            np.testing.assert_array_equal(np.asarray(r), w,
+                                          err_msg=f"request {j}")
+        assert staged_rag == 0         # the ragged path never stages
+        if kvcache:
+            assert srv._kv.hits > 0    # replay actually hit the prefix
+            assert srv.prefix_tokens_saved > 0
+
+    # one family in tier-1 guards the nonzero-offset layer-scan shape;
+    # the second rides the slow suite (same structure, MQA/wpe variant)
+    @pytest.mark.parametrize("family", [
+        "gptneox", pytest.param("starcoder", marks=pytest.mark.slow)])
+    def test_family_partial_offset_parity(self, family):
+        """The hand-written NeoX/StarCoder ragged layer scans at a
+        NONZERO runtime offset — mid-page prefix (COW tail fork),
+        position-dependent math (partial rotary / learned wpe) past the
+        offset: ragged must match the facade golden with zero dense
+        staging (dense == golden for these families is already held by
+        test_kvcache's family test, so only the ragged side runs)."""
+        if family == "gptneox":
+            from bigdl_tpu.llm.models.gptneox import (
+                GptNeoXConfig as C, GptNeoXForCausalLM as M)
+        else:
+            from bigdl_tpu.llm.models.starcoder import (
+                StarCoderConfig as C, StarCoderForCausalLM as M)
+        fam_model = M.from_config(C.tiny(), seed=0, max_cache_len=64)
+        rs = np.random.RandomState(5)
+        shared = rs.randint(0, 250, 20).astype(np.int32)  # 2.5 pages
+        prompts = [np.concatenate(
+            [shared, rs.randint(0, 250, 2 + j).astype(np.int32)])
+            for j in range(2)]
+        lens = [3, 3]
+        want = [_generate(fam_model, p, n)
+                for p, n in zip(prompts, lens)]
+        rag, staged_rag, srv = _serve(
+            fam_model, prompts, lens, ragged=True, replay=2,
+            kvcache=True, max_seq_len=48)
+        for j, (r, w) in enumerate(zip(rag, want)):
+            np.testing.assert_array_equal(np.asarray(r), w,
+                                          err_msg=f"request {j}")
+        assert srv._kv.hits > 0          # offsets were really nonzero
+        assert staged_rag == 0
+
+    def test_tier_reprefill_parity(self, model):
+        """ISSUE 6 composition: chains spilled to the host arena are
+        re-adopted by admission and attended WHERE THEY LAND — the tier
+        re-prefill rides the same ragged path (zero dense staging) and
+        stays bit-exact."""
+        from bigdl_tpu.utils.conf import conf
+        rs = np.random.RandomState(23)
+        groups = [rs.randint(0, 250, 16).astype(np.int32)
+                  for _ in range(4)]
+        prompts = [np.concatenate(
+            [groups[j % 4], rs.randint(0, 250, 1 + j % 4)
+             .astype(np.int32)]) for j in range(8)]
+        lens = [int(rs.randint(1, 5)) for _ in prompts]
+        want = [_generate(model, p, n) for p, n in zip(prompts, lens)]
+        conf.set("bigdl.llm.kvtier.sync", "true")
+        try:
+            got, staged, srv = _serve(
+                model, prompts, lens, ragged=True, num_pages=9,
+                kvcache=True, kvtier=True, host_pages=32)
+            spills, fetches = srv._tier.spills, srv._tier.fetches
+        finally:
+            conf.unset("bigdl.llm.kvtier.sync")
+        for j, (g, w) in enumerate(zip(got, want)):
+            np.testing.assert_array_equal(np.asarray(g), w,
+                                          err_msg=f"request {j}")
+        assert spills > 0 and fetches > 0   # the tier actually cycled
+        assert staged == 0
+
+
+class TestAutoResolution:
+    def test_auto_is_dense_off_tpu_overrides_win(self, model):
+        """`bigdl.llm.prefill.ragged=auto` (default) resolves by
+        backend — dense here (CPU: the XLA twin would gather the full
+        worst-case table per layer under jit); an explicit ctor arg or
+        conf true/false forces the path."""
+        from bigdl_tpu.utils.conf import conf
+        kw = dict(max_batch=2, max_seq_len=64, page_size=PAGE,
+                  kvcache=True)
+        srv = LLMServer(model, **kw)
+        assert srv._ragged is False               # auto, cpu backend
+        srv.stop()
+        srv = LLMServer(model, ragged_prefill=True, **kw)
+        assert srv._ragged is True                # ctor override
+        srv.stop()
+        conf.set("bigdl.llm.prefill.ragged", "true")
+        try:
+            srv = LLMServer(model, **kw)
+            assert srv._ragged is True            # conf override
+            srv.stop()
+        finally:
+            conf.unset("bigdl.llm.prefill.ragged")
+
+
+class TestCompileGrid:
+    def test_partial_prefill_signatures_o_suffix_buckets(self, model):
+        """The logarithmic-compile invariant (prefill.py docstring),
+        post-ISSUE 8: prefix length is runtime block-table data, so a
+        mixed-prefix replay adds ZERO new partial-prefill programs once
+        the suffix buckets are warm — while the dense path compiles one
+        program per (prefix-page-bucket, suffix-bucket) pair. Guarded
+        via the PR 3 compile recorder + the engine's step cache."""
+        from bigdl_tpu import observability as obs
+        from bigdl_tpu.llm import serving as sv
+        rs = np.random.RandomState(42)
+        # prefix chains at 1/2/3/4 pages (n_pp buckets 1, 2, 4, 4);
+        # every tail is 1..4 tokens -> ONE suffix bucket (PAGE)
+        chains = [rs.randint(0, 250, PAGE * (1 + j)).astype(np.int32)
+                  for j in range(4)]
+        def tails(seed):
+            r2 = np.random.RandomState(seed)
+            return [np.concatenate(
+                [c, r2.randint(0, 250, 1 + r2.randint(0, 4))
+                 .astype(np.int32)]) for c in chains]
+
+        def keys(tag):
+            return {k for k in sv._PAGED_STEP_CACHE if tag in k}
+
+        def ragged_compiles():
+            return sum(s["compiles"] for s in obs.compile_stats()
+                       if s["fn"] == "llm/prefill_ragged")
+
+        was = obs.enabled()
+        obs.enable()
+        ragged_before = keys("prefill_ragged")
+        # pool roomy enough that no chain ever evicts: a miss would
+        # reroute to FULL prefill and understate the dense grid below
+        srv = LLMServer(model, max_batch=2, max_seq_len=64,
+                        page_size=PAGE, num_pages=40, kvcache=True,
+                        ragged_prefill=True).start()
+        try:
+            # warmup: seed the chains (full prefill) + one partial each
+            for p in list(chains) + tails(0):
+                srv.submit(p, max_new_tokens=2).get(timeout=600)
+            warm_keys = keys("prefill_ragged")
+            warm_compiles = ragged_compiles()
+            # mixed-prefix replay: every chain length again, new tails
+            for seed in (1, 2, 3):
+                for p in tails(seed):
+                    srv.submit(p, max_new_tokens=2).get(timeout=600)
+            assert keys("prefill_ragged") == warm_keys
+            assert ragged_compiles() == warm_compiles
+            # the whole grid is the suffix buckets: this workload's
+            # are {8, 16, 32} (seeding fulls + the partial bucket), so
+            # at most 3 NEW programs exist no matter how many prefix-
+            # page buckets the chains span (the step cache is process-
+            # global, hence the delta + subset form)
+            assert len(warm_keys - ragged_before) <= 3
+            assert {k[-1] for k in warm_keys - ragged_before} <= \
+                {8, 16, 32}
+        finally:
+            srv.stop()
+            if not was:
+                obs.disable()
+        # the dense path's grid: same traffic, one (n_pp, bucket)
+        # program per prefix-page bucket on TOP of the full-prefill
+        # buckets — this is exactly what the ragged path deleted
+        srv = LLMServer(model, max_batch=2, max_seq_len=64,
+                        page_size=PAGE, num_pages=40, kvcache=True,
+                        ragged_prefill=False).start()
+        try:
+            for p in list(chains) + tails(0):
+                srv.submit(p, max_new_tokens=2).get(timeout=600)
+        finally:
+            srv.stop()
+        # one program per (n_pp, bucket) pair — the key tail is
+        # (..., "prefill_partial", n_pp, bucket) — so at the single
+        # PAGE-sized suffix bucket the dense grid spans >= 3 n_pp
+        # buckets for the 1/2/3/4-page chains, where the ragged grid
+        # holds ONE partial program no matter the prefix mix
+        dense_npp = {k[-2] for k in keys("prefill_partial")
+                     if k[-1] == PAGE}
+        assert len(dense_npp) >= 3

@@ -1,14 +1,29 @@
-"""Chip experiment: where do the ~9.5 ms between the paged decode step
-(54.2 ms, b8 ctx256) and the fused-scan dense-cache step (~44.7 ms) go?
+"""Chip experiment: the paged-vs-dense DMA gaps, decode AND prefill.
 
-Times three variants of the b8/7B decode step under the same fori-loop
-slope harness as bench_paged_decode_step:
+Decode (the original experiment): where do the ~9.5 ms between the
+paged decode step (54.2 ms, b8 ctx256) and the fused-scan dense-cache
+step (~44.7 ms) go? Times three variants of the b8/7B decode step under
+the same fori-loop slope harness as bench_paged_decode_step:
   full     — the real serving step (paged_attention_stats + merge + scatter)
   noattn   — attention replaced by v (same matmuls/norms, no paged kernel)
   nomerge  — kernel runs, merge replaced by acc (no combine math)
 full-noattn isolates the paged kernel + merge; full-nomerge isolates the
 combine. If the kernel dominates, its (b, hkv, nblk)-grid 4 KB page DMAs
-are the suspect (per-(page, head) copies are DMA-latency-bound)."""
+are the suspect (per-(page, head) copies are DMA-latency-bound).
+
+Prefill (ISSUE 8 refresh): the dense-staging gather/scatter gap this
+PR deleted, timed from the REAL entry points so the before/after stays
+reproducible from one tool:
+  dense    — llama.paged_prefill_partial: gather n_pp prefix pages into
+             a dense temp cache, family forward, scatter the window back
+  ragged   — llama.paged_prefill_ragged: attention reads the prefix
+             pages in place, only the suffix scatter remains
+  dma      — the gather + scatter of the dense sandwich with the layer
+             math removed: the staging traffic in isolation
+dense − ragged is the end-to-end win; dma bounds how much of it is pure
+HBM round-trip (it grows with the prefix while ragged's suffix scatter
+does not). Select with --decode / --prefill (default: both); --tiny
+swaps in the tiny config for an off-chip smoke."""
 
 import functools
 import time
@@ -79,8 +94,8 @@ def build_step(cfg, bt, page, num_pages, mode: str):
     return step
 
 
-def main(batch=8, ctx_len=256, page_size=16):
-    cfg = LlamaConfig.llama2_7b()
+def decode_gap(batch=8, ctx_len=256, page_size=16, cfg=None):
+    cfg = cfg or LlamaConfig.llama2_7b()
     params = _bench._synthetic_q4_llama_params(cfg)
     ppb = LANE // page_size
     cap = -(-(ctx_len + 160) // page_size)
@@ -134,11 +149,162 @@ def main(batch=8, ctx_len=256, page_size=16):
             per = t_big / 32
         results[mode] = round(per * 1e3, 2)
         print(mode, results[mode], "ms/step", flush=True)
-    print({"step_ms": results,
+    out = {"step_ms": results,
            "attn_plus_merge_ms": round(
                results["full"] - results["noattn"], 2),
-           "merge_ms": round(results["full"] - results["nomerge"], 2)})
+           "merge_ms": round(results["full"] - results["nomerge"], 2)}
+    print(out)
+    return out
+
+
+def _build_dense_dma(cfg, page, n_pp, bucket):
+    """The dense sandwich's memory traffic with the layer math removed:
+    gather the n_pp prefix pages into a dense temp buffer, then scatter
+    the page-aligned window back. What's left of paged_prefill_partial
+    when the forward is deleted — the staging gap in isolation."""
+    def dma(k_pages, v_pages, offset, prefix_ids, phys, slots):
+        L = k_pages.shape[0]
+        s_temp = n_pp * page + page + bucket
+        window0 = (offset // page) * page
+
+        def stage(pages):
+            g = pages[:, prefix_ids].transpose(0, 1, 3, 2, 4)
+            tmp = g.reshape(L, n_pp * page, *g.shape[3:])
+            tmp = jnp.pad(tmp, ((0, 0), (0, s_temp - n_pp * page),
+                                (0, 0), (0, 0)))
+            w = jax.lax.dynamic_slice_in_dim(tmp, window0,
+                                             page + bucket, axis=1)
+            return pages.at[:, phys, :, slots].set(
+                w.transpose(1, 0, 2, 3).astype(pages.dtype))
+
+        return stage(k_pages), stage(v_pages)
+
+    return dma
+
+
+def prefill_gap(splits=None, page_size=16, cfg=None, repeats=8):
+    """Partial-prefill dispatch time at several prefix/suffix splits,
+    from the real ISSUE 5 / ISSUE 8 entry points (docstring above)."""
+    from bigdl_tpu.llm.models import llama as _llama
+
+    cfg = cfg or LlamaConfig.llama2_7b()
+    if splits is None:
+        limit = min(256, cfg.max_position_embeddings)
+        splits = ((limit * 3 // 4, limit // 4),
+                  (limit * 7 // 8, limit // 8))
+    params = _bench._synthetic_q4_llama_params(cfg)
+    nl, hkv, hd = (cfg.num_hidden_layers, cfg.num_key_value_heads,
+                   cfg.head_dim)
+    ppb = LANE // page_size
+    top = max(s + t for s, t in splits)
+    cap = -(-top // page_size)
+    pages_cap = -(-cap // ppb) * ppb
+    num_pages = 1 + 2 * pages_cap
+    kk, kv = jax.random.split(jax.random.PRNGKey(2))
+    shape = (nl, num_pages, hkv, page_size, hd)
+    k_pages0 = jax.random.normal(kk, shape, jnp.bfloat16) * 0.1
+    v_pages0 = jax.random.normal(kv, shape, jnp.bfloat16) * 0.1
+    rs = np.random.RandomState(0)
+    out = {}
+    for prefix, suffix in splits:
+        n_pp = 1 << max(0, (-(-prefix // page_size)) - 1).bit_length()
+        bucket = max(page_size, 1 << (suffix - 1).bit_length())
+        prefix_pages = list(range(1, 1 + -(-prefix // page_size)))
+        own = list(range(1 + len(prefix_pages), 1 + pages_cap))
+        row = np.zeros(pages_cap, np.int32)
+        row[:len(prefix_pages) + len(own)] = prefix_pages + own
+        T = prefix + suffix
+        pos = prefix + np.arange(bucket)
+        phys_b = np.where(pos < T, row[np.minimum(pos // page_size,
+                                                  pages_cap - 1)],
+                          0).astype(np.int32)
+        slots_b = (pos % page_size).astype(np.int32)
+        # the dense path's page-aligned window (page + bucket wide)
+        w0 = (prefix // page_size) * page_size
+        wpos = w0 + np.arange(page_size + bucket)
+        phys_w = np.where((wpos >= prefix) & (wpos < T),
+                          row[np.minimum(wpos // page_size,
+                                         pages_cap - 1)],
+                          0).astype(np.int32)
+        slots_w = (wpos % page_size).astype(np.int32)
+        pids = np.zeros(n_pp, np.int32)
+        pids[:len(prefix_pages)] = prefix_pages
+        toks = jnp.asarray(rs.randint(0, cfg.vocab_size, (1, bucket)),
+                           jnp.int32)
+        args = dict(
+            length=jnp.asarray(suffix, jnp.int32),
+            offset=jnp.asarray(prefix, jnp.int32),
+            pids=jnp.asarray(pids), bt=jnp.asarray(row),
+            phys_b=jnp.asarray(phys_b), slots_b=jnp.asarray(slots_b),
+            phys_w=jnp.asarray(phys_w), slots_w=jnp.asarray(slots_w))
+
+        # cfg is a plain dataclass (unhashable): close over it like the
+        # engine's builders do instead of marking it static
+        npp_, bkt_ = n_pp, bucket
+        dense = jax.jit(
+            lambda params, kp, vp, *a: _llama.paged_prefill_partial(
+                params, cfg, kp, vp, *a, page=page_size, n_pp=npp_,
+                bucket=bkt_, cache_dtype=jnp.bfloat16),
+            donate_argnums=(1, 2))
+        ragged = jax.jit(
+            lambda params, kp, vp, *a: _llama.paged_prefill_ragged(
+                params, cfg, kp, vp, *a, page=page_size),
+            donate_argnums=(1, 2))
+        dma = jax.jit(_build_dense_dma(cfg, page_size, n_pp, bucket),
+                      donate_argnums=(0, 1))
+        zero = jnp.asarray(0, jnp.int32)
+
+        def run_dense(kp, vp):
+            out = dense(params, kp, vp, toks, args["length"],
+                        args["offset"], args["pids"], args["phys_w"],
+                        args["slots_w"])
+            return out[0], out[1]
+
+        def run_ragged(kp, vp):
+            out = ragged(params, kp, vp, toks, args["length"],
+                         args["offset"], args["bt"], args["phys_b"],
+                         args["slots_b"], zero, zero)
+            return out[0], out[1]
+
+        def run_dma(kp, vp):
+            return dma(kp, vp, args["offset"], args["pids"],
+                       args["phys_w"], args["slots_w"])
+
+        entry = {"prefix": prefix, "suffix": suffix, "n_pp": n_pp,
+                 "bucket": bucket}
+        for name, fn in (("dense", run_dense), ("ragged", run_ragged),
+                         ("dma", run_dma)):
+            kp, vp = k_pages0 + 0, v_pages0 + 0
+            kp, vp = fn(kp, vp)                       # compile + warm
+            jax.block_until_ready(kp)
+            t0 = time.perf_counter()
+            for _ in range(repeats):
+                kp, vp = fn(kp, vp)
+            jax.block_until_ready(kp)
+            entry[f"{name}_ms"] = round(
+                (time.perf_counter() - t0) / repeats * 1e3, 3)
+        entry["staging_gap_ms"] = round(
+            entry["dense_ms"] - entry["ragged_ms"], 3)
+        out[f"{prefix}+{suffix}"] = entry
+        print(entry, flush=True)
+    return out
+
+
+def main(argv=()):
+    tiny = "--tiny" in argv
+    cfg = LlamaConfig.tiny() if tiny else None
+    which = [a for a in ("--decode", "--prefill") if a in argv] or \
+        ["--decode", "--prefill"]
+    out = {}
+    if "--decode" in which:
+        out["decode"] = decode_gap(cfg=cfg) if not tiny else decode_gap(
+            batch=2, ctx_len=32, page_size=8, cfg=cfg)
+    if "--prefill" in which:
+        out["prefill"] = prefill_gap(cfg=cfg, page_size=8 if tiny
+                                     else 16)
+    return out
 
 
 if __name__ == "__main__":
-    main()
+    import sys
+    main(sys.argv[1:])

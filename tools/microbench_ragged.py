@@ -1,0 +1,159 @@
+#!/usr/bin/env python
+"""Ragged-prefill microbench (ISSUE 8 satellite): partial-prefill TTFT
+and dense-staging volume, ragged in-place path off vs on, at several
+prefix/suffix ratios.
+
+Replays the workload the ragged kernel targets — shared cached prefix +
+distinct uncached suffix, prefix cache ON — against the LIVE engine
+twice per ratio: once with ``bigdl.llm.prefill.ragged`` off (the dense
+gather → forward → scatter sandwich) and once on (attention reads the
+prefix pages in place). What it reports, per ratio and mode:
+
+- ``ttft_ms``: mean/p50 submit→first-token wall (``Request.t_submit`` /
+  ``t_first_token``);
+- ``prefill_tokens``: suffix tokens actually run through the model
+  (identical across modes — the prefix cache does that saving);
+- ``dense_staged_tokens``: tokens round-tripped through a dense temp
+  cache (the engine's always-on ``prefill_dense_staged_tokens`` tally).
+  **The ragged path must report 0** — that is the acceptance gate this
+  bench exists to keep honest.
+
+Wired into ``bench.py``'s telemetry block (``telemetry.
+microbench_ragged``), the compact northstar line (``ragged_prefill``)
+and ``tools/bench_regress.py`` (``ragged_{off,on}.ttft_ms`` +
+``ragged.dense_staged_tokens_on``). Standalone:
+
+    python tools/microbench_ragged.py                 # tiny model
+    python tools/microbench_ragged.py --requests 8 --json
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import Dict, Optional
+
+# runnable both as `python tools/microbench_ragged.py` and as an import
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+#: (shared prefix len, distinct tail len) — prefix-heavy ratios are
+#: where the dense gather cost peaks and the ragged win is largest
+RATIOS = ((32, 32), (48, 16), (96, 8))
+
+
+def run_ragged_bench(ratios=RATIOS, n_requests: int = 6,
+                     new_tokens: int = 4, page_size: int = 16,
+                     pipeline_depth: int = 2, model=None) -> Dict:
+    """Serve ``n_requests`` shared-prefix prompts per ratio in both
+    prefill modes (prefix cache ON in both — the diff isolates the
+    staging, not the reuse). One untimed warmup pass per mode absorbs
+    the per-bucket prefill compiles."""
+    import numpy as np
+
+    from bigdl_tpu.llm.models.llama import LlamaConfig, LlamaForCausalLM
+    from bigdl_tpu.llm.serving import LLMServer
+
+    if model is None:
+        model = LlamaForCausalLM.from_config(LlamaConfig.tiny(), seed=0,
+                                             max_cache_len=256)
+    rs = np.random.RandomState(0)
+    vocab = model.config.vocab_size
+    out: Dict = {"requests": n_requests, "new_tokens": new_tokens,
+                 "page_size": page_size, "ratios": []}
+    agg = {"ragged_off": [], "ragged_on": []}
+    staged = {"ragged_off": 0, "ragged_on": 0}
+    # ONE pool/seq size across ratios so the compiled pool shapes are
+    # shared and every ratio after the first runs compile-free
+    top = max(s + t for s, t in ratios)
+    max_seq = min(top + new_tokens + 2,
+                  model.config.max_position_embeddings)
+    per_req = -(-(top + new_tokens) // page_size)
+    num_pages = 1 + (n_requests + 2) * per_req
+    for shared_len, tail_len in ratios:
+        shared = rs.randint(0, vocab, shared_len).astype(np.int32)
+        prompts = [np.concatenate([shared,
+                                   rs.randint(0, vocab, tail_len)
+                                   .astype(np.int32)])
+                   for _ in range(n_requests)]
+        entry: Dict = {"shared_len": shared_len, "tail_len": tail_len}
+        for mode, key in ((False, "ragged_off"), (True, "ragged_on")):
+            srv = LLMServer(model, max_batch=2, max_seq_len=max_seq,
+                            page_size=page_size, num_pages=num_pages,
+                            kvcache=True, ragged_prefill=mode,
+                            pipeline_depth=pipeline_depth).start()
+            try:
+                # DOUBLE warmup: the first pass seeds the chains (and
+                # the cold suffix buckets), the second sees the same
+                # matched lengths the timed pass will — its buckets are
+                # the timed pass's buckets, so compiles never leak into
+                # the TTFT numbers
+                for _ in range(2):
+                    for p in prompts:
+                        srv.submit(p, max_new_tokens=new_tokens).get(
+                            timeout=600)
+                tokens0 = srv.prefill_tokens_total
+                staged0 = srv.prefill_dense_staged_tokens
+                ttfts = []
+                for p in prompts:
+                    req = srv.submit(p, max_new_tokens=new_tokens)
+                    req.get(timeout=600)
+                    ttfts.append((req.t_first_token - req.t_submit)
+                                 * 1e3)
+                entry[key] = {
+                    "ttft_ms": round(float(np.mean(ttfts)), 3),
+                    "ttft_p50_ms": round(float(np.median(ttfts)), 3),
+                    "prefill_tokens": (srv.prefill_tokens_total
+                                       - tokens0),
+                    "dense_staged_tokens": (
+                        srv.prefill_dense_staged_tokens - staged0),
+                }
+                agg[key].append(entry[key]["ttft_ms"])
+                staged[key] += entry[key]["dense_staged_tokens"]
+            finally:
+                srv.stop()
+        out["ratios"].append(entry)
+    for key in ("ragged_off", "ragged_on"):
+        out[key] = {"ttft_ms": round(float(np.mean(agg[key])), 3)}
+    out["dense_staged_tokens_off"] = staged["ragged_off"]
+    out["dense_staged_tokens_on"] = staged["ragged_on"]
+    if out["ragged_on"]["ttft_ms"]:
+        out["ttft_speedup"] = round(
+            out["ragged_off"]["ttft_ms"] / out["ragged_on"]["ttft_ms"],
+            3)
+    return out
+
+
+def main(argv) -> int:
+    def flag(name: str, default: Optional[str] = None):
+        if name in argv:
+            return argv[argv.index(name) + 1]
+        return default
+
+    out = run_ragged_bench(
+        n_requests=int(flag("--requests", "6")),
+        new_tokens=int(flag("--new-tokens", "4")),
+        page_size=int(flag("--page-size", "16")),
+        pipeline_depth=int(flag("--depth", "2")))
+    if "--json" in argv:
+        print(json.dumps(out))
+        return 0
+    print(f"ragged-prefill microbench: {out['requests']} requests/ratio, "
+          f"prefix cache on")
+    for entry in out["ratios"]:
+        print(f"  prefix {entry['shared_len']:>3} + tail "
+              f"{entry['tail_len']:<3}", end="")
+        for key in ("ragged_off", "ragged_on"):
+            d = entry[key]
+            print(f"  {key}: ttft={d['ttft_ms']:>8.3f} ms "
+                  f"staged={d['dense_staged_tokens']:<5}", end="")
+        print()
+    print(f"  dense-staged tokens  off={out['dense_staged_tokens_off']}"
+          f"  on={out['dense_staged_tokens_on']} (must be 0)"
+          f"  ttft speedup: {out.get('ttft_speedup', 'n/a')}x")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
