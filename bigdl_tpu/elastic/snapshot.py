@@ -1,0 +1,138 @@
+"""Two-tier snapshot scheme for elastic training (ISSUE 10).
+
+The cheap tier is an in-RAM ring of host copies of the full training
+state — params, module states, optimizer slots, the OptimMethod host
+state and the driver's ``state`` dict — taken every
+``bigdl.elastic.snapshot.every`` steps. Rolling back to a ring entry
+restores the exact iteration boundary without touching disk, so an
+in-process elastic restart (a stall that recovered) costs one
+device→host copy per cadence plus a replay of at most ``every`` steps.
+
+The durable tier is PR 2's atomic checksummed checkpoint directory:
+process 0 flushes the newest **committed** ring entry there (tags
+``model.<epoch>.<neval>`` / ``optim.<epoch>.<neval>``, the exact layout
+``BaseOptimizer.resume_from_checkpoint`` consumes), so a worker-set
+restart resumes from the last committed snapshot even though every
+ring died with its process.
+
+Commit protocol: a snapshot is *committed* once every live peer has
+taken it — the supervisor tracks the minimum reported snapshot step
+and hands it back on each heartbeat; the agent calls
+:meth:`SnapshotRing.commit`. A single-process (ring-only) run has no
+peers to wait for, so ``auto_commit=True`` commits at take time.
+Rollback never returns an uncommitted entry: resuming from a snapshot
+a dead peer never took would fork the replicas.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+
+class Snapshot:
+    """One committed-or-pending copy of the training state at a step
+    boundary. Trees are host numpy (device-independent: the optimizer
+    re-replicates on restore)."""
+
+    __slots__ = ("step", "params", "states", "opt_state", "host_state",
+                 "train_state", "committed")
+
+    def __init__(self, step: int, params: Any, states: Any, opt_state: Any,
+                 host_state: Dict, train_state: Dict,
+                 committed: bool = False):
+        self.step = int(step)
+        self.params = params
+        self.states = states
+        self.opt_state = opt_state
+        self.host_state = host_state
+        self.train_state = train_state
+        self.committed = committed
+
+    def __repr__(self):
+        return (f"Snapshot(step={self.step}, "
+                f"committed={self.committed})")
+
+
+class SnapshotRing:
+    """Bounded ring of :class:`Snapshot` entries, newest last.
+
+    ``take`` evicts the oldest entry past ``capacity`` (committed or
+    not — the ring bounds RAM, the durable tier bounds loss);
+    ``commit(step)`` marks every entry at or below ``step``;
+    ``rollback()`` returns the newest committed entry and drops every
+    younger (uncommitted) one, so a replay can never observe state the
+    surviving peers did not agree on.
+    """
+
+    def __init__(self, capacity: int = 2, auto_commit: bool = False):
+        self.capacity = max(1, int(capacity))
+        self.auto_commit = bool(auto_commit)
+        self._lock = threading.Lock()
+        self._entries: List[Snapshot] = []
+        self.taken = 0
+        self.committed = 0
+        self.rollbacks = 0
+
+    def take(self, step: int, params: Any, states: Any, opt_state: Any,
+             host_state: Dict, train_state: Dict) -> Snapshot:
+        snap = Snapshot(step, params, states, opt_state, host_state,
+                        train_state, committed=self.auto_commit)
+        with self._lock:
+            self._entries.append(snap)
+            if len(self._entries) > self.capacity:
+                self._entries.pop(0)
+            self.taken += 1
+            if self.auto_commit:
+                self.committed += 1
+        return snap
+
+    def commit(self, step: int) -> int:
+        """Mark entries with ``entry.step <= step`` committed; returns
+        how many flipped (idempotent: re-acking an old committed step
+        flips nothing)."""
+        flipped = 0
+        with self._lock:
+            for ent in self._entries:
+                if ent.step <= step and not ent.committed:
+                    ent.committed = True
+                    flipped += 1
+            self.committed += flipped
+        return flipped
+
+    def newest_committed(self) -> Optional[Snapshot]:
+        with self._lock:
+            for ent in reversed(self._entries):
+                if ent.committed:
+                    return ent
+        return None
+
+    def newest(self) -> Optional[Snapshot]:
+        with self._lock:
+            return self._entries[-1] if self._entries else None
+
+    def rollback(self) -> Optional[Snapshot]:
+        """Newest committed entry, with every younger entry dropped —
+        after a rollback the ring's head is the restore point, so a
+        second failure before the next snapshot rolls back to the same
+        place instead of replaying uncommitted state. ``None`` when no
+        entry is committed (fall back to the durable tier)."""
+        with self._lock:
+            while self._entries:
+                if self._entries[-1].committed:
+                    self.rollbacks += 1
+                    return self._entries[-1]
+                self._entries.pop()
+        return None
+
+    def steps(self) -> List[int]:
+        with self._lock:
+            return [e.step for e in self._entries]
+
+    def committed_steps(self) -> List[int]:
+        with self._lock:
+            return [e.step for e in self._entries if e.committed]
+
+    def __len__(self):
+        with self._lock:
+            return len(self._entries)

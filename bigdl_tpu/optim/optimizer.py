@@ -194,7 +194,8 @@ class BaseOptimizer:
         self.optim_method: OptimMethod = SGD()
         self.metrics = Metrics()
         self.state = {"epoch": 1, "neval": 1, "iteration_done": 0,
-                      "loss": float("nan"), "record_count": 0}
+                      "loss": float("nan"), "record_count": 0,
+                      "batch_in_epoch": 0}
         self._resume_opt_state = None
         self._checkpoint_path: Optional[str] = None
         self._checkpoint_trigger: Optional[Trigger] = None
@@ -208,6 +209,7 @@ class BaseOptimizer:
         self._step_fn = None
         self._drop_percentage = 0.0  # parity knob; N/A under SPMD
         self._max_retry: Optional[int] = None
+        self._elastic = None         # built per-run by optimize()
 
     # -- builder API (ref: Optimizer setters) --------------------------------
     def set_optim_method(self, method: OptimMethod):
@@ -321,10 +323,29 @@ class BaseOptimizer:
         retries = self._max_retry if self._max_retry is not None \
             else (conf.get_int("bigdl.optimizer.max.retry", 0) or 0)
         attempt = 0
+        # elastic supervision (ISSUE 10): constructed ONLY when enabled
+        # — a disabled run has no agent thread, no ring, no series
+        self._elastic = None
+        elastic_restarts = 0
+        if conf.get_bool("bigdl.elastic.enabled", False):
+            from bigdl_tpu import elastic
+            self._elastic = elastic.TrainElastic.from_conf().start()
+            if getattr(self.dataset, "_shuffle", False):
+                # exact resume re-skips the interrupted epoch's batches
+                # by COUNT; a stateful shuffle gives the restarted
+                # process a different permutation, so the skip drops
+                # the wrong samples and the replay silently diverges
+                logger.warning(
+                    "elastic exact-resume requires a deterministic "
+                    "per-epoch data order, but %s shuffles with "
+                    "process-local RNG state — a resumed run may "
+                    "diverge from an uninterrupted one (use "
+                    "shuffle=False or stateless shuffling)",
+                    type(self.dataset).__name__)
         # snapshot for checkpoint-less recovery: initial weights AND the
         # iteration counters (a replay from fresh weights with advanced
         # counters would silently under-train)
-        if retries:
+        if retries or self._elastic is not None:
             import copy
             init_params = jax.tree_util.tree_map(
                 np.asarray, self.model.parameters_dict())
@@ -336,10 +357,13 @@ class BaseOptimizer:
             self._initial_snapshot = (init_params, init_states,
                                       init_train_state, init_host_state)
         rel_on = reliability.enabled()
-        if rel_on:
-            # preemption recovery: a fresh run against a checkpoint dir
-            # that already holds valid state (a previous process was
-            # SIGTERMed) resumes exactly at the saved iteration
+        if rel_on or self._elastic is not None:
+            # preemption/elastic recovery: a fresh run against a
+            # checkpoint dir that already holds valid state (a previous
+            # process was SIGTERMed, or a restarted elastic generation
+            # finding the durable snapshot tier) resumes exactly at the
+            # saved iteration — elastic recovery must not silently
+            # depend on the unrelated reliability switch
             self._maybe_auto_resume()
         policy = reliability.RetryPolicy() if rel_on else None
         backoff = policy.delays() if rel_on else iter(())
@@ -356,6 +380,29 @@ class BaseOptimizer:
                         reliability.TrainingPreempted):
                     raise    # preemption is not a failure: no retry
                 except Exception as e:  # noqa: BLE001 — retry contract
+                    if self._elastic is not None and \
+                            self._elastic.owns(e):
+                        if self._elastic.process_restart_required():
+                            # the whole worker set restarts together
+                            # (rejoining a collective solo would hang on
+                            # peers that are also restarting): persist
+                            # the newest committed snapshot and let the
+                            # launcher respawn the world — the fresh
+                            # processes auto-resume from disk
+                            self._elastic.abort_flush(self)
+                            raise
+                        elastic_restarts += 1
+                        if elastic_restarts > \
+                                self._elastic.max_restarts:
+                            raise
+                        logger.warning(
+                            "elastic restart %d/%d: %s",
+                            elastic_restarts,
+                            self._elastic.max_restarts, e)
+                        self._elastic.on_restart()
+                        if not self._elastic.rollback(self):
+                            self._restore_latest_checkpoint()
+                        continue
                     attempt += 1
                     if attempt > retries:
                         raise
@@ -372,6 +419,8 @@ class BaseOptimizer:
         finally:
             if restore_handlers is not None:
                 restore_handlers()
+            if self._elastic is not None:
+                self._elastic.close()
 
     # -- preemption safety (ISSUE 2) -----------------------------------------
     def _install_preemption_handlers(self):
@@ -492,6 +541,18 @@ class BaseOptimizer:
         step = self._step_fn
         from bigdl_tpu.utils.engine import train_rng_key
         key = train_rng_key(self.optim_method.host_state.get("seed", 0))
+        # exact-resume contract (ISSUE 10): a replay — elastic rollback,
+        # retry restore, preemption auto-resume — must consume the SAME
+        # split-chain positions the uninterrupted run would, or any
+        # rng-consuming layer (dropout) diverges. One split was burned
+        # per completed iteration; fast-forward past them in ONE
+        # dispatched scan (a host loop would cost O(iterations) device
+        # round-trips on a deep resume).
+        ff_n = int(self.state.get("iteration_done", 0) or 0)
+        if ff_n:
+            key = jax.lax.scan(
+                lambda k, _: (jax.random.split(k)[0], None),
+                key, None, length=ff_n)[0]
 
         batcher = SampleToMiniBatch(self.batch_size)
         state = self.state
@@ -517,6 +578,15 @@ class BaseOptimizer:
             # latency, not staging. Off → inline placement, exactly the
             # synchronous loop.
             source = batcher(self.dataset.data(train=True))
+            # mid-epoch resume (ISSUE 10): a snapshot taken inside an
+            # epoch records how many batches that epoch had consumed;
+            # replaying them would re-train data the restored counters
+            # (and weights) already include. Skip them unplaced — the
+            # cadence resets to 0 at every epoch boundary, so a fresh
+            # epoch skips nothing.
+            for _ in range(int(state.get("batch_in_epoch", 0) or 0)):
+                if next(source, None) is None:
+                    break
             batches = BatchPrefetcher(source, self._place_batch,
                                       depth=prefetch_depth) \
                 if prefetch_on else self._staged_batches(source)
@@ -530,6 +600,12 @@ class BaseOptimizer:
                             break
                         x, t, nrec = item
                         reliability.inject("optimizer.step")
+                        if self._elastic is not None:
+                            # fault site + step heartbeat + abort check
+                            # — a directed/stalled world aborts HERE,
+                            # before dispatching into a collective its
+                            # peers will never join
+                            self._elastic.on_step_begin(state)
                         with obs.span("train/step", step=state["neval"]):
                             self.metrics.add("data", t_data)
                             lr = self.optim_method.current_lr()
@@ -556,8 +632,17 @@ class BaseOptimizer:
                         self.optim_method.host_state["eval_counter"] += 1
                         state["neval"] += 1
                         state["iteration_done"] += 1
+                        state["batch_in_epoch"] = \
+                            state.get("batch_in_epoch", 0) + 1
                         self._after_iteration(params, states, opt_state,
                                               state)
+                        if self._elastic is not None:
+                            # snapshot cadence + durable flush (after
+                            # _after_iteration so the snapshot carries
+                            # validation scores/trigger effects exactly
+                            # like a trigger checkpoint would)
+                            self._elastic.on_step_end(
+                                self, params, states, opt_state, state)
                         self._check_preemption(params, states, opt_state,
                                                state)
                         if end_uses_loss:
@@ -570,6 +655,12 @@ class BaseOptimizer:
                 # a raising step) must retire the producer thread
                 if isinstance(batches, BatchPrefetcher):
                     batches.close()
+                if self._elastic is not None:
+                    # epoch-boundary work (validation, checkpointing)
+                    # legitimately keeps the loop away from its step
+                    # heartbeat — park the collective-hang watchdog
+                    # until the next step re-arms it
+                    self._elastic.on_loop_exit()
             self._drain_loss()
             thr = records / max(time.perf_counter() - t_epoch, 1e-9)
             logger.info(
@@ -589,6 +680,7 @@ class BaseOptimizer:
                 state["epoch_finished"] = False
                 break
             state["epoch"] += 1
+            state["batch_in_epoch"] = 0
             self.optim_method.host_state["epoch"] = state["epoch"]
             state["epoch_finished"] = True
             self._after_iteration(params, states, opt_state, state)
@@ -676,11 +768,47 @@ class BaseOptimizer:
 
     def _save_checkpoint(self, params, states, opt_state, state):
         reliability.inject("optimizer.checkpoint")
-        tag = f"{state['epoch']}.{state['neval']}"
-        self.model.load_parameters_dict(
-            jax.tree_util.tree_map(np.asarray, params))
-        self.model.load_states_dict(
-            jax.tree_util.tree_map(np.asarray, states))
+        self._write_checkpoint(
+            jax.tree_util.tree_map(np.asarray, params),
+            jax.tree_util.tree_map(np.asarray, states),
+            jax.tree_util.tree_map(np.asarray, opt_state),
+            self.optim_method.get_state(), dict(state))
+
+    def _world_signature(self) -> dict:
+        """The shard-math identity a checkpoint is only resumable
+        under (ISSUE 10 satellite): process/device counts, plus the
+        mesh geometry for distributed optimizers."""
+        sig = {"processes": jax.process_count(),
+               "devices": jax.device_count()}
+        mesh = getattr(self, "mesh", None)
+        if mesh is not None:
+            sig["mesh_shape"] = [int(d) for d in mesh.devices.shape]
+            sig["mesh_axes"] = list(mesh.axis_names)
+        return sig
+
+    def _write_checkpoint(self, params, states, opt_state, host_state,
+                          train_state):
+        """Persist one checkpoint pair from HOST trees — shared by the
+        trigger/preemption path (:meth:`_save_checkpoint`, live state)
+        and the elastic durable-tier flush (a committed ring entry)."""
+        if jax.process_count() > 1 and jax.process_index() != 0:
+            # multi-host: training state is replicated and the
+            # checkpoint dir is shared — exactly one writer, or two
+            # processes race their atomic renames onto the same tag.
+            # Peers resume from process 0's tags.
+            if not getattr(self, "_warned_ckpt_delegated", False):
+                self._warned_ckpt_delegated = True
+                logger.warning(
+                    "multi-host checkpointing: process %d delegates "
+                    "writes to process 0 — the checkpoint dir %r must "
+                    "be on storage SHARED across hosts (GCS/NFS); on "
+                    "node-local paths this process would find no tags "
+                    "to resume from", jax.process_index(),
+                    self._checkpoint_path)
+            return
+        tag = f"{train_state['epoch']}.{train_state['neval']}"
+        self.model.load_parameters_dict(params)
+        self.model.load_states_dict(states)
         # model first, optim second: latest() requires the valid PAIR,
         # so a crash between the two leaves tag invisible to recovery
         self.model.save_module(
@@ -689,19 +817,48 @@ class BaseOptimizer:
                                                 save_checkpoint)
         save_checkpoint(
             os.path.join(self._checkpoint_path, f"optim.{tag}"),
-            {"opt_state": jax.tree_util.tree_map(np.asarray, opt_state),
-             "host_state": self.optim_method.get_state(),
-             "train_state": dict(state)})
+            {"opt_state": opt_state,
+             "host_state": host_state,
+             "train_state": dict(train_state),
+             "world": self._world_signature()})
         logger.info("checkpoint saved: %s @ %s", self._checkpoint_path, tag)
         from bigdl_tpu.utils.conf import conf
         keep = conf.get_int("bigdl.checkpoint.keep", 0) or 0
         if keep > 0:
             prune_checkpoints(self._checkpoint_path, keep)
 
+    def _check_world(self, saved: Optional[dict], path: str, tag: str):
+        """Fail fast on a world-size / mesh-shape change (ISSUE 10
+        satellite): resuming a replicated-params checkpoint into a
+        different data-parallel degree silently changes the per-shard
+        batch math — the run would converge to different weights with
+        no error. Pre-ISSUE-10 checkpoints carry no signature and skip
+        the check (resume was always same-world in practice)."""
+        if not saved:
+            return
+        cur = self._world_signature()
+        mismatched = [k for k in ("processes", "devices", "mesh_shape",
+                                  "mesh_axes")
+                      if k in saved and k in cur and saved[k] != cur[k]]
+        if not mismatched:
+            return
+        def fmt(sig):
+            out = (f"{sig.get('processes')} process(es) / "
+                   f"{sig.get('devices')} device(s)")
+            if sig.get("mesh_shape"):
+                out += (f", mesh {tuple(sig['mesh_shape'])} over "
+                        f"{tuple(sig.get('mesh_axes', ()))}")
+            return out
+        raise ValueError(
+            f"checkpoint {path} @ {tag} was saved by a different world: "
+            f"saved {fmt(saved)}, current {fmt(cur)} (mismatched: "
+            f"{', '.join(mismatched)}). Resuming would silently change "
+            "the shard math; restart with the saved world size, or load "
+            "the weights explicitly via Module.load_module to retrain "
+            "under the new topology")
+
     def resume_from_checkpoint(self, path: str, tag: str):
         """Resume (ref: Optimizer resume = loadModule + OptimMethod.load)."""
-        self.model = Module.load_module(os.path.join(path, f"model.{tag}"))
-        self._step_fn = None   # compiled step closed over the old model
         optim_path = os.path.join(path, f"optim.{tag}")
         if os.path.isdir(optim_path):
             from bigdl_tpu.utils.checkpoint import load_checkpoint
@@ -709,7 +866,16 @@ class BaseOptimizer:
         else:  # legacy round-1 pickle checkpoints
             with open(optim_path, "rb") as f:
                 blob = pickle.load(f)
+        # the world guard runs BEFORE any state mutates: a rejected
+        # resume leaves the optimizer untouched
+        self._check_world(blob.get("world"), path, tag)
+        self.model = Module.load_module(os.path.join(path, f"model.{tag}"))
+        self._step_fn = None   # compiled step closed over the old model
         self.optim_method.load_state(blob["host_state"])
+        # keys absent from an older blob must not inherit live values:
+        # a stale nonzero batch_in_epoch would make the resumed epoch
+        # skip batches that were never trained under these counters
+        self.state["batch_in_epoch"] = 0
         self.state.update(blob["train_state"])
         self.state["epoch_finished"] = False
         self._resume_opt_state = blob["opt_state"]

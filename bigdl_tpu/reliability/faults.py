@@ -52,6 +52,8 @@ SITES = (
     "kvtier.fetch",                # host->HBM page fetch (ISSUE 6)
     "router.dispatch",             # router->backend call/stream (ISSUE 7)
     "worker.stall",                # hung engine decode step (ISSUE 7)
+    "elastic.heartbeat",           # agent->supervisor beat (ISSUE 10)
+    "elastic.step",                # elastic-guarded train step (ISSUE 10)
 )
 
 

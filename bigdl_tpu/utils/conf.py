@@ -96,6 +96,28 @@ _DEFAULTS: Dict[str, str] = {
     "bigdl.llm.retry_after.jitter": "0.2",
     "bigdl.train.prefetch": "true",           # stage batch N+1 during N
     "bigdl.train.prefetch.depth": "2",        # staged batches held ahead
+    # elastic multi-host training (ISSUE 10): supervisor + peer
+    # heartbeats + collective-hang watchdog + snapshot-based recovery.
+    # false = the optimizer loop, Engine and metric registry are exactly
+    # the pre-elastic objects (no agent thread, no ring, no series)
+    "bigdl.elastic.enabled": "false",
+    "bigdl.elastic.supervisor.address": "",   # host:port; "" = ring-only
+    "bigdl.elastic.heartbeat.interval": "0.5",  # agent beat cadence (s)
+    "bigdl.elastic.heartbeat.timeout": "5.0",   # peer presumed dead (s)
+    # a worker wedged before its FIRST heartbeat never registers, so
+    # peer expiry can't see it: fail the generation if the world has
+    # not fully joined within this budget. 0 = no join deadline
+    "bigdl.elastic.join.timeout": "300",
+    # stalled-collective watchdog: a step heartbeat older than this
+    # while the loop is live means a wedged shard_map step. 0 = off
+    "bigdl.elastic.step.timeout": "0",
+    "bigdl.elastic.snapshot.every": "10",     # steps per RAM snapshot
+    "bigdl.elastic.snapshot.ring": "2",       # RAM ring capacity
+    # committed snapshots per durable flush (process 0 writes the PR 2
+    # atomic checkpoint tier); 0 = never flush mid-epoch
+    "bigdl.elastic.snapshot.flush.every": "1",
+    "bigdl.elastic.max.restarts": "3",        # restart budget (both tiers)
+    "bigdl.elastic.generation": "0",          # set by the launcher env
 }
 
 

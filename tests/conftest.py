@@ -56,6 +56,11 @@ def pytest_configure(config):
         "kernels: Pallas/Mosaic kernel family tests (paged decode + "
         "ragged prefill interpret-mode parity vs the XLA references; "
         "select with -m kernels)")
+    config.addinivalue_line(
+        "markers",
+        "elastic: elastic multi-host training tests (supervisor state "
+        "machine, peer heartbeats, collective-hang watchdog, snapshot "
+        "ring, kill-and-recover; select with -m elastic)")
 
 
 @pytest.fixture(scope="session")

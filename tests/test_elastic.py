@@ -1,0 +1,811 @@
+"""ISSUE 10 elastic multi-host training: supervisor state machine, peer
+heartbeats, collective-hang watchdog, snapshot ring, and snapshot-based
+recovery.
+
+Everything here is tier-1: the supervisor/agent state machines run on
+fake clocks against recorded transports (zero sleeping, no sockets
+except the two explicit HTTP round-trip cases), training cases use tiny
+MLPs on the ring-only path, and the launcher cases spawn jax-free
+subprocesses. The real two-process kill-and-recover run lives in
+tests/test_multihost.py (slow-marked).
+"""
+
+import os
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+from bigdl_tpu import elastic
+from bigdl_tpu import observability as obs
+from bigdl_tpu import reliability as rel
+from bigdl_tpu.elastic import ElasticAgent, ElasticRestart, SnapshotRing
+from bigdl_tpu.elastic.supervisor import RESTARTING, RUNNING, Supervisor
+from bigdl_tpu.utils.conf import conf
+
+pytestmark = pytest.mark.elastic
+
+
+@pytest.fixture(autouse=True)
+def _clean_elastic_state():
+    rel.enable()
+    rel.set_plan(None)
+    obs.reset()
+    yield
+    rel.set_plan(None)
+    for key in ("bigdl.elastic.enabled", "bigdl.elastic.snapshot.every",
+                "bigdl.elastic.snapshot.ring", "bigdl.elastic.step.timeout",
+                "bigdl.elastic.heartbeat.interval",
+                "bigdl.elastic.max.restarts",
+                "bigdl.elastic.supervisor.address"):
+        conf.unset(key)
+    obs.reset()
+
+
+class FakeClock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _counter_value(_metric, **labels):
+    m = obs.REGISTRY.get(_metric)
+    if m is None:
+        return 0.0
+    child = m.labels(**labels) if labels else m
+    return child.value
+
+
+# ---------------------------------------------------------------------------
+# snapshot ring: take / evict / commit / rollback
+# ---------------------------------------------------------------------------
+
+class TestSnapshotRing:
+    def _take(self, ring, step):
+        return ring.take(step, {"w": np.full(2, step)}, {}, {"m": step},
+                         {"seed": 0}, {"neval": step})
+
+    def test_capacity_evicts_oldest(self):
+        ring = SnapshotRing(capacity=2)
+        for s in (5, 10, 15):
+            self._take(ring, s)
+        assert ring.steps() == [10, 15]
+        assert ring.taken == 3
+
+    def test_commit_marks_at_or_below_step(self):
+        ring = SnapshotRing(capacity=4)
+        for s in (5, 10, 15):
+            self._take(ring, s)
+        assert ring.newest_committed() is None
+        flipped = ring.commit(10)
+        assert flipped == 2
+        assert ring.committed_steps() == [5, 10]
+        assert ring.newest_committed().step == 10
+        # idempotent: re-acking an old step flips nothing
+        assert ring.commit(10) == 0
+
+    def test_rollback_drops_uncommitted_younger_entries(self):
+        ring = SnapshotRing(capacity=4)
+        for s in (5, 10, 15):
+            self._take(ring, s)
+        ring.commit(10)
+        ent = ring.rollback()
+        assert ent.step == 10
+        # the uncommitted 15 is gone: a second failure before the next
+        # snapshot restores the same agreed-upon point
+        assert ring.steps() == [5, 10]
+        assert ring.rollback().step == 10
+
+    def test_rollback_none_when_nothing_committed(self):
+        ring = SnapshotRing(capacity=2)
+        self._take(ring, 5)
+        assert ring.rollback() is None
+        assert len(ring) == 0          # uncommitted entries dropped
+
+    def test_auto_commit_mode(self):
+        ring = SnapshotRing(capacity=2, auto_commit=True)
+        self._take(ring, 5)
+        assert ring.newest_committed().step == 5
+        assert ring.rollback().step == 5
+
+
+# ---------------------------------------------------------------------------
+# supervisor: membership, expiry, stall, commit floor, generations
+# ---------------------------------------------------------------------------
+
+class TestSupervisor:
+    def test_heartbeats_register_and_commit_floor(self):
+        clk = FakeClock()
+        sup = Supervisor(expected=2, heartbeat_timeout=5.0, clock=clk)
+        out = sup.heartbeat(pid=0, step=4, snap_step=3)
+        assert out["directive"] == "ok"
+        # only 1/2 peers present: no commit floor yet
+        assert out["committed_step"] == -1
+        out = sup.heartbeat(pid=1, step=5, snap_step=5)
+        assert out["committed_step"] == 3   # min over the live world
+        assert sup.live_peers() == 2
+        assert sup.step_skew() == 1
+        # the floor is monotonic
+        sup.heartbeat(pid=0, step=8, snap_step=7)
+        out = sup.heartbeat(pid=1, step=8, snap_step=7)
+        assert out["committed_step"] == 7
+
+    def test_heartbeat_expiry_fails_the_world(self):
+        clk = FakeClock()
+        sup = Supervisor(expected=2, heartbeat_timeout=5.0, clock=clk)
+        sup.heartbeat(pid=0)
+        sup.heartbeat(pid=1)
+        assert sup.sweep() and sup.state == RUNNING
+        clk.advance(3.0)
+        sup.heartbeat(pid=0)           # peer 0 stays chatty
+        clk.advance(3.0)               # peer 1 silent for 6s > 5s
+        out = sup.heartbeat(pid=0)
+        assert sup.state == RESTARTING
+        assert out["directive"] == "abort"
+        assert "expired" in out["reason"]
+        assert sup.expiries == 1
+
+    def test_stall_report_fails_the_world(self):
+        clk = FakeClock()
+        sup = Supervisor(expected=2, heartbeat_timeout=5.0, clock=clk)
+        sup.heartbeat(pid=0)
+        out = sup.heartbeat(pid=1, step=7, status="stall")
+        assert sup.state == RESTARTING
+        assert out["directive"] == "abort"
+        assert "stalled" in out["reason"]
+        assert sup.stalls == 1
+        # the survivor's next beat is told to abort too
+        assert sup.heartbeat(pid=0)["directive"] == "abort"
+
+    def test_clean_leave_is_not_a_death(self):
+        """A worker that finished and exited 0 must stop being a
+        liveness obligation — its heartbeat going quiet must not
+        restart the healthy remainder of the world."""
+        clk = FakeClock()
+        sup = Supervisor(expected=2, heartbeat_timeout=5.0, clock=clk)
+        sup.heartbeat(pid=0)
+        sup.heartbeat(pid=1)
+        sup.leave(1)                   # launcher saw exit code 0
+        assert sup.live_peers() == 1
+        clk.advance(60.0)              # way past peer 1's last beat
+        sup.heartbeat(pid=0)           # peer 0 still training
+        assert sup.sweep()
+        assert sup.state == RUNNING
+
+    def test_commit_floor_keeps_moving_after_clean_leave(self):
+        """A finished peer's snapshots stop constraining the floor —
+        the survivors' later snapshots must still commit (and flush),
+        or a late failure would lose far more than snapshot.every
+        steps."""
+        clk = FakeClock()
+        sup = Supervisor(expected=2, heartbeat_timeout=5.0, clock=clk)
+        sup.heartbeat(pid=0, snap_step=10)
+        sup.heartbeat(pid=1, snap_step=10)
+        assert sup.committed_step == 10
+        sup.leave(1)
+        out = sup.heartbeat(pid=0, snap_step=20)
+        assert out["committed_step"] == 20
+
+    def test_join_timeout_catches_prebeat_wedge(self):
+        """A worker stuck BEFORE its first heartbeat never registers,
+        so peer expiry can't see it — the join deadline must bound
+        the hang."""
+        clk = FakeClock()
+        sup = Supervisor(expected=2, heartbeat_timeout=5.0,
+                         join_timeout=30.0, clock=clk)
+        sup.heartbeat(pid=0)           # peer 1 never arrives
+        clk.advance(20.0)
+        sup.heartbeat(pid=0)
+        assert sup.state == RUNNING    # inside the join budget
+        clk.advance(15.0)
+        out = sup.heartbeat(pid=0)     # 35s > 30s
+        assert sup.state == RESTARTING
+        assert out["directive"] == "abort"
+        assert "joined" in out["reason"]
+        # a fresh generation restarts the join clock
+        sup.begin_generation()
+        assert sup.sweep() and sup.state == RUNNING
+
+    def test_stale_generation_is_told_to_abort_without_joining(self):
+        clk = FakeClock()
+        sup = Supervisor(expected=2, heartbeat_timeout=5.0, clock=clk)
+        sup.begin_generation()         # now generation 1
+        out = sup.heartbeat(pid=0, generation=0)
+        assert out["directive"] == "abort"
+        assert "stale generation" in out["reason"]
+        assert sup.live_peers() == 0   # ghosts never join the table
+
+    def test_begin_generation_resets_membership_keeps_commit(self):
+        clk = FakeClock()
+        sup = Supervisor(expected=2, heartbeat_timeout=5.0, clock=clk)
+        sup.heartbeat(pid=0, snap_step=9)
+        sup.heartbeat(pid=1, snap_step=9)
+        assert sup.committed_step == 9
+        sup.fail("process 1 exited with code 17")
+        assert sup.state == RESTARTING
+        gen = sup.begin_generation()
+        assert gen == 1 and sup.state == RUNNING
+        assert sup.live_peers() == 0
+        # the committed step survives: it names the resume point
+        assert sup.committed_step == 9
+        out = sup.heartbeat(pid=0, generation=1)
+        assert out["directive"] == "ok"
+
+    def test_http_round_trip_and_healthz(self):
+        import http.client
+        import json
+
+        sup = Supervisor(expected=1, heartbeat_timeout=60.0).start()
+        try:
+            host, port = sup.address
+
+            def call(method, path, body=None):
+                c = http.client.HTTPConnection(host, port, timeout=5)
+                try:
+                    c.request(method, path,
+                              json.dumps(body) if body else None)
+                    r = c.getresponse()
+                    return r.status, json.loads(r.read().decode())
+                finally:
+                    c.close()
+
+            st, out = call("POST", "/elastic/heartbeat",
+                           {"pid": 0, "step": 3, "snap_step": 2})
+            assert st == 200 and out["directive"] == "ok"
+            assert out["committed_step"] == 2
+            st, out = call("GET", "/elastic/status")
+            assert st == 200 and out["state"] == RUNNING
+            assert out["peers"]["0"]["step"] == 3
+            st, out = call("GET", "/healthz")
+            assert st == 200 and out["ok"]
+            sup.fail("test failure")
+            st, out = call("GET", "/healthz")
+            assert st == 503 and not out["ok"]
+            st, out = call("POST", "/elastic/heartbeat", {"pid": "x"})
+            assert st == 422
+        finally:
+            sup.stop()
+
+
+# ---------------------------------------------------------------------------
+# agent: step heartbeat, stall watchdog, beats, directives
+# ---------------------------------------------------------------------------
+
+class TestElasticAgent:
+    def test_stall_detected_on_fake_clock_and_abort_armed(self):
+        clk = FakeClock()
+        agent = ElasticAgent(process_id=0, step_timeout=2.0,
+                             heartbeat_interval=0.1, clock=clk)
+        assert not agent.check_stall()     # no step seen: not live
+        agent.step_heartbeat(5)
+        clk.advance(1.0)
+        assert not agent.check_stall()     # inside the budget
+        clk.advance(1.5)
+        assert agent.check_stall()         # 2.5s > 2.0s: wedged
+        assert agent.should_abort()
+        assert "stalled" in agent.abort_reason()
+        assert agent.stalls == 1
+        agent.check_stall()                # still stalled, counted once
+        assert agent.stalls == 1
+        assert _counter_value("bigdl_elastic_stalls_total") == 1
+
+    def test_loop_idle_parks_the_watchdog(self):
+        clk = FakeClock()
+        agent = ElasticAgent(process_id=0, step_timeout=2.0,
+                             heartbeat_interval=0.1, clock=clk)
+        agent.step_heartbeat(5)
+        agent.loop_idle()                  # epoch-boundary work
+        clk.advance(60.0)
+        assert not agent.check_stall()
+        agent.step_heartbeat(6)            # next step re-arms
+        clk.advance(3.0)
+        assert agent.check_stall()
+
+    def test_beat_payload_directives_and_ring_commit(self):
+        clk = FakeClock()
+        ring = SnapshotRing(capacity=4)
+        ring.take(7, {}, {}, {}, {}, {"neval": 7})
+        sent = []
+        reply = {"directive": "ok", "generation": 0, "committed_step": 7}
+
+        def transport(payload):
+            sent.append(payload)
+            return dict(reply)
+
+        agent = ElasticAgent(process_id=3, ring=ring, transport=transport,
+                             step_timeout=0, heartbeat_interval=0.1,
+                             generation=0, clock=clk)
+        agent.step_heartbeat(9)
+        agent.note_snapshot(7)
+        agent.beat()
+        assert sent[-1] == {"pid": 3, "step": 9, "snap_step": 7,
+                            "status": "ok", "generation": 0}
+        # the acked commit landed on the ring
+        assert ring.newest_committed().step == 7
+        assert not agent.should_abort()
+        reply = {"directive": "abort", "generation": 1,
+                 "committed_step": 7, "reason": "world restarting"}
+        agent.beat()
+        assert agent.should_abort()
+        assert "world restarting" in agent.abort_reason()
+        assert agent.beats == 2
+        assert _counter_value("bigdl_elastic_heartbeats_total") == 2
+
+    def test_stalled_agent_reports_stall_status_upstream(self):
+        clk = FakeClock()
+        sent = []
+
+        def transport(payload):
+            sent.append(payload)
+            return {"directive": "ok", "committed_step": -1}
+
+        agent = ElasticAgent(process_id=0, transport=transport,
+                             step_timeout=1.0, heartbeat_interval=0.1,
+                             clock=clk)
+        agent.step_heartbeat(4)
+        clk.advance(5.0)
+        agent.beat()
+        assert sent[-1]["status"] == "stall"
+        assert agent.should_abort()
+
+    def test_heartbeat_fault_site_raises_through_beat(self):
+        plan = rel.FaultPlan(seed=0)
+        plan.add("elastic.heartbeat", "raise", times=1)
+        rel.set_plan(plan)
+        agent = ElasticAgent(process_id=0, transport=lambda p: {},
+                             step_timeout=0, heartbeat_interval=0.1)
+        with pytest.raises(rel.InjectedFault):
+            agent.beat()
+        rel.set_plan(None)
+        assert agent.beats == 0            # the failed beat never sent
+
+    def test_thread_lifecycle_and_failure_counting(self):
+        calls = threading.Event()
+
+        def transport(payload):
+            calls.set()
+            raise ConnectionError("supervisor gone")
+
+        agent = ElasticAgent(process_id=0, transport=transport,
+                             step_timeout=0, heartbeat_interval=0.01)
+        agent.start()
+        assert calls.wait(5.0)
+        agent.stop()
+        assert agent.beat_failures >= 1
+        assert not [t for t in threading.enumerate()
+                    if t.name == "bigdl-elastic-agent"]
+
+    def test_threadless_when_nothing_to_do(self):
+        agent = ElasticAgent(process_id=0, step_timeout=0,
+                             heartbeat_interval=0.01)
+        agent.start()
+        assert agent._thread is None       # no supervisor, no watchdog
+
+
+# ---------------------------------------------------------------------------
+# optimizer integration: ring-only stall recovery, disabled mode
+# ---------------------------------------------------------------------------
+
+def _train(elastic_on=False, step_timeout="0.6", fault_plan=None,
+           epochs=3, max_restarts=None):
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.feature.dataset import LocalDataSet
+    from bigdl_tpu.nn.module import set_seed
+    from bigdl_tpu.optim.optimizer import LocalOptimizer
+    from bigdl_tpu.optim.trigger import Trigger
+
+    set_seed(0)
+    rs = np.random.RandomState(0)
+    x = rs.randn(64, 8).astype(np.float32)
+    t = (rs.randint(0, 4, 64) + 1).astype(np.int32)
+    model = (nn.Sequential().add(nn.Linear(8, 16)).add(nn.ReLU())
+             .add(nn.Linear(16, 4)).add(nn.LogSoftMax()))
+    opt = LocalOptimizer(model, LocalDataSet(x, t, shuffle=False),
+                         nn.ClassNLLCriterion(), batch_size=16,
+                         end_trigger=Trigger.max_epoch(epochs))
+    if elastic_on:
+        conf.set("bigdl.elastic.enabled", "true")
+        conf.set("bigdl.elastic.snapshot.every", "2")
+        conf.set("bigdl.elastic.step.timeout", step_timeout)
+        conf.set("bigdl.elastic.heartbeat.interval", "0.05")
+        if max_restarts is not None:
+            conf.set("bigdl.elastic.max.restarts", str(max_restarts))
+    if fault_plan is not None:
+        rel.set_plan(fault_plan)
+    try:
+        opt.optimize()
+    finally:
+        rel.set_plan(None)
+        if elastic_on:
+            for k in ("bigdl.elastic.enabled",
+                      "bigdl.elastic.snapshot.every",
+                      "bigdl.elastic.step.timeout",
+                      "bigdl.elastic.heartbeat.interval",
+                      "bigdl.elastic.max.restarts"):
+                conf.unset(k)
+    import jax
+    leaves = [np.asarray(l) for l in
+              jax.tree_util.tree_leaves(opt.model.parameters_dict())]
+    return opt, leaves
+
+
+class TestOptimizerIntegration:
+    def test_stall_recovery_is_bit_identical_to_clean_run(self):
+        """The acceptance contract on the ring tier: one wedged step
+        (an injected delay past the watchdog timeout) → stall detected
+        → in-process rollback to the last committed snapshot → replay
+        → final weights bit-identical to the uninterrupted run."""
+        _, w_clean = _train(elastic_on=False)
+        plan = rel.FaultPlan(seed=0)
+        plan.add("elastic.step", "delay", times=1, after=6, delay=1.5)
+        opt, w_el = _train(elastic_on=True, fault_plan=plan)
+        assert plan.fired == [("elastic.step", "delay")]
+        assert opt._elastic.agent.stalls == 1
+        assert opt._elastic.ring.rollbacks == 1
+        for a, b in zip(w_clean, w_el):
+            np.testing.assert_array_equal(a, b)
+        assert _counter_value("bigdl_elastic_restarts_total",
+                              scope="in_process") == 1
+        assert _counter_value("bigdl_elastic_snapshots_total") > 0
+
+    def test_snapshot_cadence(self):
+        opt, _ = _train(elastic_on=True, step_timeout="0")
+        # 12 iterations at every=2 -> 6 snapshots, ring keeps newest 2
+        assert opt._elastic.ring.taken == 6
+        assert len(opt._elastic.ring) == 2
+        assert opt._elastic.ring.newest_committed() is not None
+
+    def test_flush_every_counts_commits_not_steps(self, tmp_path):
+        """`snapshot.flush.every=2` means every SECOND committed
+        snapshot reaches disk — observing the same pending commit
+        across several iterations must not count repeatedly."""
+        import bigdl_tpu.nn as nn
+        from bigdl_tpu.feature.dataset import LocalDataSet
+        from bigdl_tpu.nn.module import set_seed
+        from bigdl_tpu.optim.optimizer import LocalOptimizer
+        from bigdl_tpu.optim.trigger import Trigger
+
+        set_seed(0)
+        rs = np.random.RandomState(0)
+        x = rs.randn(64, 8).astype(np.float32)
+        t = (rs.randint(0, 4, 64) + 1).astype(np.int32)
+        model = (nn.Sequential().add(nn.Linear(8, 4))
+                 .add(nn.LogSoftMax()))
+        opt = LocalOptimizer(model, LocalDataSet(x, t, shuffle=False),
+                             nn.ClassNLLCriterion(), batch_size=16,
+                             end_trigger=Trigger.max_epoch(3))
+        # trigger far out of reach: every tag on disk is an elastic flush
+        opt.set_checkpoint(str(tmp_path), Trigger.several_iteration(10**9))
+        conf.set("bigdl.elastic.enabled", "true")
+        conf.set("bigdl.elastic.snapshot.every", "2")
+        conf.set("bigdl.elastic.step.timeout", "0")
+        conf.set("bigdl.elastic.snapshot.flush.every", "2")
+        try:
+            opt.optimize()
+        finally:
+            for k in ("bigdl.elastic.enabled",
+                      "bigdl.elastic.snapshot.every",
+                      "bigdl.elastic.step.timeout",
+                      "bigdl.elastic.snapshot.flush.every"):
+                conf.unset(k)
+        # 12 iterations -> 6 committed snapshots -> 3 durable flushes
+        assert opt._elastic.ring.taken == 6
+        assert _counter_value("bigdl_elastic_flushes_total") == 3
+
+    def test_restart_budget_exhaustion_raises(self):
+        plan = rel.FaultPlan(seed=0)
+        # every step wedges: the budget (1) must run out and surface
+        plan.add("elastic.step", "delay", times=None, delay=1.0)
+        with pytest.raises(ElasticRestart):
+            _train(elastic_on=True, fault_plan=plan, max_restarts=1)
+
+    def test_elastic_auto_resume_without_reliability(self, tmp_path):
+        """Elastic recovery must not silently depend on the unrelated
+        reliability switch: a restarted generation with
+        bigdl.reliability.enabled=false still resumes from the durable
+        snapshot tier at the exact saved iteration."""
+        import bigdl_tpu.nn as nn
+        from bigdl_tpu.feature.dataset import LocalDataSet
+        from bigdl_tpu.nn.module import set_seed
+        from bigdl_tpu.optim.optimizer import LocalOptimizer
+        from bigdl_tpu.optim.trigger import Trigger
+        from bigdl_tpu.utils import checkpoint as ckpt
+
+        def build(epochs):
+            set_seed(0)
+            rs = np.random.RandomState(0)
+            x = rs.randn(32, 8).astype(np.float32)
+            t = (rs.randint(0, 4, 32) + 1).astype(np.int32)
+            model = (nn.Sequential().add(nn.Linear(8, 4))
+                     .add(nn.LogSoftMax()))
+            opt = LocalOptimizer(model, LocalDataSet(x, t, shuffle=False),
+                                 nn.ClassNLLCriterion(), batch_size=16,
+                                 end_trigger=Trigger.max_epoch(epochs))
+            opt.set_checkpoint(str(tmp_path), Trigger.every_epoch())
+            return opt
+
+        build(1).optimize()            # seeds the durable tier
+        saved = ckpt.latest(str(tmp_path), paired_prefix="model.")
+        assert saved is not None
+        conf.set("bigdl.elastic.enabled", "true")
+        conf.set("bigdl.elastic.step.timeout", "0")
+        rel.disable()
+        try:
+            opt2 = build(2)
+            seen = {}
+            orig = opt2._optimize_once
+
+            def capture():
+                seen["neval"] = opt2.state["neval"]
+                return orig()
+
+            opt2._optimize_once = capture
+            opt2.optimize()
+        finally:
+            rel.enable()
+        # resumed at the saved iteration, not from scratch
+        assert seen["neval"] == int(saved.split(".")[1])
+
+    def test_disabled_mode_structurally_absent(self):
+        before = set(obs.render().splitlines())
+        opt, _ = _train(elastic_on=False, epochs=1)
+        assert opt._elastic is None
+        assert not [t for t in threading.enumerate()
+                    if t.name.startswith("bigdl-elastic")]
+        grown = "\n".join(set(obs.render().splitlines()) - before)
+        assert "bigdl_elastic_" not in grown
+
+
+# ---------------------------------------------------------------------------
+# world-size guard (satellite): resume must fail fast, not mis-shard
+# ---------------------------------------------------------------------------
+
+class TestWorldSizeGuard:
+    def test_resume_into_changed_world_fails_fast(self, tmp_path):
+        import bigdl_tpu.nn as nn
+        from bigdl_tpu.feature.dataset import LocalDataSet
+        from bigdl_tpu.nn.module import set_seed
+        from bigdl_tpu.optim.optimizer import LocalOptimizer
+        from bigdl_tpu.optim.trigger import Trigger
+        from bigdl_tpu.utils import checkpoint as ckpt
+
+        def build():
+            set_seed(0)
+            rs = np.random.RandomState(0)
+            x = rs.randn(32, 8).astype(np.float32)
+            t = (rs.randint(0, 4, 32) + 1).astype(np.int32)
+            model = (nn.Sequential().add(nn.Linear(8, 4))
+                     .add(nn.LogSoftMax()))
+            opt = LocalOptimizer(model, LocalDataSet(x, t, shuffle=False),
+                                 nn.ClassNLLCriterion(), batch_size=16,
+                                 end_trigger=Trigger.max_epoch(1))
+            opt.set_checkpoint(str(tmp_path), Trigger.every_epoch())
+            return opt
+
+        build().optimize()
+        tag = ckpt.latest(str(tmp_path), paired_prefix="model.")
+        assert tag is not None
+        # the signature is recorded
+        blob, _ = ckpt.load_checkpoint(
+            str(tmp_path / f"optim.{tag}"), to_jax=False)
+        assert blob["world"]["processes"] == 1
+        # doctor the saved world: pretend a 4-process / 32-device run
+        blob["world"] = {"processes": 4, "devices": 32}
+        ckpt.save_checkpoint(str(tmp_path / f"optim.{tag}"), blob)
+
+        opt2 = build()
+        with pytest.raises(ValueError) as ei:
+            opt2.resume_from_checkpoint(str(tmp_path), tag)
+        msg = str(ei.value)
+        assert "4 process(es)" in msg and "32 device(s)" in msg
+        assert "1 process(es)" in msg      # saved vs current, by name
+        # the rejected resume left the optimizer untouched
+        assert opt2.state["neval"] == 1
+
+    def test_legacy_blob_resets_stale_batch_in_epoch(self, tmp_path):
+        """A pre-ISSUE-10 optim blob carries no batch_in_epoch; the
+        live (possibly nonzero) value must not survive the resume, or
+        the restored epoch silently skips that many batches."""
+        import bigdl_tpu.nn as nn
+        from bigdl_tpu.feature.dataset import LocalDataSet
+        from bigdl_tpu.nn.module import set_seed
+        from bigdl_tpu.optim.optimizer import LocalOptimizer
+        from bigdl_tpu.optim.trigger import Trigger
+        from bigdl_tpu.utils import checkpoint as ckpt
+
+        set_seed(0)
+        rs = np.random.RandomState(0)
+        x = rs.randn(32, 8).astype(np.float32)
+        t = (rs.randint(0, 4, 32) + 1).astype(np.int32)
+        model = (nn.Sequential().add(nn.Linear(8, 4))
+                 .add(nn.LogSoftMax()))
+        opt = LocalOptimizer(model, LocalDataSet(x, t, shuffle=False),
+                             nn.ClassNLLCriterion(), batch_size=16,
+                             end_trigger=Trigger.max_epoch(1))
+        opt.set_checkpoint(str(tmp_path), Trigger.every_epoch())
+        opt.optimize()
+        tag = ckpt.latest(str(tmp_path), paired_prefix="model.")
+        blob, _ = ckpt.load_checkpoint(str(tmp_path / f"optim.{tag}"),
+                                       to_jax=False)
+        del blob["train_state"]["batch_in_epoch"]   # legacy layout
+        ckpt.save_checkpoint(str(tmp_path / f"optim.{tag}"), blob)
+
+        opt.state["batch_in_epoch"] = 7            # stale live value
+        opt.resume_from_checkpoint(str(tmp_path), tag)
+        assert opt.state["batch_in_epoch"] == 0
+
+    def test_same_world_resume_still_works(self, tmp_path):
+        """The guard must not break the normal preemption round-trip."""
+        import bigdl_tpu.nn as nn
+        from bigdl_tpu.feature.dataset import LocalDataSet
+        from bigdl_tpu.nn.module import set_seed
+        from bigdl_tpu.optim.optimizer import LocalOptimizer
+        from bigdl_tpu.optim.trigger import Trigger
+        from bigdl_tpu.utils import checkpoint as ckpt
+
+        def build(epochs):
+            set_seed(0)
+            rs = np.random.RandomState(0)
+            x = rs.randn(32, 8).astype(np.float32)
+            t = (rs.randint(0, 4, 32) + 1).astype(np.int32)
+            model = (nn.Sequential().add(nn.Linear(8, 4))
+                     .add(nn.LogSoftMax()))
+            opt = LocalOptimizer(model, LocalDataSet(x, t, shuffle=False),
+                                 nn.ClassNLLCriterion(), batch_size=16,
+                                 end_trigger=Trigger.max_epoch(epochs))
+            opt.set_checkpoint(str(tmp_path), Trigger.every_epoch())
+            return opt
+
+        build(1).optimize()
+        tag = ckpt.latest(str(tmp_path), paired_prefix="model.")
+        opt2 = build(2)
+        opt2.resume_from_checkpoint(str(tmp_path), tag)
+        assert opt2.state["epoch"] == 2
+        opt2.optimize()                    # trains epoch 2 and finishes
+        assert opt2.state["epoch"] > 2
+
+
+# ---------------------------------------------------------------------------
+# Engine.init satellite: loud failure for explicit coordinators
+# ---------------------------------------------------------------------------
+
+class TestEngineInitFailures:
+    @pytest.fixture(autouse=True)
+    def _reset_engine(self):
+        from bigdl_tpu.utils.engine import Engine
+        Engine.reset()
+        yield
+        Engine.reset()
+
+    def test_explicit_coordinator_failure_raises_and_counts(
+            self, monkeypatch):
+        import jax
+        from bigdl_tpu.utils.engine import Engine
+
+        def boom(**kw):
+            raise RuntimeError("connection refused")
+
+        monkeypatch.setattr(jax.distributed, "initialize", boom)
+        with pytest.raises(RuntimeError) as ei:
+            Engine.init(coordinator_address="127.0.0.1:1",
+                        num_processes=2, process_id=0)
+        assert "explicitly configured coordinator" in str(ei.value)
+        assert "127.0.0.1:1" in str(ei.value)
+        assert _counter_value("bigdl_engine_init_failures_total") == 1
+
+    def test_env_autodetect_failure_is_best_effort(self, monkeypatch):
+        import jax
+        from bigdl_tpu.utils.engine import Engine
+
+        def boom(**kw):
+            raise RuntimeError("connection refused")
+
+        monkeypatch.setattr(jax.distributed, "initialize", boom)
+        monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "127.0.0.1:1")
+        mesh = Engine.init()               # warns, continues standalone
+        assert mesh is not None
+        assert Engine.is_initialized()
+        assert _counter_value("bigdl_engine_init_failures_total") == 1
+
+    def test_already_initialized_is_not_a_failure(self, monkeypatch):
+        import jax
+        from bigdl_tpu.utils.engine import Engine
+
+        def boom(**kw):
+            raise RuntimeError(
+                "jax.distributed.initialize was already called")
+
+        monkeypatch.setattr(jax.distributed, "initialize", boom)
+        mesh = Engine.init(coordinator_address="127.0.0.1:1")
+        assert mesh is not None
+        assert _counter_value("bigdl_engine_init_failures_total") == 0
+
+    def test_reinit_distributed_tears_down_and_rejoins(self, monkeypatch):
+        import jax
+        from bigdl_tpu.utils.engine import Engine
+
+        calls = []
+        monkeypatch.setattr(jax.distributed, "shutdown",
+                            lambda: calls.append("shutdown"))
+        monkeypatch.setattr(
+            jax.distributed, "initialize",
+            lambda **kw: calls.append(("init", kw["coordinator_address"])))
+        Engine.init()
+        mesh = Engine.reinit_distributed("127.0.0.1:2222",
+                                         num_processes=1, process_id=0)
+        assert mesh is not None
+        assert calls == ["shutdown", ("init", "127.0.0.1:2222")]
+        assert Engine.is_initialized()
+
+    def test_reinit_survives_wedged_shutdown(self, monkeypatch):
+        import jax
+        from bigdl_tpu.utils.engine import Engine
+
+        def bad_shutdown():
+            raise RuntimeError("client wedged on a dead peer")
+
+        monkeypatch.setattr(jax.distributed, "shutdown", bad_shutdown)
+        monkeypatch.setattr(jax.distributed, "initialize",
+                            lambda **kw: None)
+        mesh = Engine.reinit_distributed("127.0.0.1:2222",
+                                         num_processes=1, process_id=0)
+        assert mesh is not None
+
+
+# ---------------------------------------------------------------------------
+# launcher: jax-free worker sets (fast, real processes)
+# ---------------------------------------------------------------------------
+
+_EXIT_BY_GENERATION = (
+    "import os, sys; "
+    "sys.exit(0 if int(os.environ['BIGDL_TPU_ELASTIC_GENERATION']) >= %d "
+    "else %d)")
+
+
+class TestLauncher:
+    def _launcher(self, code, **kw):
+        from bigdl_tpu.elastic.launch import ElasticLauncher
+        env = {k: v for k, v in os.environ.items()}
+        return ElasticLauncher([sys.executable, "-c", code], nprocs=2,
+                               poll_interval=0.05, grace=2.0, env=env,
+                               **kw)
+
+    def test_clean_set_completes_without_restart(self):
+        rec = self._launcher("print('ok')",
+                             max_restarts=1).run(timeout=60)
+        assert rec["restarts"] == 0
+        assert rec["exit_codes"] == [0, 0]
+        assert rec["failures"] == []
+
+    def test_failed_generation_is_restarted(self):
+        # generation 0 exits 7; generation 1 exits 0
+        rec = self._launcher(_EXIT_BY_GENERATION % (1, 7),
+                             max_restarts=2).run(timeout=60)
+        assert rec["restarts"] == 1
+        assert rec["exit_codes"] == [0, 0]
+        assert any("code 7" in f for f in rec["failures"])
+
+    def test_restart_budget_exhaustion(self):
+        from bigdl_tpu.elastic.launch import ElasticJobFailed
+        with pytest.raises(ElasticJobFailed) as ei:
+            self._launcher("import sys; sys.exit(3)",
+                           max_restarts=1).run(timeout=60)
+        assert "restart budget exhausted" in str(ei.value)
+        assert ei.value.log_tails        # diagnostics attached
+
+    def test_workers_see_the_elastic_env(self):
+        code = ("import os; "
+                "assert os.environ['BIGDL_TPU_ELASTIC_ENABLED'] == 'true'; "
+                "assert ':' in os.environ["
+                "'BIGDL_TPU_ELASTIC_SUPERVISOR_ADDRESS']; "
+                "assert os.environ['BIGDL_TPU_NUM_PROCESSES'] == '2'; "
+                "assert os.environ['BIGDL_TPU_PROCESS_ID'] in ('0', '1'); "
+                "assert ':' in os.environ['BIGDL_TPU_COORDINATOR_ADDRESS']")
+        rec = self._launcher(code, max_restarts=0).run(timeout=60)
+        assert rec["exit_codes"] == [0, 0]
