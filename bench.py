@@ -941,6 +941,14 @@ def _telemetry_block() -> dict:
     except Exception as e:  # never lose the telemetry to the chaos run
         out["chaos_all"] = {"error": repr(e)}
     try:
+        # ISSUE 11: the static-analysis gate summary — finding counts
+        # by rule, zero-unbaselined verdict, baseline hygiene — lands
+        # in every bench round (+ one PROGRESS.jsonl breadcrumb) so
+        # finding-count drift across PRs is visible in telemetry
+        out["static_analysis"] = _static_analysis_block()
+    except Exception as e:
+        out["static_analysis"] = {"error": repr(e)}
+    try:
         # ISSUE 4: live-engine decode latency across pipeline depths —
         # the host-overlap win (and its host/stall attribution) lands in
         # every bench round next to the device-side decode numbers
@@ -976,6 +984,30 @@ def _telemetry_block() -> dict:
     except Exception as e:
         out["microbench_ragged"] = {"error": repr(e)}
     return out
+
+
+def _static_analysis_block() -> dict:
+    """Run the ISSUE 11 analyzer over the repo and compress its record
+    to the counts worth tracking round-over-round; append one
+    breadcrumb line to PROGRESS.jsonl (the bench_regress idiom)."""
+    import json as _json
+    import os
+    import time as _time
+    from bigdl_tpu.analysis import check as static_check
+    root = os.path.dirname(os.path.abspath(__file__))
+    sa = static_check(root)
+    block = {"ok": sa["ok"], "by_rule": sa["by_rule"],
+             "new": len(sa["new"]), "suppressed": sa["suppressed"],
+             "stale_baseline": len(sa["stale_baseline"]),
+             "baseline_errors": len(sa["baseline_errors"])}
+    try:
+        with open(os.path.join(root, "PROGRESS.jsonl"), "a") as f:
+            f.write(_json.dumps({"ts": _time.time(),
+                                 "kind": "static_analysis",
+                                 **block}) + "\n")
+    except OSError:
+        pass                      # the breadcrumb never fails the bench
+    return block
 
 
 def _regress_block() -> dict:

@@ -1,0 +1,113 @@
+"""Repo-native static-analysis suite (ISSUE 11).
+
+Three AST passes over ``bigdl_tpu/`` (stdlib ``ast`` only — the
+analyzed code is never imported or executed; ``tools/check_static.py``
+loads this package standalone via its relative imports, so the CLI
+gate runs without jax):
+
+- **concurrency** — lock-order cycles, unlocked cross-thread writes,
+  threads with no join path, bare ``acquire()`` (``concurrency.py``);
+- **hotpath** — implicit device syncs and jit cache-key hazards over
+  functions reachable from the serving engine pass and the optimizer
+  step loop (``hotpath.py``);
+- **registry** — conf keys / metric series / span names / fault sites /
+  pytest markers must resolve to the declared registries and appear in
+  docs (``registrydrift.py`` + ``registries.py``).
+
+Findings carry ``file:line`` + rule id; the checked-in
+``analysis/baseline.json`` suppresses triaged pre-existing findings
+(each with a required justification), so ``tools/check_static.py`` is
+a zero-new-findings CI gate from day one. The opt-in runtime witness
+(``bigdl.analysis.lockwatch``, ``lockwatch.py``) asserts observed lock
+orderings against the same lock names during chaos runs.
+
+This package deliberately does NOT import the rest of ``bigdl_tpu`` at
+module scope (``lockwatch`` reads conf lazily): ``import
+bigdl_tpu.analysis`` must stay cheap enough for CI hooks.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence
+
+from .baseline import (BASELINE_RELPATH, Baseline,
+                       BaselineEntry)
+from .core import Finding, ProjectIndex
+
+PASSES = ("concurrency", "hotpath", "registry")
+
+
+def build_index(root: str,
+                subdirs: Sequence[str] = ("bigdl_tpu",)) -> ProjectIndex:
+    return ProjectIndex.scan(root, subdirs)
+
+
+def run_analysis(root: str,
+                 passes: Sequence[str] = PASSES,
+                 index: Optional[ProjectIndex] = None) -> List[Finding]:
+    """Run the requested passes over the repo at ``root`` and return
+    every raw finding (baseline application is the caller's concern —
+    see :func:`check`)."""
+    usage: Optional[ProjectIndex] = None
+    if "registry" in passes:
+        # one superset scan serves all three scopes — the registry
+        # pass's usage index, its bigdl_tpu/tools enforcement subset,
+        # and (below) the bigdl_tpu-only index the other passes walk
+        usage = ProjectIndex.scan(
+            root, [d for d in ("bigdl_tpu", "tools", "tests", "examples")
+                   if os.path.exists(os.path.join(root, d))])
+    if index is None:
+        index = ProjectIndex.from_modules(root, {
+            rel: m for rel, m in usage.modules.items()
+            if rel.startswith("bigdl_tpu")}) \
+            if usage is not None else build_index(root)
+    findings: List[Finding] = []
+    if "concurrency" in passes:
+        from .concurrency import run_concurrency_pass
+        findings += run_concurrency_pass(index)
+    if "hotpath" in passes:
+        from .hotpath import run_hotpath_pass
+        findings += run_hotpath_pass(index)
+    if "registry" in passes:
+        from .registrydrift import run_registry_pass
+        enforce = ProjectIndex.from_modules(root, {
+            rel: m for rel, m in usage.modules.items()
+            if rel.startswith(("bigdl_tpu", "tools"))})
+        findings += run_registry_pass(enforce, usage_index=usage,
+                                      root=root)
+    findings.sort(key=lambda f: (f.rule, f.file, f.line, f.key))
+    return findings
+
+
+def check(root: str, baseline_path: Optional[str] = None,
+          passes: Sequence[str] = PASSES) -> dict:
+    """The gate: run passes, apply the baseline, summarize.
+
+    Returns a dict with ``ok`` (zero unbaselined findings and zero
+    baseline errors), ``new``/``suppressed`` finding lists,
+    ``stale_baseline`` fingerprints and per-rule counts — the shape
+    ``tools/check_static.py`` prints and ``bench.py`` embeds in its
+    telemetry block."""
+    baseline_path = baseline_path or os.path.join(root, BASELINE_RELPATH)
+    findings = run_analysis(root, passes=passes)
+    bl = Baseline.load(baseline_path)
+    new, suppressed, stale = bl.split(findings)
+    by_rule: Dict[str, int] = {}
+    for f in findings:
+        by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+    return {
+        "ok": not new and not bl.errors,
+        "total": len(findings),
+        "new": [f.to_dict() for f in new],
+        "suppressed": len(suppressed),
+        "stale_baseline": stale,
+        "baseline_errors": bl.errors,
+        "by_rule": dict(sorted(by_rule.items())),
+        "baseline_path": baseline_path,
+    }
+
+
+__all__ = ["Finding", "ProjectIndex", "Baseline", "BaselineEntry",
+           "BASELINE_RELPATH", "PASSES", "build_index", "run_analysis",
+           "check"]

@@ -1,0 +1,111 @@
+"""Findings baseline: triaged pre-existing findings, suppressed with a
+required justification (ISSUE 11 tentpole, findings engine).
+
+The gate is zero *new* findings from day one: everything the analyzer
+flagged at introduction time was either fixed or triaged into
+``analysis/baseline.json`` with a one-line justification naming why it
+is intentional (a designed sync fence, a fire-and-forget hedge thread,
+...). Matching is by :attr:`Finding.fingerprint` — rule + file +
+semantic key, deliberately line-number-free so unrelated edits don't
+churn the baseline.
+
+Hygiene rules the loader enforces:
+
+- every entry MUST carry a non-empty ``justification`` (an entry you
+  can't justify is a bug you're hiding) — violations are reported as
+  baseline errors and fail the gate;
+- entries whose finding no longer fires are *stale* and reported so
+  the baseline shrinks as code improves (``--prune`` rewrites the file
+  without them; ``--strict`` makes staleness fail).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .core import Finding
+
+#: default checked-in location, relative to the repo root
+BASELINE_RELPATH = "bigdl_tpu/analysis/baseline.json"
+
+
+@dataclass
+class BaselineEntry:
+    fingerprint: str
+    justification: str
+    rule: str = ""
+
+
+@dataclass
+class Baseline:
+    entries: Dict[str, BaselineEntry] = field(default_factory=dict)
+    errors: List[str] = field(default_factory=list)
+    path: Optional[str] = None
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        bl = cls(path=path)
+        if not os.path.exists(path):
+            return bl
+        with open(path) as f:
+            data = json.load(f)
+        for raw in data.get("entries", []):
+            fp = raw.get("fingerprint", "")
+            just = (raw.get("justification") or "").strip()
+            if not fp:
+                bl.errors.append("baseline entry missing fingerprint: "
+                                 f"{raw!r}")
+                continue
+            if not just:
+                bl.errors.append(
+                    f"baseline entry {fp!r} has no justification — "
+                    f"every suppression must say why")
+                continue
+            if fp in bl.entries:
+                bl.errors.append(f"duplicate baseline entry {fp!r}")
+                continue
+            bl.entries[fp] = BaselineEntry(
+                fingerprint=fp, justification=just,
+                rule=raw.get("rule", fp.split("::", 1)[0]))
+        return bl
+
+    def split(self, findings: Sequence[Finding]
+              ) -> Tuple[List[Finding], List[Finding], List[str]]:
+        """(new, suppressed, stale-fingerprints)."""
+        new, suppressed = [], []
+        seen = set()
+        for f in findings:
+            if f.fingerprint in self.entries:
+                suppressed.append(f)
+                seen.add(f.fingerprint)
+            else:
+                new.append(f)
+        stale = sorted(set(self.entries) - seen)
+        return new, suppressed, stale
+
+    def save(self, path: Optional[str] = None):
+        path = path or self.path
+        data = {"version": 1, "entries": [
+            {"fingerprint": e.fingerprint, "rule": e.rule,
+             "justification": e.justification}
+            for e in sorted(self.entries.values(),
+                            key=lambda e: e.fingerprint)]}
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(data, f, indent=1, sort_keys=False)
+            f.write("\n")
+        os.replace(tmp, path)
+
+    def add_findings(self, findings: Sequence[Finding],
+                     justification: str):
+        for f in findings:
+            self.entries.setdefault(f.fingerprint, BaselineEntry(
+                fingerprint=f.fingerprint, justification=justification,
+                rule=f.rule))
+
+    def prune(self, stale: Sequence[str]):
+        for fp in stale:
+            self.entries.pop(fp, None)

@@ -1,0 +1,502 @@
+"""Concurrency pass: lock-order cycles, unlocked shared writes, thread
+lifecycle (ISSUE 11 tentpole pass 1).
+
+The threaded subsystems (pipelined engine, kvtier migrator, failover
+prober, elastic agent/supervisor, metrics registry) follow a small set
+of conventions this pass turns into rules:
+
+- ``lock-order`` — the lock-acquisition graph (lock A held while lock B
+  is acquired, through the conservative call graph) must be acyclic; a
+  cycle is a potential deadlock the moment two threads run the two
+  witnesses concurrently.
+- ``unlocked-write`` — an attribute written both from a
+  thread-entry-reachable function and from elsewhere must share at
+  least one lock across all its write sites (``__init__`` is exempt:
+  construction happens-before the thread start).
+- ``thread-no-join`` — every started ``threading.Thread`` needs a
+  reachable ``join()`` (a stop/retire path); fire-and-forget threads
+  outlive their work and leak on shutdown.
+- ``bare-acquire`` — ``lock.acquire()`` outside a ``with`` block and
+  without a ``finally: ...release()`` leaks the lock on any exception
+  between the two calls.
+
+Lock identity is the *declaration site* (``module::Class.attr``), not
+the instance — the same grouping ``lockwatch`` uses at runtime, so the
+static graph and the runtime witness speak the same names.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import (ClassInfo, CallResolver, Finding, FuncRef,
+                   ModuleInfo, ProjectIndex, iter_functions, reachable)
+
+
+class _FuncFacts:
+    """What one function does with locks/threads/attributes."""
+
+    def __init__(self):
+        self.acquires: List[Tuple[str, int]] = []      # (lock, line)
+        self.direct_edges: List[Tuple[str, str, int]] = []
+        self.calls_under: List[Tuple[Tuple[str, ...], ast.Call]] = []
+        self.attr_writes: List[Tuple[str, Tuple[str, ...], int]] = []
+        self.bare_acquires: List[Tuple[str, int]] = []
+        self.thread_creations: List[dict] = []
+
+
+def _lock_of_expr(expr: ast.AST, mod: ModuleInfo,
+                  cinfo: Optional[ClassInfo]) -> Optional[str]:
+    """Lock id of ``self._lock`` / module-level ``_lock`` expressions."""
+    if isinstance(expr, ast.Attribute) and \
+            isinstance(expr.value, ast.Name) and expr.value.id == "self" \
+            and cinfo is not None and expr.attr in cinfo.lock_attrs:
+        return cinfo.lock_id(expr.attr)
+    if isinstance(expr, ast.Name) and expr.id in mod.module_locks:
+        return mod.module_locks[expr.id]
+    return None
+
+
+def _collect(node: ast.AST, mod: ModuleInfo,
+             cinfo: Optional[ClassInfo]) -> _FuncFacts:
+    facts = _FuncFacts()
+
+    def visit(stmts, held: Tuple[str, ...], finally_releases: Set[str]):
+        # the repo idiom puts acquire() on the line BEFORE the
+        # try/finally that releases — credit any finally-release in
+        # the same block to every acquire in it
+        block_releases = set(finally_releases)
+        for stmt in stmts:
+            if isinstance(stmt, ast.Try):
+                for sub in stmt.finalbody:
+                    for call in ast.walk(sub):
+                        if isinstance(call, ast.Call) and \
+                                isinstance(call.func, ast.Attribute) \
+                                and call.func.attr == "release":
+                            lock = _lock_of_expr(call.func.value, mod,
+                                                 cinfo)
+                            if lock:
+                                block_releases.add(lock)
+        for stmt in stmts:
+            _visit_stmt(stmt, held, block_releases)
+
+    def _visit_stmt(stmt, held, finally_releases):
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return          # a nested def's body runs later, not here
+        if isinstance(stmt, ast.With):
+            new = list(held)
+            for item in stmt.items:
+                lock = _lock_of_expr(item.context_expr, mod, cinfo)
+                if lock is None and isinstance(item.context_expr,
+                                               ast.Call):
+                    _visit_expr(item.context_expr, tuple(new))
+                    continue
+                if lock is not None:
+                    for h in new:
+                        if h != lock:
+                            facts.direct_edges.append(
+                                (h, lock, stmt.lineno))
+                    facts.acquires.append((lock, stmt.lineno))
+                    new.append(lock)
+            visit(stmt.body, tuple(new), finally_releases)
+            return
+        if isinstance(stmt, ast.Try):
+            released = set(finally_releases)
+            for sub in stmt.finalbody:
+                for call in ast.walk(sub):
+                    if isinstance(call, ast.Call) and \
+                            isinstance(call.func, ast.Attribute) and \
+                            call.func.attr == "release":
+                        lock = _lock_of_expr(call.func.value, mod, cinfo)
+                        if lock:
+                            released.add(lock)
+            visit(stmt.body, held, released)
+            for handler in stmt.handlers:
+                visit(handler.body, held, finally_releases)
+            visit(stmt.orelse, held, finally_releases)
+            visit(stmt.finalbody, held, finally_releases)
+            return
+        # generic statement: expressions + nested blocks
+        for f in ast.iter_fields(stmt):
+            val = f[1]
+            items = val if isinstance(val, list) else [val]
+            for item in items:
+                if isinstance(item, ast.stmt):
+                    _visit_stmt(item, held, finally_releases)
+                elif isinstance(item, ast.AST):
+                    _visit_expr(item, held,
+                                finally_releases=finally_releases)
+        if isinstance(stmt, (ast.Assign, ast.AugAssign)):
+            targets = stmt.targets if isinstance(stmt, ast.Assign) \
+                else [stmt.target]
+            for tgt in targets:
+                if isinstance(tgt, (ast.Attribute, ast.Subscript)):
+                    base = tgt.value if isinstance(tgt, ast.Subscript) \
+                        else tgt
+                    if isinstance(base, ast.Attribute) and \
+                            isinstance(base.value, ast.Name) and \
+                            base.value.id == "self":
+                        facts.attr_writes.append(
+                            (base.attr, held, stmt.lineno))
+
+    def _visit_expr(expr, held, finally_releases: Set[str] = frozenset()):
+        for sub in ast.walk(expr):
+            if not isinstance(sub, ast.Call):
+                continue
+            f = sub.func
+            if isinstance(f, ast.Attribute):
+                if f.attr == "acquire":
+                    lock = _lock_of_expr(f.value, mod, cinfo)
+                    if lock is not None:
+                        facts.acquires.append((lock, sub.lineno))
+                        for h in held:
+                            if h != lock:
+                                facts.direct_edges.append(
+                                    (h, lock, sub.lineno))
+                        if lock not in finally_releases:
+                            facts.bare_acquires.append((lock, sub.lineno))
+                        continue
+                if f.attr == "Thread" and \
+                        isinstance(f.value, ast.Name) and \
+                        f.value.id == "threading":
+                    facts.thread_creations.append(
+                        {"node": sub, "line": sub.lineno})
+            facts.calls_under.append((held, sub))
+
+    body = getattr(node, "body", [])
+    visit(body, (), set())
+    return facts
+
+
+def _thread_target_ref(call: ast.Call, mod: ModuleInfo,
+                       cinfo: Optional[ClassInfo]) -> Optional[FuncRef]:
+    for kw in call.keywords:
+        if kw.arg == "target":
+            v = kw.value
+            if isinstance(v, ast.Attribute) and \
+                    isinstance(v.value, ast.Name) and \
+                    v.value.id == "self" and cinfo is not None and \
+                    v.attr in cinfo.methods:
+                return FuncRef(mod.relpath, cinfo.name, v.attr)
+            if isinstance(v, ast.Name) and v.id in mod.functions:
+                return FuncRef(mod.relpath, None, v.id)
+    return None
+
+
+def run_concurrency_pass(index: ProjectIndex) -> List[Finding]:
+    resolver = CallResolver(index)
+    facts: Dict[FuncRef, _FuncFacts] = {}
+    owners: Dict[FuncRef, Tuple[ModuleInfo, Optional[ClassInfo]]] = {}
+    for mod, cinfo, name, node in iter_functions(index):
+        ref = FuncRef(mod.relpath, cinfo.name if cinfo else None, name)
+        facts[ref] = _collect(node, mod, cinfo)
+        owners[ref] = (mod, cinfo)
+
+    # thread entries (for unlocked-write) + creation sites (for join)
+    thread_entries: Set[FuncRef] = set()
+    for ref, fc in facts.items():
+        mod, cinfo = owners[ref]
+        for tc in fc.thread_creations:
+            tgt = _thread_target_ref(tc["node"], mod, cinfo)
+            if tgt is not None:
+                thread_entries.add(tgt)
+    thread_reachable = reachable(index, thread_entries)
+
+    entry_held = _entry_held_fixpoint(facts, owners, resolver,
+                                      thread_entries)
+    acq_trans = _transitive_acquires(facts, owners, resolver)
+
+    findings: List[Finding] = []
+    findings += _lock_order_findings(facts, owners, resolver,
+                                     entry_held, acq_trans)
+    findings += _unlocked_write_findings(index, facts, owners,
+                                         entry_held, thread_reachable)
+    findings += _thread_join_findings(index, facts, owners)
+    for ref, fc in facts.items():
+        for lock, line in fc.bare_acquires:
+            findings.append(Finding(
+                rule="bare-acquire", file=ref.module, line=line,
+                key=f"{ref.qualname}:{lock.split('::')[-1]}",
+                message=f"{ref.qualname} calls acquire() on "
+                        f"{lock.split('::')[-1]} outside a with-block "
+                        f"and without a finally release"))
+    return findings
+
+
+def _entry_held_fixpoint(facts, owners, resolver, thread_entries):
+    """Locks *provably* held on entry to each internal (underscore-
+    prefixed) function: the intersection over all resolved call sites.
+    Public functions, thread entries and functions with no resolved
+    callers are assumed entered bare. ``None`` = not yet constrained."""
+    entry: Dict[FuncRef, Optional[frozenset]] = {}
+    callers: Dict[FuncRef, List[Tuple[FuncRef, Tuple[str, ...]]]] = {}
+    for ref, fc in facts.items():
+        mod, cinfo = owners[ref]
+        for held, call in fc.calls_under:
+            for callee in resolver.resolve(call, mod, cinfo):
+                callers.setdefault(callee, []).append((ref, held))
+    pinned: Set[FuncRef] = set()
+    for ref in facts:
+        internal = ref.name.startswith("_") and \
+            not ref.name.startswith("__")
+        if not internal or ref in thread_entries or ref not in callers:
+            entry[ref] = frozenset()
+            pinned.add(ref)         # public/thread-entry: entered bare
+        else:
+            entry[ref] = None       # None = unconstrained (universe)
+    for _ in range(len(facts)):
+        changed = False
+        for ref, sites in callers.items():
+            if ref not in entry or ref in pinned:
+                continue
+            acc: Optional[frozenset] = None
+            for caller, held in sites:
+                ctx = entry.get(caller)
+                if ctx is None and not held:
+                    continue        # universe term: intersection no-op
+                site_held = frozenset(held) | (ctx or frozenset())
+                acc = site_held if acc is None else (acc & site_held)
+            if acc != entry[ref]:
+                entry[ref] = acc
+                changed = True
+        if not changed:
+            break
+    return {r: (v or frozenset()) for r, v in entry.items()}
+
+
+def _transitive_acquires(facts, owners, resolver):
+    acq: Dict[FuncRef, Set[str]] = {
+        ref: {l for l, _ in fc.acquires} for ref, fc in facts.items()}
+    callees: Dict[FuncRef, Set[FuncRef]] = {}
+    for ref, fc in facts.items():
+        mod, cinfo = owners[ref]
+        outs = set()
+        for _, call in fc.calls_under:
+            outs.update(resolver.resolve(call, mod, cinfo))
+        callees[ref] = {c for c in outs if c in acq}
+    for _ in range(len(facts)):
+        changed = False
+        for ref in facts:
+            before = len(acq[ref])
+            for c in callees[ref]:
+                acq[ref] |= acq[c]
+            if len(acq[ref]) != before:
+                changed = True
+        if not changed:
+            break
+    return acq
+
+
+def _lock_order_findings(facts, owners, resolver, entry_held, acq_trans):
+    """Edges -> digraph -> inconsistent orders. An edge A->B means
+    "acquired B while (possibly transitively) holding A"."""
+    edges: Dict[Tuple[str, str], Tuple[str, int]] = {}
+
+    def add_edge(a, b, file, line):
+        if a != b:
+            edges.setdefault((a, b), (file, line))
+
+    for ref, fc in facts.items():
+        mod, cinfo = owners[ref]
+        ctx = entry_held.get(ref, frozenset())
+        for a, b, line in fc.direct_edges:
+            add_edge(a, b, ref.module, line)
+        for lock, line in fc.acquires:
+            for h in ctx:
+                add_edge(h, lock, ref.module, line)
+        for held, call in fc.calls_under:
+            full = set(held) | ctx
+            if not full:
+                continue
+            for callee in resolver.resolve(call, mod, cinfo):
+                for b in acq_trans.get(callee, ()):
+                    for a in full:
+                        add_edge(a, b, ref.module, call.lineno)
+
+    findings: List[Finding] = []
+    seen_pairs: Set[Tuple[str, str]] = set()
+    for (a, b), (file, line) in sorted(edges.items()):
+        if (b, a) in edges and tuple(sorted((a, b))) not in seen_pairs:
+            pair = tuple(sorted((a, b)))
+            seen_pairs.add(pair)
+            rfile, rline = edges[(b, a)]
+            sa = a.split("::")[-1]
+            sb = b.split("::")[-1]
+            findings.append(Finding(
+                rule="lock-order", file=file, line=line,
+                key=f"{pair[0].split('::')[-1]}<->{pair[1].split('::')[-1]}",
+                message=f"inconsistent lock order: {sa} -> {sb} "
+                        f"({file}:{line}) but {sb} -> {sa} "
+                        f"({rfile}:{rline}) — potential deadlock"))
+    return findings
+
+
+_EXEMPT_WRITE_METHODS = ("__init__", "__new__", "__enter__")
+#: attr suffixes that are synchronization/bookkeeping primitives — their
+#: construction-time replacement is itself the synchronization point
+_EXEMPT_ATTR_HINTS = ("_lock", "_thread", "_stop", "_event")
+
+
+def _unlocked_write_findings(index, facts, owners, entry_held,
+                             thread_reachable):
+    findings: List[Finding] = []
+    for mod in index.modules.values():
+        for cinfo in mod.classes.values():
+            writes: Dict[str, List[Tuple[FuncRef, frozenset, int]]] = {}
+            for name in cinfo.methods:
+                if name in _EXEMPT_WRITE_METHODS:
+                    continue
+                ref = FuncRef(mod.relpath, cinfo.name, name)
+                fc = facts.get(ref)
+                if fc is None:
+                    continue
+                ctx = entry_held.get(ref, frozenset())
+                for attr, held, line in fc.attr_writes:
+                    if any(attr.endswith(h) for h in _EXEMPT_ATTR_HINTS):
+                        continue
+                    writes.setdefault(attr, []).append(
+                        (ref, frozenset(held) | ctx, line))
+            for attr, sites in writes.items():
+                funcs = {s[0] for s in sites}
+                threaded = {f for f in funcs if f in thread_reachable}
+                if not threaded or threaded == funcs:
+                    continue        # one side only: no cross-thread race
+                common = frozenset.intersection(
+                    *[s[1] for s in sites])
+                if common:
+                    continue
+                t = sorted(f.name for f in threaded)
+                o = sorted(f.name for f in funcs - threaded)
+                first = min(sites, key=lambda s: s[2])
+                findings.append(Finding(
+                    rule="unlocked-write", file=mod.relpath,
+                    line=first[2],
+                    key=f"{cinfo.name}.{attr}",
+                    message=f"{cinfo.name}.{attr} written from thread-"
+                            f"reachable {t} and from {o} with no common "
+                            f"lock across all write sites"))
+    return findings
+
+
+def _thread_join_findings(index, facts, owners):
+    findings: List[Finding] = []
+    for ref, fc in facts.items():
+        mod, cinfo = owners[ref]
+        node = index.func_node(ref)
+        func_src = mod.segment(node)
+        for tc in fc.thread_creations:
+            holder = _thread_holder(tc["node"], node)
+            joined = False
+            if holder is not None and holder[0] == "attr":
+                # self.<attr> (direct, inside a list literal, or via
+                # container.append): some method must both mention the
+                # attr and call .join(
+                joined = _class_joins(mod, cinfo, node, holder[1])
+            elif holder is not None:      # plain local variable
+                name = holder[1]
+                joined = f"{name}.join(" in func_src
+            if not joined:
+                shown = holder[1] if holder else "no binding"
+                findings.append(Finding(
+                    rule="thread-no-join", file=ref.module,
+                    line=tc["line"],
+                    key=f"{ref.qualname}:{shown}",
+                    message=f"thread started in {ref.qualname} "
+                            f"(held as {shown}) has no reachable "
+                            f"join() — no stop/retire path"))
+    return findings
+
+
+def _class_joins(mod, cinfo, fallback_node, attr: str) -> bool:
+    scope = cinfo.methods.values() if cinfo else [fallback_node]
+    for meth in scope:
+        src = mod.segment(meth)
+        if attr in src and ".join(" in src:
+            return True
+    return False
+
+
+def _mentions_name(node: ast.AST, name: str) -> bool:
+    """True when ``node`` is (or contains, e.g. the tuple in
+    ``self._conns.append((t, conn))``) the bare Name ``name``."""
+    return any(isinstance(n, ast.Name) and n.id == name
+               for n in ast.walk(node))
+
+
+def _thread_holder(call: ast.Call,
+                   func_node: ast.AST) -> Optional[Tuple[str, str]]:
+    """Where the Thread object lands: ("attr", name) for anything
+    rooted at ``self`` (direct assignment, a list-literal assignment,
+    or ``self.<c>.append(t)`` of a local), ("local", name) for a plain
+    local, None for inline ``threading.Thread(...).start()``."""
+    local: Optional[str] = None
+    for stmt in ast.walk(func_node):
+        if isinstance(stmt, ast.Assign):
+            covered = stmt.value is call or (
+                isinstance(stmt.value, (ast.List, ast.Tuple)) and
+                call in stmt.value.elts)
+            if covered:
+                tgt = stmt.targets[0]
+                if isinstance(tgt, ast.Attribute) and \
+                        isinstance(tgt.value, ast.Name) and \
+                        tgt.value.id == "self":
+                    return ("attr", tgt.attr)
+                if isinstance(tgt, ast.Name):
+                    local = tgt.id
+    if local is not None:
+        # stored in a self container? self._threads.append(t)
+        for stmt in ast.walk(func_node):
+            if isinstance(stmt, ast.Call) and \
+                    isinstance(stmt.func, ast.Attribute) and \
+                    stmt.func.attr == "append" and \
+                    any(_mentions_name(a, local) for a in stmt.args) \
+                    and isinstance(stmt.func.value, ast.Attribute) and \
+                    isinstance(stmt.func.value.value, ast.Name) and \
+                    stmt.func.value.value.id == "self":
+                return ("attr", stmt.func.value.attr)
+        return ("local", local)
+    return None
+
+
+def lock_graph(index: ProjectIndex) -> Dict[str, List[str]]:
+    """The static lock-order graph as adjacency lists — what
+    ``tools/check_static.py --dump-graph`` prints and what lockwatch
+    readers compare runtime edges against."""
+    resolver = CallResolver(index)
+    facts: Dict[FuncRef, _FuncFacts] = {}
+    owners = {}
+    for mod, cinfo, name, node in iter_functions(index):
+        ref = FuncRef(mod.relpath, cinfo.name if cinfo else None, name)
+        facts[ref] = _collect(node, mod, cinfo)
+        owners[ref] = (mod, cinfo)
+    thread_entries = set()
+    for ref, fc in facts.items():
+        mod, cinfo = owners[ref]
+        for tc in fc.thread_creations:
+            tgt = _thread_target_ref(tc["node"], mod, cinfo)
+            if tgt is not None:
+                thread_entries.add(tgt)
+    entry_held = _entry_held_fixpoint(facts, owners, resolver,
+                                      thread_entries)
+    acq_trans = _transitive_acquires(facts, owners, resolver)
+    adj: Dict[str, Set[str]] = {}
+    for ref, fc in facts.items():
+        mod, cinfo = owners[ref]
+        ctx = entry_held.get(ref, frozenset())
+        for a, b, _ in fc.direct_edges:
+            adj.setdefault(a, set()).add(b)
+        for lock, _ in fc.acquires:
+            for h in ctx:
+                if h != lock:
+                    adj.setdefault(h, set()).add(lock)
+        for held, call in fc.calls_under:
+            full = set(held) | ctx
+            if not full:
+                continue
+            for callee in resolver.resolve(call, mod, cinfo):
+                for b in acq_trans.get(callee, ()):
+                    for a in full:
+                        if a != b:
+                            adj.setdefault(a, set()).add(b)
+    return {k: sorted(v) for k, v in sorted(adj.items())}

@@ -1,0 +1,355 @@
+"""Shared AST index for the static-analysis passes (ISSUE 11).
+
+Five PRs of robustness work left the repo with ~34 lock constructs,
+~30 background threads and a hundred-odd registry-worthy string
+literals — each reviewed by hand, every time. This package turns the
+invariants those reviews keep re-deriving into machine-checked rules
+over the stdlib ``ast`` (no new dependencies, no imports of the
+analyzed code — jax never loads).
+
+``core`` holds what every pass shares:
+
+- :class:`Finding` — one rule violation with a *stable* fingerprint
+  (rule + file + semantic key, no line numbers) so the checked-in
+  baseline survives unrelated edits;
+- :class:`ModuleInfo` / :class:`ProjectIndex` — parsed modules plus a
+  light symbol layer: classes, methods, module functions, per-class
+  attribute types inferred from ``self.x = ClassName(...)`` in
+  ``__init__`` (enough to resolve ``self.x.method()`` calls), lock
+  attributes, thread-entry targets;
+- :class:`CallResolver` — the conservative call-graph used by both the
+  concurrency pass (locks acquired downstream of a held lock) and the
+  hot-path pass (functions reachable from the engine/step loops). Only
+  confidently-resolvable edges exist: ``self.m()``, same-module
+  ``fn()``, and ``self.attr.m()`` where ``attr``'s class is known.
+
+Passes subclass nothing; they are functions taking a
+:class:`ProjectIndex` and returning ``List[Finding]`` — see
+``concurrency.py`` / ``hotpath.py`` / ``registrydrift.py``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+#: Constructors treated as lock objects for the concurrency pass.
+LOCK_FACTORIES = ("Lock", "RLock", "Condition")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation.
+
+    ``key`` is the semantic identity of the finding (lock pair,
+    attribute name, literal, ...) — the fingerprint deliberately
+    excludes line numbers so baselined findings survive edits that
+    merely move code."""
+
+    rule: str
+    file: str              # repo-relative path
+    line: int
+    message: str
+    key: str
+
+    @property
+    def fingerprint(self) -> str:
+        return f"{self.rule}::{self.file}::{self.key}"
+
+    def to_dict(self) -> dict:
+        return {"rule": self.rule, "file": self.file, "line": self.line,
+                "message": self.message, "fingerprint": self.fingerprint}
+
+
+class ModuleInfo:
+    """One parsed source file + its symbol summary."""
+
+    def __init__(self, root: str, relpath: str):
+        self.relpath = relpath
+        with open(os.path.join(root, relpath)) as f:
+            self.source = f.read()
+        self.tree = ast.parse(self.source, filename=relpath)
+        self._lines = self.source.splitlines()
+        #: top-level class name -> ClassInfo
+        self.classes: Dict[str, "ClassInfo"] = {}
+        #: module-level function name -> FunctionDef
+        self.functions: Dict[str, ast.AST] = {}
+        #: imported name -> dotted module/attr it refers to
+        self.imports: Dict[str, str] = {}
+        #: module-level lock variables (name -> lock id)
+        self.module_locks: Dict[str, str] = {}
+        self._index()
+
+    def _index(self):
+        for node in self.tree.body:
+            if isinstance(node, ast.ClassDef):
+                self.classes[node.name] = ClassInfo(self, node)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.functions[node.name] = node
+            elif isinstance(node, ast.Import):
+                for a in node.names:
+                    self.imports[a.asname or a.name.split(".")[0]] = a.name
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    self.imports[a.asname or a.name] = \
+                        f"{node.module}.{a.name}"
+            elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                name = node.targets[0].id
+                if _is_lock_ctor(node.value):
+                    self.module_locks[name] = f"{self.relpath}::{name}"
+
+    def segment(self, node: ast.AST) -> str:
+        """Source text of a node's line span — the cheap replacement
+        for ``ast.get_source_segment``, which re-splits the whole file
+        per call."""
+        start = getattr(node, "lineno", 1) - 1
+        end = getattr(node, "end_lineno", start + 1)
+        return "\n".join(self._lines[start:end])
+
+    def imports_jax(self) -> bool:
+        """Does this module import jax/jnp (i.e. can its casts touch
+        device arrays at all)?"""
+        return any(tgt == "jax" or tgt.startswith("jax.")
+                   or tgt == "jax.numpy"
+                   for tgt in self.imports.values())
+
+
+def _is_lock_ctor(value: ast.AST) -> bool:
+    """``threading.Lock()`` / ``Lock()`` / RLock / Condition."""
+    if not isinstance(value, ast.Call):
+        return False
+    f = value.func
+    if isinstance(f, ast.Attribute) and f.attr in LOCK_FACTORIES and \
+            isinstance(f.value, ast.Name) and f.value.id == "threading":
+        return True
+    return isinstance(f, ast.Name) and f.id in LOCK_FACTORIES
+
+
+class ClassInfo:
+    """Per-class symbol summary: methods, lock attrs, attribute types."""
+
+    def __init__(self, module: ModuleInfo, node: ast.ClassDef):
+        self.module = module
+        self.node = node
+        self.name = node.name
+        self.methods: Dict[str, ast.AST] = {}
+        #: attrs assigned a lock constructor anywhere in the class
+        self.lock_attrs: Set[str] = set()
+        #: attr -> simple ctor name it was assigned (``self.x = Foo()``)
+        self.attr_ctors: Dict[str, str] = {}
+        for item in node.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.methods[item.name] = item
+        for meth in self.methods.values():
+            for sub in ast.walk(meth):
+                if isinstance(sub, ast.Assign) and len(sub.targets) == 1:
+                    tgt = sub.targets[0]
+                    if isinstance(tgt, ast.Attribute) and \
+                            isinstance(tgt.value, ast.Name) and \
+                            tgt.value.id == "self":
+                        if _is_lock_ctor(sub.value):
+                            self.lock_attrs.add(tgt.attr)
+                        elif isinstance(sub.value, ast.Call):
+                            ctor = _ctor_name(sub.value.func)
+                            if ctor:
+                                self.attr_ctors.setdefault(tgt.attr, ctor)
+
+    def lock_id(self, attr: str) -> str:
+        return f"{self.module.relpath}::{self.name}.{attr}"
+
+
+def _ctor_name(func: ast.AST) -> Optional[str]:
+    """``Foo(...)`` -> "Foo"; ``mod.Foo(...)`` -> "Foo" (capitalized
+    attrs only, so ``self.x = obj.method()`` is not misread)."""
+    if isinstance(func, ast.Name) and func.id[:1].isupper():
+        return func.id
+    if isinstance(func, ast.Attribute) and func.attr[:1].isupper():
+        return func.attr
+    return None
+
+
+@dataclass
+class FuncRef:
+    """A (module, class, method) coordinate — the call-graph node."""
+    module: str                   # relpath
+    cls: Optional[str]
+    name: str
+
+    @property
+    def qualname(self) -> str:
+        base = f"{self.module}::"
+        return base + (f"{self.cls}.{self.name}" if self.cls else self.name)
+
+    def __hash__(self):
+        return hash((self.module, self.cls, self.name))
+
+
+class ProjectIndex:
+    """Every parsed module under the scanned roots + lookup tables."""
+
+    def __init__(self, root: str, relpaths: Iterable[str]):
+        self.root = root
+        self.modules: Dict[str, ModuleInfo] = {}
+        self.errors: List[Tuple[str, str]] = []
+        for rel in sorted(relpaths):
+            try:
+                self.modules[rel] = ModuleInfo(root, rel)
+            except (SyntaxError, UnicodeDecodeError, OSError) as e:
+                self.errors.append((rel, f"{type(e).__name__}: {e}"))
+        self._build_class_table()
+
+    def _build_class_table(self):
+        #: class name -> [(relpath, ClassInfo)] — used to resolve
+        #: ``self.attr = Foo(...)`` attribute types across modules
+        self.classes_by_name: Dict[str, List[Tuple[str, ClassInfo]]] = {}
+        for rel, mod in self.modules.items():
+            for cname, cinfo in mod.classes.items():
+                self.classes_by_name.setdefault(cname, []).append(
+                    (rel, cinfo))
+
+    @classmethod
+    def from_modules(cls, root: str,
+                     modules: Dict[str, ModuleInfo]) -> "ProjectIndex":
+        """A filtered view reusing already-parsed modules (one scan of
+        the superset serves both enforcement and usage scopes)."""
+        self = cls.__new__(cls)
+        self.root = root
+        self.modules = dict(modules)
+        self.errors = []
+        self._build_class_table()
+        return self
+
+    @classmethod
+    def scan(cls, root: str,
+             subdirs: Iterable[str] = ("bigdl_tpu",)) -> "ProjectIndex":
+        rels: List[str] = []
+        for sub in subdirs:
+            base = os.path.join(root, sub)
+            if os.path.isfile(base) and base.endswith(".py"):
+                rels.append(os.path.relpath(base, root))
+                continue
+            for dirpath, dirnames, filenames in os.walk(base):
+                dirnames[:] = [d for d in dirnames
+                               if d not in ("__pycache__",)]
+                for fn in filenames:
+                    if fn.endswith(".py"):
+                        rels.append(os.path.relpath(
+                            os.path.join(dirpath, fn), root))
+        return cls(root, rels)
+
+    # -- lookups -------------------------------------------------------------
+    def func_node(self, ref: FuncRef) -> Optional[ast.AST]:
+        mod = self.modules.get(ref.module)
+        if mod is None:
+            return None
+        if ref.cls:
+            cinfo = mod.classes.get(ref.cls)
+            return cinfo.methods.get(ref.name) if cinfo else None
+        return mod.functions.get(ref.name)
+
+    def resolve_attr_class(self, mod: ModuleInfo, cinfo: ClassInfo,
+                           attr: str) -> Optional[Tuple[str, ClassInfo]]:
+        """Class of ``self.<attr>`` when ``__init__`` assigned it a
+        constructor we can name. Ambiguous class names (several classes
+        in the tree share it) resolve via the module's imports first,
+        then give up rather than guess."""
+        ctor = cinfo.attr_ctors.get(attr)
+        if not ctor:
+            return None
+        candidates = self.classes_by_name.get(ctor, [])
+        if len(candidates) == 1:
+            return candidates[0]
+        imported = mod.imports.get(ctor)
+        if imported:
+            modpath = imported.rsplit(".", 1)[0].replace(".", "/") + ".py"
+            for rel, ci in candidates:
+                if rel == modpath or rel.endswith(modpath):
+                    return (rel, ci)
+        if ctor in mod.classes:
+            return (mod.relpath, mod.classes[ctor])
+        return None
+
+
+class CallResolver:
+    """Resolve a call expression at a site inside (module, class) to
+    callee :class:`FuncRef`s. Deliberately conservative: unresolvable
+    calls return [] — both passes prefer missing an edge to inventing
+    one (the baseline absorbs true positives; false cycles would make
+    the gate cry wolf)."""
+
+    def __init__(self, index: ProjectIndex):
+        self.index = index
+
+    def resolve(self, call: ast.Call, mod: ModuleInfo,
+                cinfo: Optional[ClassInfo]) -> List[FuncRef]:
+        f = call.func
+        if isinstance(f, ast.IfExp):
+            # (self.a if cond else self.b)(...) — either may run
+            out: List[FuncRef] = []
+            for branch in (f.body, f.orelse):
+                fake = ast.Call(func=branch, args=call.args,
+                                keywords=call.keywords)
+                out.extend(self.resolve(fake, mod, cinfo))
+            return out
+        # self.m(...)
+        if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+            if f.value.id == "self" and cinfo is not None:
+                if f.attr in cinfo.methods:
+                    return [FuncRef(mod.relpath, cinfo.name, f.attr)]
+                return []
+        # self.attr.m(...)
+        if isinstance(f, ast.Attribute) and \
+                isinstance(f.value, ast.Attribute) and \
+                isinstance(f.value.value, ast.Name) and \
+                f.value.value.id == "self" and cinfo is not None:
+            target = self.index.resolve_attr_class(mod, cinfo,
+                                                   f.value.attr)
+            if target and f.attr in target[1].methods:
+                rel, ci = target
+                return [FuncRef(rel, ci.name, f.attr)]
+            return []
+        # fn(...) — same-module function or class constructor
+        if isinstance(f, ast.Name):
+            if f.id in mod.functions:
+                return [FuncRef(mod.relpath, None, f.id)]
+            if f.id in mod.classes and \
+                    "__init__" in mod.classes[f.id].methods:
+                return [FuncRef(mod.relpath, f.id, "__init__")]
+        return []
+
+
+def reachable(index: ProjectIndex, roots: Iterable[FuncRef]
+              ) -> Set[FuncRef]:
+    """Transitive closure of the conservative call graph from roots."""
+    resolver = CallResolver(index)
+    seen: Set[FuncRef] = set()
+    stack = [r for r in roots if index.func_node(r) is not None]
+    while stack:
+        ref = stack.pop()
+        if ref in seen:
+            continue
+        seen.add(ref)
+        node = index.func_node(ref)
+        mod = index.modules[ref.module]
+        cinfo = mod.classes.get(ref.cls) if ref.cls else None
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                for callee in resolver.resolve(sub, mod, cinfo):
+                    if callee not in seen and \
+                            index.func_node(callee) is not None:
+                        stack.append(callee)
+    return seen
+
+
+def iter_functions(index: ProjectIndex):
+    """Yield (ModuleInfo, ClassInfo|None, name, node) for every
+    function/method in the project."""
+    for mod in index.modules.values():
+        for name, node in mod.functions.items():
+            yield mod, None, name, node
+        for cinfo in mod.classes.values():
+            for name, node in cinfo.methods.items():
+                yield mod, cinfo, name, node

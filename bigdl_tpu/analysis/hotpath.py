@@ -1,0 +1,202 @@
+"""JAX hot-path pass: implicit device syncs + jit cache-key hazards
+(ISSUE 11 tentpole pass 2).
+
+The perf trajectory the ragged-attention work rides on (PAPERS.md,
+arXiv 2604.15464) dies quietly the day someone lands an ``.item()`` in
+the decode pass: every engine tick gains a device round-trip and the
+pipelining from PR 4 overlaps nothing. PR 3's recompile alarms catch
+cache-key hazards *at runtime*; this pass catches both classes at
+review time, over the functions statically reachable from the two hot
+roots:
+
+- ``LLMServer._loop`` — the serving engine pass (admission, prefill,
+  decode dispatch, drain);
+- ``BaseOptimizer.optimize`` — the training step loop.
+
+Rules:
+
+- ``host-sync-item``      — ``x.item()`` forces a device→host fetch;
+- ``host-sync-transfer``  — ``np.asarray``/``jax.device_get``/
+  ``block_until_ready`` on the hot path: an explicit synchronization.
+  The engine's *designed* fence points stay, with a baseline entry
+  naming why they are the one permitted sync per drain;
+- ``host-sync-cast``      — ``float()``/``int()``/``bool()`` on a
+  non-literal in a jax-importing module: on an array this is an
+  implicit blocking fetch (``bool`` additionally fails under jit);
+- ``traced-branch``       — Python ``if``/``while`` on a parameter of
+  an ``obs.compiled(...)`` function: a TracerBoolConversionError at
+  best, a silent per-value recompile at worst;
+- ``compiled-self-ref``   — an ``obs.compiled(...)`` function reading
+  ``self``: mutable host state folded into traced constants — the
+  builder must bind statics to locals first (the ``cfg = self.cfg``
+  idiom every serving builder follows).
+
+Compiled functions are found by the repo's own convention: any local
+``def`` passed to ``obs.compiled(fn, ...)`` (the PR 3 flight-recorder
+wrapper marks every jit entry point).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import (Finding, FuncRef, ModuleInfo,
+                                     ProjectIndex, reachable)
+
+#: (module relpath, class, method) the reachability walk starts from.
+HOT_ROOTS: Tuple[Tuple[str, Optional[str], str], ...] = (
+    ("bigdl_tpu/llm/serving.py", "LLMServer", "_loop"),
+    ("bigdl_tpu/optim/optimizer.py", "BaseOptimizer", "optimize"),
+)
+
+#: parameters of compiled fns that are static by convention (model
+#: config dataclasses close over Python scalars on purpose — they are
+#: part of the cache key, not traced values)
+_STATIC_PARAM_NAMES = frozenset({"cfg", "config", "self"})
+
+
+def _call_name(func: ast.AST) -> str:
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return ""
+
+
+def _dotted(func: ast.AST) -> str:
+    """'np.asarray' for Attribute(Name) chains; '' otherwise."""
+    parts: List[str] = []
+    while isinstance(func, ast.Attribute):
+        parts.append(func.attr)
+        func = func.value
+    if isinstance(func, ast.Name):
+        parts.append(func.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _snippet(node: ast.AST, limit: int = 48) -> str:
+    try:
+        text = ast.unparse(node)
+    except Exception:
+        text = type(node).__name__
+    return text if len(text) <= limit else text[:limit - 3] + "..."
+
+
+def run_hotpath_pass(index: ProjectIndex,
+                     roots: Sequence[Tuple[str, Optional[str], str]]
+                     = HOT_ROOTS) -> List[Finding]:
+    root_refs = [FuncRef(m, c, f) for m, c, f in roots]
+    hot = reachable(index, root_refs)
+    findings: List[Finding] = []
+    seen: Set[str] = set()
+
+    def emit(f: Finding):
+        if f.fingerprint not in seen:
+            seen.add(f.fingerprint)
+            findings.append(f)
+
+    for ref in sorted(hot, key=lambda r: r.qualname):
+        node = index.func_node(ref)
+        mod = index.modules[ref.module]
+        for f in _sync_findings(ref, node, mod):
+            emit(f)
+    for mod in index.modules.values():
+        for f in _compiled_fn_findings(mod):
+            emit(f)
+    return findings
+
+
+def _sync_findings(ref: FuncRef, node: ast.AST,
+                   mod: ModuleInfo) -> List[Finding]:
+    out: List[Finding] = []
+    jaxy = mod.imports_jax()
+    for sub in ast.walk(node):
+        if not isinstance(sub, ast.Call):
+            continue
+        dn = _dotted(sub.func)
+        name = _call_name(sub.func)
+        if name == "item" and not sub.args and \
+                isinstance(sub.func, ast.Attribute):
+            out.append(Finding(
+                rule="host-sync-item", file=ref.module, line=sub.lineno,
+                key=f"{ref.qualname}:{_snippet(sub)}",
+                message=f"{ref.qualname} calls {_snippet(sub)} on the "
+                        f"hot path — a blocking device->host fetch per "
+                        f"call"))
+        elif dn in ("np.asarray", "numpy.asarray", "jax.device_get") \
+                or name == "block_until_ready":
+            out.append(Finding(
+                rule="host-sync-transfer", file=ref.module,
+                line=sub.lineno,
+                key=f"{ref.qualname}:{dn or name}:{_snippet(sub)}",
+                message=f"{ref.qualname} calls {dn or name} on the hot "
+                        f"path — an explicit device synchronization"))
+        elif jaxy and isinstance(sub.func, ast.Name) and \
+                sub.func.id in ("float", "int", "bool") and \
+                len(sub.args) == 1 and not sub.keywords and \
+                not isinstance(sub.args[0], ast.Constant):
+            out.append(Finding(
+                rule="host-sync-cast", file=ref.module, line=sub.lineno,
+                key=f"{ref.qualname}:{sub.func.id}:{_snippet(sub.args[0])}",
+                message=f"{ref.qualname} casts "
+                        f"{sub.func.id}({_snippet(sub.args[0])}) on the "
+                        f"hot path — on a jax array this is an implicit "
+                        f"blocking fetch"))
+    return out
+
+
+def compiled_functions(mod: ModuleInfo) -> List[Tuple[ast.AST, int]]:
+    """Local ``def f`` passed to ``obs.compiled(f, ...)`` — the repo's
+    jit entry points. Returns (fn node, compiled-call line)."""
+    out = []
+    # map def name -> node per enclosing scope, nearest-definition wins
+    for scope in ast.walk(mod.tree):
+        if not isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Module)):
+            continue
+        local_defs = {n.name: n for n in getattr(scope, "body", [])
+                      if isinstance(n, ast.FunctionDef)}
+        for sub in ast.walk(scope):
+            if isinstance(sub, ast.Call) and \
+                    _call_name(sub.func) == "compiled" and sub.args and \
+                    isinstance(sub.args[0], ast.Name) and \
+                    sub.args[0].id in local_defs:
+                out.append((local_defs[sub.args[0].id], sub.lineno))
+    return out
+
+
+def _compiled_fn_findings(mod: ModuleInfo) -> List[Finding]:
+    out: List[Finding] = []
+    for fn, _line in compiled_functions(mod):
+        params = {a.arg for a in list(fn.args.args) +
+                  list(fn.args.kwonlyargs)} - _STATIC_PARAM_NAMES
+        qual = f"{mod.relpath}::{fn.name}@{fn.lineno}"
+        for sub in ast.walk(fn):
+            if isinstance(sub, (ast.If, ast.While)):
+                traced = [n.id for n in ast.walk(sub.test)
+                          if isinstance(n, ast.Name) and n.id in params]
+                if traced:
+                    out.append(Finding(
+                        rule="traced-branch", file=mod.relpath,
+                        line=sub.lineno,
+                        key=f"{fn.name}:{_snippet(sub.test)}",
+                        message=f"compiled fn {qual} branches in Python "
+                                f"on traced parameter(s) "
+                                f"{sorted(set(traced))} — use lax.cond/"
+                                f"jnp.where, or hoist the value to a "
+                                f"static"))
+            elif isinstance(sub, ast.Name) and sub.id == "self":
+                # no early exit: a later traced-branch in the same fn
+                # must still be reported (emit() dedups the shared
+                # `fn:self` fingerprint)
+                out.append(Finding(
+                    rule="compiled-self-ref", file=mod.relpath,
+                    line=sub.lineno,
+                    key=f"{fn.name}:self",
+                    message=f"compiled fn {qual} reads `self` — mutable "
+                            f"host state baked into the trace; bind it "
+                            f"to a local in the builder first (the "
+                            f"`cfg = self.cfg` idiom)"))
+    return out

@@ -1,0 +1,242 @@
+"""Opt-in runtime lock-order witness (ISSUE 11 tentpole, runtime half).
+
+The static concurrency pass proves lock-order consistency over the
+edges it can *see*; ``lockwatch`` watches the orders that actually
+happen. With ``bigdl.analysis.lockwatch=true`` (default false),
+:func:`install` replaces ``threading.Lock``/``threading.RLock`` with
+factories returning watched proxies. Each proxy is tagged with its
+*creation site* (``file:line``, normalized to a repo-relative path) —
+the same declaration-site identity the static pass uses — and every
+successful acquire records the edge (innermost-held-site → this-site)
+into a process-global order table. Observing both (A→B) and (B→A) is
+an inversion: two threads interleaving those two code paths can
+deadlock. Violations are recorded (and counted as
+``bigdl_lockwatch_inversions_total`` when observability is on) rather
+than raised, so a chaos run completes and asserts ``violations() ==
+[]`` at the end.
+
+Scope and honesty notes:
+
+- only locks *created after* :func:`install` are watched (chaos runs
+  construct their servers afterwards, so coverage there is complete);
+- reentrant re-acquisition of the same site records no edge;
+- the witness's own bookkeeping lock is a leaf: it is never held
+  while acquiring a watched lock, so the watcher cannot deadlock the
+  watched program;
+- disabled mode is structurally absent: ``threading.Lock`` is the
+  stock factory, no table, no series (asserted by the tier-1 test).
+
+``tools/check_static.py --dump-graph`` prints the static graph in the
+same site vocabulary for offline comparison with
+:func:`observed_edges`.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional, Set, Tuple
+
+_ORIG_LOCK = threading.Lock
+_ORIG_RLOCK = threading.RLock
+
+_installed = False
+_table_lock = _ORIG_LOCK()          # leaf lock for the order table
+_edges: Dict[Tuple[str, str], Tuple[str, str]] = {}  # (a,b) -> thread, note
+_violations: List[dict] = []
+_violated_pairs: Set[Tuple[str, str]] = set()
+_tls = threading.local()
+
+
+def enabled() -> bool:
+    """The conf switch (``bigdl.analysis.lockwatch``). Read lazily so
+    importing this module never drags in the conf layer."""
+    try:
+        from bigdl_tpu.utils.conf import conf
+        return conf.get_bool("bigdl.analysis.lockwatch", False)
+    except Exception:
+        return False
+
+
+def _site(depth: int = 2) -> str:
+    """file:line of the frame creating the lock, repo-relative."""
+    import sys
+    frame = sys._getframe(depth)
+    fn = frame.f_code.co_filename
+    for marker in ("bigdl_tpu", "tools", "tests"):
+        idx = fn.rfind(os.sep + marker + os.sep)
+        if idx >= 0:
+            fn = fn[idx + 1:]
+            break
+    return f"{fn.replace(os.sep, '/')}:{frame.f_lineno}"
+
+
+def _held_stack() -> List[str]:
+    stack = getattr(_tls, "stack", None)
+    if stack is None:
+        stack = _tls.stack = []
+    return stack
+
+
+def _record_acquire(site: str):
+    if not _installed:
+        # live proxies outlast uninstall(); without this gate they
+        # would keep depositing edges (and phantom held-stack entries
+        # feeding false inversions) into the next reset() window
+        return
+    stack = _held_stack()
+    if site in stack:               # reentrant: no new edge
+        stack.append(site)
+        return
+    inversions = 0
+    if stack:
+        thread = threading.current_thread().name
+        with _table_lock:
+            for a in set(stack):    # all held sites, not just innermost
+                if a == site:
+                    continue
+                _edges.setdefault((a, site), (thread, ""))
+                pair = tuple(sorted((a, site)))
+                if (site, a) in _edges and pair not in _violated_pairs:
+                    _violated_pairs.add(pair)
+                    inversions += 1
+                    _violations.append({
+                        "pair": pair,
+                        "order_seen": (a, site),
+                        "thread": thread})
+    stack.append(site)
+    if inversions:
+        _count_metrics(inversions)
+
+
+def _count_metrics(n: int):
+    try:
+        from bigdl_tpu import observability as obs
+        if obs.enabled():
+            obs.counter("bigdl_lockwatch_inversions_total",
+                        "Lock-order inversions observed by the "
+                        "bigdl.analysis.lockwatch witness").inc(n)
+    except Exception:
+        pass
+
+
+def _record_release(site: str):
+    stack = _held_stack()
+    # release the innermost matching hold (with-blocks unwind LIFO;
+    # out-of-order explicit releases still balance)
+    for i in range(len(stack) - 1, -1, -1):
+        if stack[i] == site:
+            del stack[i]
+            return
+
+
+class _WatchedLock:
+    """Proxy over a real lock recording acquisition order by creation
+    site. Forwards the private methods ``threading.Condition`` relies
+    on so watched RLocks still back conditions correctly."""
+
+    def __init__(self, inner, site: str):
+        self._inner = inner
+        self._lw_site = site
+
+    def acquire(self, *a, **kw):
+        got = self._inner.acquire(*a, **kw)
+        if got:
+            _record_acquire(self._lw_site)
+        return got
+
+    def release(self):
+        self._inner.release()
+        _record_release(self._lw_site)
+
+    def locked(self):
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    # Condition(lock) support — delegate, keeping our stack balanced
+    def _is_owned(self):
+        if hasattr(self._inner, "_is_owned"):
+            return self._inner._is_owned()
+        if self._inner.acquire(False):
+            self._inner.release()
+            return False
+        return True
+
+    def _acquire_restore(self, state):
+        self._inner._acquire_restore(state) \
+            if hasattr(self._inner, "_acquire_restore") \
+            else self._inner.acquire()
+        _record_acquire(self._lw_site)
+
+    def _release_save(self):
+        _record_release(self._lw_site)
+        if hasattr(self._inner, "_release_save"):
+            return self._inner._release_save()
+        self._inner.release()
+        return None
+
+    def __repr__(self):
+        return f"<lockwatch {self._lw_site} {self._inner!r}>"
+
+
+def _watched_lock_factory():
+    return _WatchedLock(_ORIG_LOCK(), _site())
+
+
+def _watched_rlock_factory():
+    return _WatchedLock(_ORIG_RLOCK(), _site())
+
+
+def install():
+    """Patch the ``threading`` lock factories. Idempotent."""
+    global _installed
+    if _installed:
+        return
+    threading.Lock = _watched_lock_factory
+    threading.RLock = _watched_rlock_factory
+    _installed = True
+
+
+def uninstall():
+    global _installed
+    threading.Lock = _ORIG_LOCK
+    threading.RLock = _ORIG_RLOCK
+    _installed = False
+
+
+def installed() -> bool:
+    return _installed
+
+
+def maybe_install() -> bool:
+    """Install iff the conf switch is on — the chaos-harness entry."""
+    if enabled():
+        install()
+        return True
+    return False
+
+
+def reset():
+    with _table_lock:
+        _edges.clear()
+        _violations.clear()
+        _violated_pairs.clear()
+
+
+def violations() -> List[dict]:
+    with _table_lock:
+        return list(_violations)
+
+
+def observed_edges() -> List[Tuple[str, str]]:
+    """Every (held-site, acquired-site) edge seen so far — comparable
+    with ``tools/check_static.py --dump-graph``."""
+    with _table_lock:
+        return sorted(_edges)

@@ -1,0 +1,423 @@
+"""Declared name registries (ISSUE 11 tentpole pass 3, source side).
+
+The single source of truth for the repo's five string namespaces. An
+entry here is a *declaration*: the name exists on purpose, means what
+the description says, and (for conf keys and metric series) is
+documented in the user-facing docs. The registry-drift pass
+(:mod:`bigdl_tpu.analysis.registrydrift`) enforces both directions —
+every literal in code resolves to an entry, and every entry is still
+used by code — so a typo'd metric name or a deleted-but-still-registered
+knob fails ``tools/check_static.py`` instead of shipping.
+
+Mirrors: ``CONF_KEYS`` must cover ``bigdl_tpu.utils.conf._DEFAULTS``;
+``FAULT_SITES`` must equal ``bigdl_tpu.reliability.faults.SITES``;
+``PYTEST_MARKERS`` must equal the markers ``tests/conftest.py``
+declares. The pass AST-parses those sources (never imports them) and
+flags drift in either direction.
+
+This module is import-light on purpose (no jax, no bigdl_tpu) so the
+analyzer, the CLI gate and CI can load it anywhere.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+#: ``bigdl.*`` configuration keys -> one-line meaning. Filled below.
+CONF_KEYS: Dict[str, str] = {}
+
+#: ``bigdl_*`` metric series -> one-line meaning. Filled below.
+METRICS: Dict[str, str] = {}
+
+#: metric names without the ``bigdl_`` prefix that are still ours
+#: (Prometheus ecosystem conventions).
+METRIC_EXTRA_NAMES: Tuple[str, ...] = ("process_start_time_seconds",)
+
+#: trace span names (``category/what``) -> emitting subsystem.
+SPAN_NAMES: Dict[str, str] = {}
+
+#: fault-injection sites — must mirror ``reliability.faults.SITES``.
+FAULT_SITES: Dict[str, str] = {}
+
+#: pytest markers — must mirror ``tests/conftest.py``.
+PYTEST_MARKERS: Dict[str, str] = {}
+CONF_KEYS.update({
+    "bigdl.analysis.lockwatch":
+        "runtime lock-order witness for chaos runs; off = stock lock factories",
+    "bigdl.checkpoint.keep":
+        "retention; 0 = unlimited",
+    "bigdl.coordinator.address":
+        "jax.distributed coordinator host:port ('' = single-process)",
+    "bigdl.elastic.enabled":
+        "elastic training master switch; false = structurally absent",
+    "bigdl.elastic.generation":
+        "set by the launcher env",
+    "bigdl.elastic.heartbeat.interval":
+        "agent beat cadence (s)",
+    "bigdl.elastic.heartbeat.timeout":
+        "peer presumed dead (s)",
+    "bigdl.elastic.join.timeout":
+        "join deadline: fail the generation if the world never fully joins",
+    "bigdl.elastic.max.restarts":
+        "restart budget (both tiers)",
+    "bigdl.elastic.snapshot.every":
+        "steps per RAM snapshot",
+    "bigdl.elastic.snapshot.flush.every":
+        "commit-floor advances per durable checkpoint flush on process 0",
+    "bigdl.elastic.snapshot.ring":
+        "RAM ring capacity",
+    "bigdl.elastic.step.timeout":
+        "collective-hang watchdog step timeout (seconds); 0 = off",
+    "bigdl.elastic.supervisor.address":
+        "host:port; '' = ring-only",
+    "bigdl.engine.type":
+        "'' = auto (jax.default_backend)",
+    "bigdl.llm.failover.enabled":
+        "router journals in-flight requests and resumes on another backend",
+    "bigdl.llm.failover.max.attempts":
+        "dispatch tries/request",
+    "bigdl.llm.hedge.budget":
+        "hedges / requests cap",
+    "bigdl.llm.hedge.delay.ms":
+        "0 = p95-based (observed)",
+    "bigdl.llm.hedge.enabled":
+        "duplicate a slow call to a second backend; first success wins",
+    "bigdl.llm.hedge.min.delay.ms":
+        "floor under the p95 rule",
+    "bigdl.llm.kvcache.enabled":
+        "radix-indexed KV page reuse with refcounts + COW; false = off",
+    "bigdl.llm.kvtier.enabled":
+        "host-RAM spill tier behind the radix pool; false = absent",
+    "bigdl.llm.kvtier.fetch.timeout":
+        "stuck fetch -> plain miss",
+    "bigdl.llm.kvtier.host_pages":
+        "0 = auto (4x device pool)",
+    "bigdl.llm.kvtier.sync":
+        "inline migration (tests)",
+    "bigdl.llm.pipeline_depth":
+        "decode steps dispatched ahead of the host drain; 1 = synchronous",
+    "bigdl.llm.prefill.ragged":
+        "prefill attends cached prefix pages in place; auto = on where Mosaic runs",
+    "bigdl.llm.prober.interval":
+        "/healthz poll (seconds)",
+    "bigdl.llm.retry_after.base":
+        "derived Retry-After base seconds (clamped with per_queued/max)",
+    "bigdl.llm.retry_after.jitter":
+        "Retry-After random stretch fraction",
+    "bigdl.llm.retry_after.max":
+        "Retry-After clamp ceiling (seconds)",
+    "bigdl.llm.retry_after.per_queued":
+        "Retry-After seconds added per queued request",
+    "bigdl.llm.role":
+        "worker role: '' unified, 'prefill' or 'decode' side of the KV handoff",
+    "bigdl.llm.watchdog.step_timeout":
+        "engine watchdog: a stalled step flips /healthz and fails retriably; 0 = off",
+    "bigdl.mesh.axes":
+        "comma-separated axis names",
+    "bigdl.mesh.shape":
+        "comma-separated ints; '' = auto",
+    "bigdl.num.processes":
+        "multi-process world size ('' = single process)",
+    "bigdl.observability.enabled":
+        "metrics + trace spans",
+    "bigdl.observability.exemplars":
+        "slowest-N latency traces",
+    "bigdl.observability.trace.capacity":
+        "span ring entries",
+    "bigdl.optimizer.max.retry":
+        "iteration-retry attempts",
+    "bigdl.process.id":
+        "this process's rank in the multi-process world",
+    "bigdl.reliability.enabled":
+        "fault sites + policies",
+    "bigdl.reliability.retry.base.delay":
+        "retry backoff base delay (seconds)",
+    "bigdl.reliability.retry.max.attempts":
+        "tries, not retries",
+    "bigdl.reliability.retry.max.delay":
+        "backoff cap",
+    "bigdl.train.prefetch":
+        "stage batch N+1 during N",
+    "bigdl.train.prefetch.depth":
+        "staged batches held ahead",
+})
+
+METRICS.update({
+    "bigdl_build_info":
+        "Constant 1; the build identity lives in the labels",
+    "bigdl_cluster_serving_batch_size":
+        "Records packed per inference batch",
+    "bigdl_cluster_serving_batches_total":
+        "Inference batches executed",
+    "bigdl_cluster_serving_infer_seconds":
+        "Wall time of one InferenceModel.predict call",
+    "bigdl_cluster_serving_records_total":
+        "Records answered by the ClusterServing batch loop",
+    "bigdl_collective_calls_total":
+        "Collective call sites traced",
+    "bigdl_collective_traced_bytes_total":
+        "Input payload bytes per compiled collective call site (trace-time accounting: multiply by executions, and by the op's wire amplification — e.g. ~(n-1) recv copies for all_gather, ~2(n-1)/n for ring all_reduce — for actual traffic)",
+    "bigdl_elastic_committed_step":
+        "Newest snapshot step every live peer has taken",
+    "bigdl_elastic_flushes_total":
+        "Committed snapshots flushed to the durable tier",
+    "bigdl_elastic_generation":
+        "Worker-set generation (restarts of the world)",
+    "bigdl_elastic_heartbeat_failures_total":
+        "Heartbeats that failed to reach the supervisor",
+    "bigdl_elastic_heartbeats_total":
+        "Agent heartbeats delivered to the supervisor",
+    "bigdl_elastic_restarts_total":
+        "Elastic restarts performed",
+    "bigdl_elastic_snapshot_age_steps":
+        "Iterations since the last RAM snapshot was taken",
+    "bigdl_elastic_snapshots_total":
+        "RAM snapshots taken into the elastic ring",
+    "bigdl_elastic_stalls_total":
+        "Wedged optimizer steps detected by the collective-hang watchdog",
+    "bigdl_elastic_step_skew":
+        "Max-min optimizer step across live peers (straggler gauge)",
+    "bigdl_elastic_world_size":
+        "Live (heartbeating) training processes this generation",
+    "bigdl_engine_init_failures_total":
+        "jax.distributed.initialize failures during Engine.init",
+    "bigdl_kvcache_evictions_total":
+        "Pages evicted from the prefix index under pool pressure",
+    "bigdl_kvcache_hits_total":
+        "Admissions that reused a cached prefix",
+    "bigdl_kvcache_indexed_pages":
+        "Pages currently referenced by the prefix index",
+    "bigdl_kvcache_misses_total":
+        "Admissions with no cached prefix",
+    "bigdl_kvcache_pool_occupancy":
+        "Fraction of the usable page pool allocated (live + indexed)",
+    "bigdl_kvcache_prefix_tokens_reused_total":
+        "Prompt tokens served from cached prefixes instead of prefill",
+    "bigdl_kvcache_shared_pages":
+        "Pages with more than one reference (index + live requests)",
+    "bigdl_kvtier_fetch_failures_total":
+        "Host-tier fetches that degraded to a cache miss",
+    "bigdl_kvtier_fetches_total":
+        "Pages fetched from the host arena back into HBM",
+    "bigdl_kvtier_handoff_bytes_total":
+        "Serialized KV bytes moved by handoffs",
+    "bigdl_kvtier_handoffs_total":
+        "KV-chain handoffs across the prefill/decode split",
+    "bigdl_kvtier_host_pages":
+        "Host arena capacity in page slots",
+    "bigdl_kvtier_host_pages_used":
+        "Host arena slots currently holding a page",
+    "bigdl_kvtier_inflight_migrations":
+        "Migration jobs queued or running",
+    "bigdl_kvtier_spills_total":
+        "Pages spilled from HBM to the host arena",
+    "bigdl_llm_active_slots":
+        "Slots currently decoding",
+    "bigdl_llm_decode_host_seconds":
+        "Host-side scheduling slice of one decode step (page allocation + dispatch; no device wait)",
+    "bigdl_llm_decode_stall_seconds":
+        "Host time blocked on the device fence when draining a decode step (the pipeline's residual stall)",
+    "bigdl_llm_decode_step_seconds":
+        "Host wall attributed to one decode step: scheduling + fence stall (under pipelining device compute overlaps the host, so this is NOT pure device time — see the host/stall split below and docs/PERFORMANCE.md)",
+    "bigdl_llm_decode_tokens_total":
+        "Tokens decoded across all slots",
+    "bigdl_llm_kv_pages_in_use":
+        "Physical KV pages owned by live requests",
+    "bigdl_llm_kv_pool_occupancy":
+        "Fraction of the KV page pool in use (0..1)",
+    "bigdl_llm_pipeline_inflight":
+        "Decode steps dispatched but not yet drained (bounded by bigdl.llm.pipeline_depth)",
+    "bigdl_llm_prefill_seconds":
+        "Host wall of one request prefill (compile excluded after first hit per length bucket). At pipeline_depth 1 this covers execution (the prefill barriers); at depth > 1 it is DISPATCH time — execution overlaps decode by design",
+    "bigdl_llm_prefill_tokens_total":
+        "Prompt tokens prefilled into the KV cache",
+    "bigdl_llm_requests_total":
+        "Requests finished by the engine",
+    "bigdl_llm_watchdog_trips_total":
+        "Engine stalls detected by the step-deadline watchdog",
+    "bigdl_lockwatch_inversions_total":
+        "Lock-order inversions observed by the bigdl.analysis.lockwatch witness",
+    "bigdl_reliability_breaker_transitions_total":
+        "CircuitBreaker state transitions",
+    "bigdl_reliability_checkpoints_quarantined_total":
+        "Corrupt/incomplete checkpoints moved aside during recovery scans",
+    "bigdl_reliability_deadline_expired_total":
+        "Deadlines that ran out before the work completed",
+    "bigdl_reliability_injected_faults_total":
+        "Faults fired by the armed FaultPlan",
+    "bigdl_reliability_preemptions_total":
+        "SIGTERM/SIGINT preemptions that checkpointed and exited",
+    "bigdl_reliability_retries_total":
+        "Retries performed under a RetryPolicy",
+    "bigdl_reliability_shed_total":
+        "Requests rejected by admission control",
+    "bigdl_router_backend_healthy":
+        "Prober verdict per backend (1 healthy)",
+    "bigdl_router_breaker_state":
+        "Per-backend circuit-breaker state (0=closed, 1=half_open, 2=open)",
+    "bigdl_router_failovers_total":
+        "Requests re-dispatched to another backend after a failure",
+    "bigdl_router_hedges_total":
+        "Hedged backend calls by outcome",
+    "bigdl_router_journal_inflight":
+        "Routed requests currently in the failover journal",
+    "bigdl_serving_errors_total":
+        "Predict requests failing (bad request or timeout)",
+    "bigdl_serving_queue_depth":
+        "Requests submitted and still awaiting a result",
+    "bigdl_serving_request_seconds":
+        "End-to-end /predict latency (submit to result)",
+    "bigdl_serving_requests_total":
+        "HTTP requests by endpoint outcome",
+    "bigdl_serving_served_total":
+        "Predict requests answered with a result",
+    "bigdl_summary_scalar":
+        "Last value of each Train/ValidationSummary scalar tag",
+    "bigdl_train_compute_seconds_total":
+        "Cumulative host time spent dispatching the compiled step",
+    "bigdl_train_data_wait_seconds_total":
+        "Cumulative host time spent staging input batches",
+    "bigdl_train_examples_total":
+        "Training examples consumed",
+    "bigdl_train_grad_norm":
+        "Global gradient L2 norm at the last drained step",
+    "bigdl_train_learning_rate":
+        "Learning rate at the last drained step",
+    "bigdl_train_loss":
+        "Last drained train loss",
+    "bigdl_train_step_seconds":
+        "Wall time of one optimizer iteration (data wait + step dispatch; the loop is pipelined, so this bounds dispatch, not device occupancy)",
+    "bigdl_train_steps_total":
+        "Optimizer steps taken",
+    "bigdl_train_throughput_examples_per_sec":
+        "Throughput of the last completed epoch",
+    "bigdl_xla_bytes_accessed_per_call":
+        "cost_analysis() bytes accessed (HBM traffic) per call",
+    "bigdl_xla_compile_seconds":
+        "Wall time of one XLA compilation",
+    "bigdl_xla_compiles_total":
+        "XLA compilations per wrapped jit entry point",
+    "bigdl_xla_flops_per_call":
+        "cost_analysis() FLOPs of one call of the latest executable",
+    "bigdl_xla_live_buffer_bytes":
+        "Total bytes of live jax arrays, sampled at compile time",
+    "bigdl_xla_peak_hbm_bytes":
+        "memory_analysis() argument+output+temp-alias bytes of the latest executable (its device-memory high-water mark)",
+    "bigdl_xla_recompiles_total":
+        "Compilations beyond the first signature of a function — the silent-perf-killer alarm (triggering signature logged)",
+    "process_start_time_seconds":
+        "Unix epoch seconds this process started",
+})
+
+SPAN_NAMES.update({
+    "elastic/flush":
+        "durable snapshot flush (elastic training, process 0)",
+    "elastic/restart":
+        "completion: a generation restart round-trip",
+    "elastic/rollback":
+        "completion: in-process ring rollback",
+    "elastic/snapshot":
+        "RAM snapshot capture in the elastic step hooks",
+    "kvcache/lookup":
+        "radix prefix-index lookup at admission",
+    "kvtier/fetch_wait":
+        "engine-side wait on a parked host-tier fetch",
+    "kvtier/migrate":
+        "completion: one HBM<->host migration job",
+    "llm/decode":
+        "per-request decode phase on the engine (PR 3)",
+    "llm/decode_step":
+        "one pipelined engine decode pass",
+    "llm/handoff_export":
+        "KV chain serialized for disaggregated handoff",
+    "llm/handoff_import":
+        "KV handoff blob landed into pool/arena",
+    "llm/prefill":
+        "prompt prefill (full/partial/ragged) on the engine",
+    "llm/queue_wait":
+        "request time between submit and slot admission",
+    "llm/request":
+        "LLMWorker HTTP request envelope",
+    "llm/route":
+        "LLMRouter dispatch envelope (prefill+decode legs)",
+    "llm/watchdog_trip":
+        "completion: engine watchdog declared a stall",
+    "router/failover":
+        "completion: one journal resume onto a new backend",
+    "router/hedge":
+        "hedged duplicate dispatch (first success wins)",
+    "serving/batch":
+        "ClusterServing batch execution",
+    "serving/predict":
+        "ServingFrontend HTTP /predict envelope",
+    "train/epoch":
+        "BaseOptimizer epoch bracket",
+    "train/step":
+        "BaseOptimizer training step bracket",
+    "xla/compile":
+        "completion: one XLA compile (flight recorder)",
+})
+
+FAULT_SITES.update({
+    "checkpoint.commit":
+        "before the atomic rename",
+    "checkpoint.load":
+        "load_checkpoint entry",
+    "checkpoint.write":
+        "save_checkpoint entry",
+    "checkpoint.write.arrays":
+        "after arrays land (corrupt-capable)",
+    "checkpoint.write.manifest":
+        "between arrays and manifest writes",
+    "elastic.heartbeat":
+        "agent->supervisor beat (ISSUE 10)",
+    "elastic.step":
+        "elastic-guarded train step (ISSUE 10)",
+    "kvcache.evict":
+        "prefix-cache LRU eviction (ISSUE 5)",
+    "kvtier.fetch":
+        "host->HBM page fetch (ISSUE 6)",
+    "kvtier.spill":
+        "HBM->host page spill (ISSUE 6)",
+    "llm.step":
+        "LLM engine decode step",
+    "llm.submit":
+        "LLMServer request admission",
+    "optimizer.checkpoint":
+        "before the optimizer persists state",
+    "optimizer.step":
+        "top of each training iteration",
+    "router.dispatch":
+        "router->backend call/stream (ISSUE 7)",
+    "serving.backend.pop":
+        "queue backend read",
+    "serving.backend.push":
+        "queue backend write",
+    "serving.batch":
+        "cluster-serving batch execution",
+    "serving.frontend.request":
+        "HTTP /predict admission",
+    "worker.stall":
+        "hung engine decode step (ISSUE 7)",
+})
+
+PYTEST_MARKERS.update({
+    "analysis":
+        "static-analysis suite tests (passes, baseline, lockwatch)",
+    "chaos":
+        "seeded fault-injection chaos runs (always also slow)",
+    "elastic":
+        "elastic multi-host training tests",
+    "failover":
+        "request-level failover / hedging / watchdog tests",
+    "kernels":
+        "Pallas/Mosaic kernel family tests",
+    "kvcache":
+        "prefix-aware KV-cache subsystem tests",
+    "kvtier":
+        "tiered KV-cache (host arena / migration / handoff) tests",
+    "perf":
+        "performance microbenchmarks (advisory on shared hosts)",
+    "slow":
+        "excluded from the tier-1 gate (-m 'not slow')",
+})

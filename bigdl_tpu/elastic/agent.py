@@ -138,14 +138,20 @@ class ElasticAgent:
         return self._abort.is_set()
 
     def abort_reason(self) -> Optional[str]:
-        return self._abort_reason
+        with self._lock:
+            return self._abort_reason
 
     def request_abort(self, reason: str):
-        self._abort_reason = self._abort_reason or reason
+        # the beat thread and the training loop both reach this (stall
+        # report vs. directive): first reason wins, under the same lock
+        # the rest of the agent state uses
+        with self._lock:
+            self._abort_reason = self._abort_reason or reason
         self._abort.set()
 
     def reset_abort(self):
-        self._abort_reason = None
+        with self._lock:
+            self._abort_reason = None
         self._abort.clear()
 
     # -- stall detection -----------------------------------------------------

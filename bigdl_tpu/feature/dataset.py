@@ -224,6 +224,9 @@ class PrefetchDataSet(AbstractDataSet):
                 yield item
         finally:
             stop.set()
+            # retire the producer: put() gives up within its 0.1 s
+            # poll once stop is set, so this never hangs the consumer
+            t.join(timeout=5.0)
 
 
 class SampleToMiniBatch:

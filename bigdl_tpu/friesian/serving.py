@@ -42,6 +42,10 @@ class _TcpService:
         self.host, self.port = self._sock.getsockname()[:2]
         self._stop = threading.Event()
         self._accept_thread: Optional[threading.Thread] = None
+        self._conn_lock = threading.Lock()
+        #: live (thread, socket) handler pairs — stop() severs the
+        #: sockets so handlers blocked in recv_msg actually exit
+        self._conns: List[tuple] = []
 
     def start(self):
         self._sock.listen()
@@ -56,8 +60,15 @@ class _TcpService:
                 conn, _ = self._sock.accept()
             except OSError:
                 return
-            threading.Thread(target=self._serve, args=(conn,),
-                             daemon=True).start()
+            t = threading.Thread(target=self._serve, args=(conn,),
+                                 daemon=True)
+            t.start()
+            # sweep finished handlers so a long-lived service doesn't
+            # accumulate one dead pair per past connection
+            with self._conn_lock:
+                self._conns = [(c, s) for c, s in self._conns
+                               if c.is_alive()]
+                self._conns.append((t, conn))
 
     def _serve(self, conn: socket.socket):
         try:
@@ -84,9 +95,34 @@ class _TcpService:
     def stop(self):
         self._stop.set()
         try:
+            # shutdown BEFORE close: on Linux, close() alone does not
+            # wake a thread blocked in accept(); shutdown() does
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
             self._sock.close()
         except OSError:
             pass
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=5.0)
+        with self._conn_lock:
+            pending, self._conns = self._conns, []
+        for t, conn in pending:
+            # sever the client socket first: a handler blocked in
+            # recv_msg on an idle-but-connected client only notices
+            # _stop between messages — without this every join below
+            # would burn its full timeout and leak the thread
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+        for t, _ in pending:
+            t.join(timeout=1.0)
 
     @property
     def target(self) -> str:

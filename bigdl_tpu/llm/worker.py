@@ -534,6 +534,7 @@ class LLMWorker:
             # shutdown() handshakes with serve_forever — calling it on
             # a never-started server would wait forever
             self._httpd.shutdown()
+            self._thread.join(timeout=5.0)
         self._httpd.server_close()
 
 
@@ -1389,4 +1390,5 @@ class LLMRouter:
             # shutdown() handshakes with serve_forever — calling it on
             # a never-started router would wait forever
             self._httpd.shutdown()
+            self._thread.join(timeout=5.0)
         self._httpd.server_close()

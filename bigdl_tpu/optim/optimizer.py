@@ -162,6 +162,11 @@ class BatchPrefetcher:
                 self._q.get_nowait()
         except _queue.Empty:
             pass
+        # retire the producer before the caller reuses the device: a
+        # still-running place_fn (device_put) must not race the next
+        # epoch's donated buffers. _put gives up within its 0.1 s poll
+        # once _stop is set, so this returns promptly.
+        self._thread.join(timeout=5.0)
 
 
 def _to_device(tree, sharding=None):

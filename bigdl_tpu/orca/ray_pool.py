@@ -130,6 +130,10 @@ class RayContext:
             if p.is_alive():
                 p.terminate()
         self._procs = []
+        if self._collector is not None:
+            # exits within its 0.2 s result-queue poll of _stopped
+            self._collector.join(timeout=5.0)
+            self._collector = None
 
     def _collect(self):
         while not self._stopped.is_set():

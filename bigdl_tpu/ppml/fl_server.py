@@ -24,6 +24,10 @@ class FLServer:
         self.port = self._sock.getsockname()[1]
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
+        self._conn_lock = threading.Lock()
+        #: live client sockets — stop() severs them so handler threads
+        #: blocked in recv_msg actually exit before the joins below
+        self._conns: List[socket.socket] = []
 
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
@@ -56,9 +60,29 @@ class FLServer:
     def stop(self):
         self._stop.set()
         try:
+            # shutdown BEFORE close: on Linux, close() alone does not
+            # wake a thread blocked in accept(); shutdown() does
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
             self._sock.close()
         except OSError:
             pass
+        with self._conn_lock:
+            conns, self._conns = self._conns, []
+            threads, self._threads = self._threads, []
+        for s in conns:
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                s.close()
+            except OSError:
+                pass
+        for t in threads:
+            t.join(timeout=1.0)
 
     def _accept_loop(self):
         while not self._stop.is_set():
@@ -69,7 +93,13 @@ class FLServer:
             t = threading.Thread(target=self._serve_client,
                                  args=(conn,), daemon=True)
             t.start()
-            self._threads.append(t)
+            with self._conn_lock:
+                self._threads = [c for c in self._threads
+                                 if c.is_alive()]
+                self._threads.append(t)
+                self._conns = [s for s in self._conns
+                               if s.fileno() >= 0]
+                self._conns.append(conn)
 
     # -- per-connection handler ---------------------------------------------
     def _serve_client(self, conn: socket.socket):

@@ -369,3 +369,5 @@ class ServingFrontend:
         self._stop.set()
         self._httpd.shutdown()
         self._httpd.server_close()
+        for t in getattr(self, "_threads", []):
+            t.join(timeout=5.0)

@@ -36,12 +36,10 @@ _DEFAULTS: Dict[str, str] = {
     "bigdl.coordinator.address": "",
     "bigdl.num.processes": "",
     "bigdl.process.id": "",
-    "bigdl.check.singleton": "false",
-    "bigdl.log.level": "INFO",
     "bigdl.optimizer.max.retry": "0",   # iteration-retry attempts
-    "bigdl.checkpoint.overwrite": "true",
     "bigdl.observability.enabled": "true",    # metrics + trace spans
     "bigdl.observability.trace.capacity": "65536",  # span ring entries
+    "bigdl.observability.exemplars": "8",     # slowest-N latency traces
     "bigdl.reliability.enabled": "true",      # fault sites + policies
     "bigdl.reliability.retry.max.attempts": "3",   # tries, not retries
     "bigdl.reliability.retry.base.delay": "0.05",  # seconds
@@ -118,6 +116,11 @@ _DEFAULTS: Dict[str, str] = {
     "bigdl.elastic.snapshot.flush.every": "1",
     "bigdl.elastic.max.restarts": "3",        # restart budget (both tiers)
     "bigdl.elastic.generation": "0",          # set by the launcher env
+    # static-analysis runtime witness (ISSUE 11): wrap threading.Lock/
+    # RLock creation to record acquisition order and flag inversions
+    # against the static lock graph during chaos runs. false = the
+    # stock factories, no table, no series (structurally absent)
+    "bigdl.analysis.lockwatch": "false",
 }
 
 

@@ -61,6 +61,11 @@ def pytest_configure(config):
         "elastic: elastic multi-host training tests (supervisor state "
         "machine, peer heartbeats, collective-hang watchdog, snapshot "
         "ring, kill-and-recover; select with -m elastic)")
+    config.addinivalue_line(
+        "markers",
+        "analysis: static-analysis suite tests (AST passes, baseline "
+        "round-trip, lockwatch witness, repo gate; select with "
+        "-m analysis)")
 
 
 @pytest.fixture(scope="session")

@@ -1,0 +1,607 @@
+"""Static-analysis suite tests (ISSUE 11): fixture-based per-rule
+checks for each pass (known-bad snippets fire, known-good don't), the
+baseline round-trip, the lockwatch runtime witness, the CLI exit-code
+contract, and the tier-1 repo gate (zero unbaselined findings)."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from bigdl_tpu import analysis
+from bigdl_tpu.analysis import lockwatch
+from bigdl_tpu.analysis.baseline import Baseline
+from bigdl_tpu.analysis.concurrency import (lock_graph,
+                                            run_concurrency_pass)
+from bigdl_tpu.analysis.core import Finding, ProjectIndex
+from bigdl_tpu.analysis.hotpath import run_hotpath_pass
+from bigdl_tpu.analysis.registrydrift import run_registry_pass
+
+pytestmark = pytest.mark.analysis
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def make_tree(tmp_path, files):
+    """Write {relpath: source} under tmp_path/bigdl_tpu and index it."""
+    for rel, src in files.items():
+        path = tmp_path / "bigdl_tpu" / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(src)
+    return ProjectIndex.scan(str(tmp_path), ["bigdl_tpu"])
+
+
+def rules_fired(findings, rule):
+    return [f for f in findings if f.rule == rule]
+
+
+# ---------------------------------------------------------------------------
+# concurrency pass fixtures
+# ---------------------------------------------------------------------------
+
+BAD_LOCK_ORDER = '''
+import threading
+
+class S:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def one(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def two(self):
+        with self._b:
+            with self._a:
+                pass
+'''
+
+GOOD_LOCK_ORDER = BAD_LOCK_ORDER.replace(
+    "with self._b:\n            with self._a:",
+    "with self._a:\n            with self._b:")
+
+#: the cycle hides behind a call: two() holds b and CALLS a helper
+#: that takes a — only the transitive edge sees it
+BAD_LOCK_ORDER_INDIRECT = '''
+import threading
+
+class S:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def one(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def _take_a(self):
+        with self._a:
+            pass
+
+    def two(self):
+        with self._b:
+            self._take_a()
+'''
+
+BAD_UNLOCKED_WRITE = '''
+import threading
+
+class S:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def _loop(self):
+        self.count += 1
+
+    def bump(self):
+        self.count += 1
+
+    def stop(self):
+        self._thread.join()
+'''
+
+GOOD_LOCKED_WRITE = BAD_UNLOCKED_WRITE.replace(
+    "    def _loop(self):\n        self.count += 1",
+    "    def _loop(self):\n        with self._lock:\n"
+    "            self.count += 1").replace(
+    "    def bump(self):\n        self.count += 1",
+    "    def bump(self):\n        with self._lock:\n"
+    "            self.count += 1")
+
+BAD_THREAD_NO_JOIN = '''
+import threading
+
+def fire():
+    threading.Thread(target=print, daemon=True).start()
+'''
+
+GOOD_THREAD_JOINED = '''
+import threading
+
+class S:
+    def start(self):
+        self._thread = threading.Thread(target=print, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._thread.join()
+'''
+
+BAD_BARE_ACQUIRE = '''
+import threading
+
+class S:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def risky(self):
+        self._lock.acquire()
+        do_work()
+        self._lock.release()
+'''
+
+GOOD_ACQUIRE_FINALLY = '''
+import threading
+
+class S:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def safe(self):
+        self._lock.acquire()
+        try:
+            do_work()
+        finally:
+            self._lock.release()
+'''
+
+
+class TestConcurrencyPass:
+    def test_lock_order_inversion_fires(self, tmp_path):
+        idx = make_tree(tmp_path, {"mod.py": BAD_LOCK_ORDER})
+        hits = rules_fired(run_concurrency_pass(idx), "lock-order")
+        assert len(hits) == 1
+        assert "S._a" in hits[0].key and "S._b" in hits[0].key
+
+    def test_consistent_order_clean(self, tmp_path):
+        idx = make_tree(tmp_path, {"mod.py": GOOD_LOCK_ORDER})
+        assert rules_fired(run_concurrency_pass(idx), "lock-order") == []
+
+    def test_lock_order_through_call_graph(self, tmp_path):
+        idx = make_tree(tmp_path, {"mod.py": BAD_LOCK_ORDER_INDIRECT})
+        hits = rules_fired(run_concurrency_pass(idx), "lock-order")
+        assert len(hits) == 1
+
+    def test_unlocked_write_fires(self, tmp_path):
+        idx = make_tree(tmp_path, {"mod.py": BAD_UNLOCKED_WRITE})
+        hits = rules_fired(run_concurrency_pass(idx), "unlocked-write")
+        assert [h.key for h in hits] == ["S.count"]
+
+    def test_locked_write_clean(self, tmp_path):
+        idx = make_tree(tmp_path, {"mod.py": GOOD_LOCKED_WRITE})
+        assert rules_fired(run_concurrency_pass(idx),
+                           "unlocked-write") == []
+
+    def test_init_writes_exempt(self, tmp_path):
+        # the __init__ assignment of count never counts as a race side
+        idx = make_tree(tmp_path, {"mod.py": GOOD_LOCKED_WRITE})
+        findings = run_concurrency_pass(idx)
+        assert all("__init__" not in f.message for f in findings)
+
+    def test_thread_no_join_fires(self, tmp_path):
+        idx = make_tree(tmp_path, {"mod.py": BAD_THREAD_NO_JOIN})
+        assert len(rules_fired(run_concurrency_pass(idx),
+                               "thread-no-join")) == 1
+
+    def test_joined_thread_clean(self, tmp_path):
+        idx = make_tree(tmp_path, {"mod.py": GOOD_THREAD_JOINED})
+        assert rules_fired(run_concurrency_pass(idx),
+                           "thread-no-join") == []
+
+    def test_bare_acquire_fires(self, tmp_path):
+        idx = make_tree(tmp_path, {"mod.py": BAD_BARE_ACQUIRE})
+        assert len(rules_fired(run_concurrency_pass(idx),
+                               "bare-acquire")) == 1
+
+    def test_acquire_with_finally_clean(self, tmp_path):
+        idx = make_tree(tmp_path, {"mod.py": GOOD_ACQUIRE_FINALLY})
+        assert rules_fired(run_concurrency_pass(idx),
+                           "bare-acquire") == []
+
+    def test_lock_graph_names_sites(self, tmp_path):
+        idx = make_tree(tmp_path, {"mod.py": GOOD_LOCK_ORDER})
+        graph = lock_graph(idx)
+        assert any("S._a" in k for k in graph)
+
+
+# ---------------------------------------------------------------------------
+# hot-path pass fixtures
+# ---------------------------------------------------------------------------
+
+HOT_SYNCS = '''
+import jax
+import numpy as np
+
+class Engine:
+    def _loop(self):
+        while True:
+            self._tick()
+
+    def _tick(self):
+        x = self._step()
+        n = x.item()
+        host = np.asarray(x)
+        jax.block_until_ready(x)
+        flag = float(x)
+        return n, host, flag
+
+    def _step(self):
+        return 1
+'''
+
+HOT_CLEAN = '''
+import jax
+import jax.numpy as jnp
+
+class Engine:
+    def _loop(self):
+        while True:
+            self._tick()
+
+    def _tick(self):
+        dev = jnp.asarray([1, 2])      # host->device: async, allowed
+        return dev
+
+    def unreachable_sync(self, x):
+        return x.item()                # NOT reachable from _loop
+'''
+
+BAD_COMPILED = '''
+from bigdl_tpu import observability as obs
+
+class Model:
+    def _build(self):
+        def step(params, x, flag):
+            if flag:                    # traced-branch
+                return params
+            return self.scale * x       # compiled-self-ref
+        return obs.compiled(step, name="m/step")
+'''
+
+GOOD_COMPILED = '''
+from bigdl_tpu import observability as obs
+
+class Model:
+    def _build(self):
+        cfg = self.cfg                  # the blessed idiom
+        def step(params, x):
+            return params + x * cfg.scale
+        return obs.compiled(step, name="m/step")
+'''
+
+ROOTS = (("bigdl_tpu/mod.py", "Engine", "_loop"),)
+
+
+class TestHotPathPass:
+    def test_sync_rules_fire(self, tmp_path):
+        idx = make_tree(tmp_path, {"mod.py": HOT_SYNCS})
+        findings = run_hotpath_pass(idx, roots=ROOTS)
+        assert len(rules_fired(findings, "host-sync-item")) == 1
+        # np.asarray + block_until_ready
+        assert len(rules_fired(findings, "host-sync-transfer")) == 2
+        assert len(rules_fired(findings, "host-sync-cast")) == 1
+
+    def test_upload_and_unreachable_clean(self, tmp_path):
+        idx = make_tree(tmp_path, {"mod.py": HOT_CLEAN})
+        findings = run_hotpath_pass(idx, roots=ROOTS)
+        # jnp.asarray is a host->device upload, not a sync; and the
+        # .item() lives in a function the engine loop never reaches
+        assert findings == []
+
+    def test_compiled_fn_hazards_fire(self, tmp_path):
+        idx = make_tree(tmp_path, {"mod.py": BAD_COMPILED})
+        findings = run_hotpath_pass(idx, roots=ROOTS)
+        assert len(rules_fired(findings, "traced-branch")) == 1
+        assert len(rules_fired(findings, "compiled-self-ref")) == 1
+
+    def test_compiled_fn_good_idiom_clean(self, tmp_path):
+        idx = make_tree(tmp_path, {"mod.py": GOOD_COMPILED})
+        assert run_hotpath_pass(idx, roots=ROOTS) == []
+
+
+# ---------------------------------------------------------------------------
+# registry-drift pass fixtures
+# ---------------------------------------------------------------------------
+
+REGISTRY_FIXTURE = '''
+from bigdl_tpu.utils.conf import conf
+from bigdl_tpu import observability as obs
+from bigdl_tpu import reliability
+
+def f():
+    conf.get_bool("bigdl.nosuch.key", False)          # unregistered
+    conf.get_int("bigdl.llm.pipeline_depth", 2)       # registered
+    obs.counter("bigdl_nosuch_total", "bogus")        # unregistered
+    reliability.inject("nosuch.site")                 # unregistered
+    reliability.inject("llm.step")                    # registered
+'''
+
+
+class TestRegistryPass:
+    def _run(self, tmp_path, files):
+        idx = make_tree(tmp_path, files)
+        return run_registry_pass(idx, usage_index=idx,
+                                 root=str(tmp_path))
+
+    def test_unregistered_literals_fire(self, tmp_path):
+        findings = self._run(tmp_path, {"mod.py": REGISTRY_FIXTURE})
+        assert [f.key for f in rules_fired(findings,
+                                           "conf-unregistered")] == \
+            ["bigdl.nosuch.key"]
+        assert [f.key for f in rules_fired(findings,
+                                           "metric-unregistered")] == \
+            ["bigdl_nosuch_total"]
+        assert [f.key for f in rules_fired(findings,
+                                           "site-unregistered")] == \
+            ["nosuch.site"]
+
+    def test_registered_names_clean(self, tmp_path):
+        findings = self._run(tmp_path, {"mod.py": REGISTRY_FIXTURE})
+        bad_keys = {f.key for f in findings
+                    if f.rule.endswith("unregistered")}
+        assert "bigdl.llm.pipeline_depth" not in bad_keys
+        assert "llm.step" not in bad_keys
+
+    def test_source_drift_fires(self, tmp_path):
+        files = {
+            "mod.py": "x = 1\n",
+            "utils/conf.py": '_DEFAULTS = {"bigdl.rogue.key": "1"}\n',
+        }
+        findings = self._run(tmp_path, files)
+        assert [f.key for f in rules_fired(findings,
+                                           "registry-source-drift")] == \
+            ["conf:bigdl.rogue.key"]
+
+    def test_marker_unregistered_fires(self, tmp_path):
+        files = {"mod.py": "import pytest\n\n"
+                           "@pytest.mark.bogusmark\n"
+                           "def test_x():\n    pass\n"}
+        findings = self._run(tmp_path, files)
+        assert [f.key for f in rules_fired(findings,
+                                           "marker-unregistered")] == \
+            ["bogusmark"]
+
+    def test_doc_brace_expansion(self):
+        from bigdl_tpu.analysis.registrydrift import DocIndex
+        di = DocIndex("counters `bigdl_kvcache_{hits,misses}_total` "
+                      "and `bigdl_kvtier_host_pages{,_used}`")
+        assert di.covers("bigdl_kvcache_hits_total")
+        assert di.covers("bigdl_kvcache_misses_total")
+        assert di.covers("bigdl_kvtier_host_pages")
+        assert di.covers("bigdl_kvtier_host_pages_used")
+        assert not di.covers("bigdl_kvcache_evictions_total")
+
+
+# ---------------------------------------------------------------------------
+# baseline engine
+# ---------------------------------------------------------------------------
+
+def _finding(key="k", rule="lock-order"):
+    return Finding(rule=rule, file="bigdl_tpu/mod.py", line=3,
+                   key=key, message="m")
+
+
+class TestBaseline:
+    def test_round_trip_suppresses(self, tmp_path):
+        path = str(tmp_path / "baseline.json")
+        bl = Baseline(path=path)
+        bl.add_findings([_finding("a"), _finding("b")], "triaged: ok")
+        bl.save()
+        loaded = Baseline.load(path)
+        new, suppressed, stale = loaded.split(
+            [_finding("a"), _finding("b"), _finding("c")])
+        assert [f.key for f in new] == ["c"]
+        assert len(suppressed) == 2 and stale == []
+
+    def test_stale_entries_reported_and_prunable(self, tmp_path):
+        path = str(tmp_path / "baseline.json")
+        bl = Baseline(path=path)
+        bl.add_findings([_finding("gone")], "fixed since")
+        bl.save()
+        loaded = Baseline.load(path)
+        _, _, stale = loaded.split([])
+        assert stale == [_finding("gone").fingerprint]
+        loaded.prune(stale)
+        loaded.save()
+        assert Baseline.load(path).entries == {}
+
+    def test_justification_required(self, tmp_path):
+        path = tmp_path / "baseline.json"
+        path.write_text(json.dumps({"entries": [
+            {"fingerprint": "lock-order::f::k", "justification": ""}]}))
+        bl = Baseline.load(str(path))
+        assert bl.entries == {}
+        assert any("justification" in e for e in bl.errors)
+
+    def test_fingerprint_is_line_free(self):
+        a = Finding(rule="r", file="f", line=1, key="k", message="x")
+        b = Finding(rule="r", file="f", line=99, key="k", message="y")
+        assert a.fingerprint == b.fingerprint
+
+
+# ---------------------------------------------------------------------------
+# lockwatch runtime witness
+# ---------------------------------------------------------------------------
+
+class TestLockwatch:
+    def test_disabled_structurally_absent(self):
+        """Acceptance: off by default — stock factories, no series."""
+        from bigdl_tpu import observability as obs
+        from bigdl_tpu.utils.conf import conf
+        assert conf.get_bool("bigdl.analysis.lockwatch") is False
+        assert lockwatch.maybe_install() is False
+        assert threading.Lock is lockwatch._ORIG_LOCK
+        assert threading.RLock is lockwatch._ORIG_RLOCK
+        assert not lockwatch.installed()
+        assert "bigdl_lockwatch" not in obs.render()
+
+    def test_inversion_detected(self):
+        """The seeded A->B / B->A inversion the ISSUE asks for."""
+        lockwatch.install()
+        try:
+            lockwatch.reset()
+            a = threading.Lock()
+            b = threading.Lock()
+            assert type(a).__name__ == "_WatchedLock"
+            with a:
+                with b:
+                    pass
+            assert lockwatch.violations() == []
+            with b:
+                with a:
+                    pass
+            vio = lockwatch.violations()
+            assert len(vio) == 1
+            assert "test_analysis.py" in vio[0]["pair"][0]
+        finally:
+            lockwatch.uninstall()
+            lockwatch.reset()
+        assert threading.Lock is lockwatch._ORIG_LOCK
+
+    def test_consistent_order_no_violation(self):
+        lockwatch.install()
+        try:
+            lockwatch.reset()
+            # one creation site per lock: site identity is file:line
+            a = threading.Lock()
+            b = threading.Lock()
+            for _ in range(3):
+                with a:
+                    with b:
+                        pass
+            assert lockwatch.violations() == []
+            assert len(lockwatch.observed_edges()) >= 1
+        finally:
+            lockwatch.uninstall()
+            lockwatch.reset()
+
+    def test_reentrant_rlock_no_edge(self):
+        lockwatch.install()
+        try:
+            lockwatch.reset()
+            r = threading.RLock()
+            with r:
+                with r:            # reentrant: no self-edge, balanced
+                    pass
+            assert lockwatch.violations() == []
+            with r:                # still usable after full release
+                pass
+        finally:
+            lockwatch.uninstall()
+            lockwatch.reset()
+
+    def test_watched_lock_backs_condition(self):
+        lockwatch.install()
+        try:
+            lockwatch.reset()
+            cv = threading.Condition(threading.RLock())
+            hit = []
+
+            def waiter():
+                with cv:
+                    cv.wait(timeout=5)
+                    hit.append(1)
+
+            t = threading.Thread(target=waiter)
+            t.start()
+            import time
+            time.sleep(0.05)
+            with cv:
+                cv.notify_all()
+            t.join(timeout=5)
+            assert hit == [1]
+        finally:
+            lockwatch.uninstall()
+            lockwatch.reset()
+
+
+# ---------------------------------------------------------------------------
+# the CI gate
+# ---------------------------------------------------------------------------
+
+class TestGate:
+    def test_repo_gate_zero_unbaselined(self):
+        """THE tier-1 gate: the analyzer over bigdl_tpu/ must report
+        zero findings the checked-in baseline does not suppress."""
+        out = analysis.check(REPO)
+        assert out["baseline_errors"] == []
+        assert out["new"] == [], (
+            "unbaselined static-analysis findings — fix them or triage "
+            "into bigdl_tpu/analysis/baseline.json:\n" +
+            "\n".join(f"{f['rule']}: {f['file']}:{f['line']}: "
+                      f"{f['message']}" for f in out["new"]))
+        assert out["ok"]
+
+    def test_repo_baseline_not_stale(self):
+        """Every baseline entry still matches a live finding — the
+        baseline only ever shrinks (prune when your fix lands)."""
+        out = analysis.check(REPO)
+        assert out["stale_baseline"] == []
+
+    def _cli(self, *args):
+        env = dict(os.environ)
+        return subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools",
+                                          "check_static.py")] +
+            list(args), capture_output=True, text=True, env=env,
+            timeout=300)
+
+    def test_cli_fixture_violations_exit_nonzero(self, tmp_path):
+        """Acceptance: nonzero exit on each fixture violation, one per
+        pass."""
+        (tmp_path / "bigdl_tpu").mkdir()
+        (tmp_path / "bigdl_tpu" / "mod.py").write_text(BAD_LOCK_ORDER)
+        r = self._cli("--root", str(tmp_path), "--passes",
+                      "concurrency")
+        assert r.returncode == 1, r.stdout + r.stderr
+        assert "lock-order" in r.stdout
+
+        (tmp_path / "bigdl_tpu" / "mod.py").write_text(BAD_COMPILED)
+        r = self._cli("--root", str(tmp_path), "--passes", "hotpath")
+        assert r.returncode == 1
+        assert "traced-branch" in r.stdout
+
+        (tmp_path / "bigdl_tpu" / "mod.py").write_text(
+            'from bigdl_tpu.utils.conf import conf\n'
+            'conf.get("bigdl.nosuch.key")\n')
+        r = self._cli("--root", str(tmp_path), "--passes", "registry")
+        assert r.returncode == 1
+        assert "conf-unregistered" in r.stdout
+
+    def test_cli_missing_justification_exit_2(self, tmp_path):
+        (tmp_path / "bigdl_tpu").mkdir()
+        (tmp_path / "bigdl_tpu" / "mod.py").write_text("x = 1\n")
+        bl = tmp_path / "baseline.json"
+        bl.write_text(json.dumps({"entries": [
+            {"fingerprint": "lock-order::f::k", "justification": ""}]}))
+        r = self._cli("--root", str(tmp_path), "--passes",
+                      "concurrency", "--baseline", str(bl))
+        assert r.returncode == 2
+        assert "BASELINE ERROR" in r.stdout
+
+    @pytest.mark.slow
+    def test_cli_repo_clean_exit_0(self):
+        """Acceptance: `python tools/check_static.py` exits 0 on the
+        repo (the in-process gate test covers the same contract; this
+        one pins the CLI surface)."""
+        r = self._cli()
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "gate clean" in r.stdout

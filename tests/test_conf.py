@@ -12,7 +12,7 @@ class TestBigDLConf:
     def test_defaults(self):
         c = BigDLConf(conf_file="/nonexistent")
         assert c.get("bigdl.mesh.axes") == "data"
-        assert c.get_bool("bigdl.check.singleton") is False
+        assert c.get_bool("bigdl.llm.kvcache.enabled") is False
         assert c.get_int("bigdl.optimizer.max.retry") == 0
 
     def test_layering_file_env_set(self, tmp_path, monkeypatch):
@@ -36,9 +36,9 @@ class TestBigDLConf:
         c.set("bigdl.num.processes", "not-a-number")
         with pytest.raises(ValueError, match="not an int"):
             c.get_int("bigdl.num.processes")
-        c.set("bigdl.check.singleton", "maybe")
+        c.set("bigdl.train.prefetch", "maybe")
         with pytest.raises(ValueError, match="not a bool"):
-            c.get_bool("bigdl.check.singleton")
+            c.get_bool("bigdl.train.prefetch")
 
     def test_effective_view(self):
         c = BigDLConf(conf_file="/nonexistent")

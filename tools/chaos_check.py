@@ -716,24 +716,59 @@ def run_all_chaos(seed: int = 0) -> dict:
     """Every chaos suite, one record per pass (the ``chaos_all``
     telemetry block in ``bench.py``). Each pass asserts its own
     parity contract; a failing pass lands as an ``error`` entry
-    instead of killing the others."""
+    instead of killing the others.
+
+    ISSUE 11: the whole run executes under the ``bigdl.analysis.
+    lockwatch`` runtime witness — every lock the suites construct is
+    order-checked against the process-global table, and ANY observed
+    inversion fails the run (``ok: false`` + the violating pair in the
+    ``lockwatch`` block). The knob is restored afterwards so the
+    process leaves the way it came."""
+    from bigdl_tpu.analysis import lockwatch
+    from bigdl_tpu.utils.conf import conf
+
+    # restore-exactly bookkeeping: remember whether the SET LAYER had
+    # an explicit value (conf.get would return the baked-in default and
+    # re-setting that would shadow the env/file layers forever), and
+    # whether a caller already installed the witness (then its edge
+    # table and installation are theirs — don't reset or uninstall)
+    with conf._lock:
+        prev = conf._set_layer.get("bigdl.analysis.lockwatch")
+    was_installed = lockwatch.installed()
+    conf.set("bigdl.analysis.lockwatch", "true")
+    if not was_installed:
+        lockwatch.reset()
+    installed = lockwatch.maybe_install() or was_installed
     out = {}
-    for name, fn in (("train", lambda: run_chaos(seed=seed, events=3,
-                                                 smoke=True)),
-                     ("kvcache", lambda: run_kvcache_chaos(seed=seed)),
-                     ("kvtier", lambda: run_kvtier_chaos(seed=seed)),
-                     ("failover", lambda: run_failover_chaos(
-                         seed=seed, smoke=True)),
-                     ("elastic", lambda: run_elastic_chaos(
-                         seed=seed, smoke=True))):
-        try:
-            out[name] = fn()
-        except ElasticUnsupported as e:
-            out[name] = {"skipped": repr(e)}   # no loopback distributed
-        except Exception as e:  # noqa: BLE001 — one bad suite
-            out[name] = {"error": repr(e)}   # must not hide the rest
+    try:
+        for name, fn in (("train", lambda: run_chaos(seed=seed, events=3,
+                                                     smoke=True)),
+                         ("kvcache", lambda: run_kvcache_chaos(seed=seed)),
+                         ("kvtier", lambda: run_kvtier_chaos(seed=seed)),
+                         ("failover", lambda: run_failover_chaos(
+                             seed=seed, smoke=True)),
+                         ("elastic", lambda: run_elastic_chaos(
+                             seed=seed, smoke=True))):
+            try:
+                out[name] = fn()
+            except ElasticUnsupported as e:
+                out[name] = {"skipped": repr(e)}  # no loopback distributed
+            except Exception as e:  # noqa: BLE001 — one bad suite
+                out[name] = {"error": repr(e)}  # must not hide the rest
+    finally:
+        violations = lockwatch.violations()
+        out["lockwatch"] = {"installed": installed,
+                            "edges_observed": len(
+                                lockwatch.observed_edges()),
+                            "violations": violations}
+        if installed and not was_installed:
+            lockwatch.uninstall()
+        if prev is None:
+            conf.unset("bigdl.analysis.lockwatch")
+        else:
+            conf.set("bigdl.analysis.lockwatch", prev)
     out["ok"] = all("error" not in v for v in out.values()
-                    if isinstance(v, dict))
+                    if isinstance(v, dict)) and not violations
     return out
 
 

@@ -1,0 +1,136 @@
+"""Static-analysis CI gate (ISSUE 11): run the three AST passes over
+``bigdl_tpu/`` and fail on any finding the checked-in baseline does not
+suppress.
+
+Usage:
+    python tools/check_static.py                  # the gate: 0 = clean
+    python tools/check_static.py --json           # machine-readable
+    python tools/check_static.py --passes hotpath # one pass only
+    python tools/check_static.py --write-baseline --justify "..."
+                                                  # absorb current NEW
+                                                  # findings (triage!)
+    python tools/check_static.py --prune          # drop stale entries
+    python tools/check_static.py --dump-graph     # static lock graph
+    python tools/check_static.py --strict         # stale baseline fails
+
+Exit codes: 0 clean; 1 unbaselined findings; 2 baseline hygiene errors
+(missing justification / duplicates); 3 stale baseline under --strict.
+
+The analyzer imports nothing from the analyzed code — this script
+loads ``bigdl_tpu/analysis`` as a standalone package, so the gate runs
+without jax in milliseconds (CI pre-commit friendly).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+# load the analysis package WITHOUT importing bigdl_tpu/__init__ (which
+# pulls jax): the package uses relative imports precisely for this
+sys.path.insert(0, os.path.join(_ROOT, "bigdl_tpu"))
+import analysis                                        # noqa: E402
+from analysis.baseline import Baseline                 # noqa: E402
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--root", default=_ROOT)
+    ap.add_argument("--baseline", default=None,
+                    help="baseline path (default "
+                         "bigdl_tpu/analysis/baseline.json)")
+    ap.add_argument("--passes", default=",".join(analysis.PASSES),
+                    help="comma-separated subset of "
+                         f"{analysis.PASSES}")
+    ap.add_argument("--json", action="store_true",
+                    help="print the full summary record as JSON")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="add every currently-NEW finding to the "
+                         "baseline (requires --justify)")
+    ap.add_argument("--justify", default="",
+                    help="justification string for --write-baseline")
+    ap.add_argument("--prune", action="store_true",
+                    help="rewrite the baseline without stale entries")
+    ap.add_argument("--strict", action="store_true",
+                    help="stale baseline entries fail the gate")
+    ap.add_argument("--dump-graph", action="store_true",
+                    help="print the static lock-order graph "
+                         "(adjacency JSON) and exit")
+    args = ap.parse_args()
+
+    if args.dump_graph:
+        from analysis.concurrency import lock_graph
+        idx = analysis.build_index(args.root)
+        print(json.dumps(lock_graph(idx), indent=1))
+        return 0
+
+    passes = tuple(p.strip() for p in args.passes.split(",") if p.strip())
+    baseline_path = args.baseline or os.path.join(
+        args.root, analysis.BASELINE_RELPATH)
+
+    if args.write_baseline:
+        if not args.justify.strip():
+            print("--write-baseline requires --justify 'why these are "
+                  "acceptable' (triage, don't bulk-silence)",
+                  file=sys.stderr)
+            return 2
+        findings = analysis.run_analysis(args.root, passes=passes)
+        bl = Baseline.load(baseline_path)
+        new, _, _ = bl.split(findings)
+        bl.add_findings(new, args.justify.strip())
+        bl.save(baseline_path)
+        print(f"baselined {len(new)} finding(s) -> {baseline_path}")
+        return 0
+
+    out = analysis.check(args.root, baseline_path=baseline_path,
+                         passes=passes)
+
+    if args.prune and out["stale_baseline"]:
+        bl = Baseline.load(baseline_path)
+        bl.prune(out["stale_baseline"])
+        bl.save(baseline_path)
+        print(f"pruned {len(out['stale_baseline'])} stale baseline "
+              f"entr(y/ies)")
+        out["stale_baseline"] = []
+
+    if args.json:
+        print(json.dumps(out, indent=1))
+    else:
+        _print_human(out)
+
+    if out["baseline_errors"]:
+        return 2
+    if out["new"]:
+        return 1
+    if args.strict and out["stale_baseline"]:
+        return 3
+    return 0
+
+
+def _print_human(out: dict):
+    print(f"check_static: {out['total']} finding(s) total, "
+          f"{out['suppressed']} baselined, {len(out['new'])} NEW")
+    if out["by_rule"]:
+        width = max(len(r) for r in out["by_rule"])
+        for rule, n in out["by_rule"].items():
+            print(f"  {rule:<{width}}  {n}")
+    for f in out["new"]:
+        print(f"NEW {f['rule']}: {f['file']}:{f['line']}: "
+              f"{f['message']}")
+    for err in out["baseline_errors"]:
+        print(f"BASELINE ERROR: {err}")
+    for fp in out["stale_baseline"]:
+        print(f"stale baseline entry (no longer fires): {fp}")
+    if out["new"]:
+        print("\nFix the finding, or triage it into "
+              f"{out['baseline_path']} with a justification "
+              "(tools/check_static.py --write-baseline --justify ...).")
+    else:
+        print("gate clean: zero unbaselined findings")
+
+
+if __name__ == "__main__":
+    sys.exit(main())
