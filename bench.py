@@ -983,6 +983,15 @@ def _telemetry_block() -> dict:
         out["microbench_ragged"] = run_ragged_bench()
     except Exception as e:
         out["microbench_ragged"] = {"error": repr(e)}
+    try:
+        # ISSUE 12: the fleet telemetry plane — two live workers behind
+        # a federation+SLO router; merged sketch percentiles
+        # (ttft_p50/p95/p99_ms, itl_p99_ms — bench_regress diffs them)
+        # plus the counter-additivity verdict
+        from tools.fleet_report import run_fleet_micro
+        out["fleet"] = run_fleet_micro()
+    except Exception as e:
+        out["fleet"] = {"error": repr(e)}
     return out
 
 

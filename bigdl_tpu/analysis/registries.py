@@ -122,6 +122,12 @@ CONF_KEYS.update({
         "metrics + trace spans",
     "bigdl.observability.exemplars":
         "slowest-N latency traces",
+    "bigdl.observability.federation":
+        "fleet collector + /metrics/snapshot + /fleet/status; false = absent",
+    "bigdl.observability.federation.interval":
+        "member scrape cadence (seconds)",
+    "bigdl.observability.sketch.alpha":
+        "quantile-sketch relative-error bound (merge requires equal alpha)",
     "bigdl.observability.trace.capacity":
         "span ring entries",
     "bigdl.optimizer.max.retry":
@@ -136,6 +142,14 @@ CONF_KEYS.update({
         "tries, not retries",
     "bigdl.reliability.retry.max.delay":
         "backoff cap",
+    "bigdl.slo.enabled":
+        "per-request TTFT/ITL SLO accounting; false = no sketch/slo series",
+    "bigdl.slo.itl_ms":
+        "inter-token-latency objective: worst gap per request",
+    "bigdl.slo.ttft_ms":
+        "time-to-first-token objective (admission to first token)",
+    "bigdl.slo.window":
+        "rolling burn-rate window (requests)",
     "bigdl.train.prefetch":
         "stage batch N+1 during N",
     "bigdl.train.prefetch.depth":
@@ -181,6 +195,12 @@ METRICS.update({
         "Live (heartbeating) training processes this generation",
     "bigdl_engine_init_failures_total":
         "jax.distributed.initialize failures during Engine.init",
+    "bigdl_federation_members":
+        "Members the fleet collector is scraping",
+    "bigdl_federation_scrapes_total":
+        "Member snapshot scrapes by outcome",
+    "bigdl_federation_stale_instances":
+        "Members whose last /metrics/snapshot scrape failed (serving last-known state)",
     "bigdl_kvcache_evictions_total":
         "Pages evicted from the prefix index under pool pressure",
     "bigdl_kvcache_hits_total":
@@ -221,6 +241,8 @@ METRICS.update({
         "Host wall attributed to one decode step: scheduling + fence stall (under pipelining device compute overlaps the host, so this is NOT pure device time — see the host/stall split below and docs/PERFORMANCE.md)",
     "bigdl_llm_decode_tokens_total":
         "Tokens decoded across all slots",
+    "bigdl_llm_itl_seconds":
+        "Engine gap between consecutive drained tokens of one request, mergeable quantile sketch",
     "bigdl_llm_kv_pages_in_use":
         "Physical KV pages owned by live requests",
     "bigdl_llm_kv_pool_occupancy":
@@ -233,6 +255,8 @@ METRICS.update({
         "Prompt tokens prefilled into the KV cache",
     "bigdl_llm_requests_total":
         "Requests finished by the engine",
+    "bigdl_llm_ttft_seconds":
+        "Engine time to first token (submit to first drained token), mergeable quantile sketch",
     "bigdl_llm_watchdog_trips_total":
         "Engine stalls detected by the step-deadline watchdog",
     "bigdl_lockwatch_inversions_total":
@@ -259,8 +283,12 @@ METRICS.update({
         "Requests re-dispatched to another backend after a failure",
     "bigdl_router_hedges_total":
         "Hedged backend calls by outcome",
+    "bigdl_router_itl_seconds":
+        "Client-visible gap between streamed tokens at the router (resumed/hedged tokens stamped once), mergeable quantile sketch",
     "bigdl_router_journal_inflight":
         "Routed requests currently in the failover journal",
+    "bigdl_router_ttft_seconds":
+        "Client-visible time to first streamed token at the router, mergeable quantile sketch",
     "bigdl_serving_errors_total":
         "Predict requests failing (bad request or timeout)",
     "bigdl_serving_queue_depth":
@@ -271,6 +299,10 @@ METRICS.update({
         "HTTP requests by endpoint outcome",
     "bigdl_serving_served_total":
         "Predict requests answered with a result",
+    "bigdl_slo_burn_rate":
+        "Fraction of the last bigdl.slo.window requests violating the SLO",
+    "bigdl_slo_requests_total":
+        "Finished requests classified against the bigdl.slo.* thresholds",
     "bigdl_summary_scalar":
         "Last value of each Train/ValidationSummary scalar tag",
     "bigdl_train_compute_seconds_total":
@@ -312,6 +344,8 @@ METRICS.update({
 SPAN_NAMES.update({
     "elastic/flush":
         "durable snapshot flush (elastic training, process 0)",
+    "federation/scrape":
+        "completion: one fleet-collector sweep over the members",
     "elastic/restart":
         "completion: a generation restart round-trip",
     "elastic/rollback":
@@ -373,6 +407,8 @@ FAULT_SITES.update({
         "agent->supervisor beat (ISSUE 10)",
     "elastic.step":
         "elastic-guarded train step (ISSUE 10)",
+    "federation.scrape":
+        "fleet collector member scrape (ISSUE 12)",
     "kvcache.evict":
         "prefix-cache LRU eviction (ISSUE 5)",
     "kvtier.fetch":
@@ -418,6 +454,8 @@ PYTEST_MARKERS.update({
         "tiered KV-cache (host arena / migration / handoff) tests",
     "perf":
         "performance microbenchmarks (advisory on shared hosts)",
+    "slo":
+        "fleet telemetry plane tests (sketches, federation, SLO accounting)",
     "slow":
         "excluded from the tier-1 gate (-m 'not slow')",
 })

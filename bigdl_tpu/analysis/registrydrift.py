@@ -40,7 +40,8 @@ from .core import Finding, ProjectIndex
 
 _CONF_RE = re.compile(r"^bigdl(\.[a-z0-9_]+)+$")
 _SITE_RE = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_*?]+)+$")
-_METRIC_DECL_FUNCS = ("counter", "gauge", "histogram", "_count")
+_METRIC_DECL_FUNCS = ("counter", "gauge", "histogram", "sketch",
+                      "_count")
 _METRIC_USE_FUNCS = _METRIC_DECL_FUNCS + ("sample_value", "get")
 _SPAN_FUNCS = ("span", "add_complete")
 

@@ -108,6 +108,13 @@ class ElasticAgent:
         self.beats = 0
         self.beat_failures = 0
         self.stalls = 0
+        # fleet federation member surface (ISSUE 12): a training
+        # process has no HTTP server of its own, so when
+        # bigdl.observability.federation is on the agent runs a tiny
+        # /metrics/snapshot listener and advertises its address on
+        # every heartbeat — the supervisor-embedded collector polls
+        # it. Off (the default): no server, no thread, no socket.
+        self._metrics_server = None
 
     @property
     def has_supervisor(self) -> bool:
@@ -203,6 +210,8 @@ class ElasticAgent:
                        "snap_step": self._snap_step,
                        "status": "stall" if stalled else "ok",
                        "generation": self.generation}
+        if self._metrics_server is not None:
+            payload["metrics_addr"] = list(self._metrics_server.address)
         out = self._transport(payload)
         self.beats += 1
         from bigdl_tpu import observability as obs
@@ -243,6 +252,12 @@ class ElasticAgent:
                 target=self._loop, name="bigdl-elastic-agent",
                 daemon=True)
             self._thread.start()
+        if self._metrics_server is None and self._transport is not None:
+            from bigdl_tpu.observability.federation import (
+                SnapshotServer, federation_enabled)
+            if federation_enabled():
+                self._metrics_server = SnapshotServer(
+                    instance=f"pid{self.process_id}").start()
         return self
 
     def stop(self):
@@ -250,3 +265,6 @@ class ElasticAgent:
         if self._thread is not None:
             self._thread.join(timeout=self.heartbeat_interval + 2.0)
             self._thread = None
+        if self._metrics_server is not None:
+            self._metrics_server.stop()
+            self._metrics_server = None

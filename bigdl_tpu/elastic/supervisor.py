@@ -50,7 +50,7 @@ RUNNING, RESTARTING = "running", "restarting"
 
 class _Peer:
     __slots__ = ("pid", "last_seen", "step", "snap_step", "status",
-                 "beats")
+                 "beats", "metrics_addr")
 
     def __init__(self, pid: int, now: float):
         self.pid = pid
@@ -59,6 +59,9 @@ class _Peer:
         self.snap_step = -1
         self.status = "ok"
         self.beats = 0
+        # federation (ISSUE 12): the peer's /metrics/snapshot listener,
+        # advertised on its heartbeats when the plane is enabled
+        self.metrics_addr = None
 
 
 class Supervisor:
@@ -102,11 +105,39 @@ class Supervisor:
         self._host, self._port = host, port
         self._httpd = None
         self._thread: Optional[threading.Thread] = None
+        # fleet federation (ISSUE 12): supervisor-embedded collector
+        # over the live peers' advertised /metrics/snapshot listeners.
+        # Constructed ONLY when bigdl.observability.federation is on —
+        # disabled means no collector thread and the fleet endpoints
+        # stay 404 like any unknown path.
+        self._collector = None
+        from bigdl_tpu.observability.federation import federation_enabled
+        if federation_enabled():
+            from bigdl_tpu.observability.federation import (
+                FederationCollector)
+            self._collector = FederationCollector(
+                self._federation_targets, include_self="supervisor")
+
+    def _federation_targets(self):
+        with self._lock:
+            return [(f"pid{p.pid}", tuple(p.metrics_addr))
+                    for p in self._peers.values()
+                    if p.metrics_addr is not None]
 
     # -- core state machine --------------------------------------------------
     def heartbeat(self, pid: int, step: int = 0, snap_step: int = -1,
-                  status: str = "ok", generation: int = 0) -> dict:
+                  status: str = "ok", generation: int = 0,
+                  metrics_addr=None) -> dict:
         """Process one beat; returns the directive the agent acts on."""
+        if metrics_addr is not None:
+            # validate BEFORE any peer state mutates, so a malformed
+            # beat is a clean 422, not a half-recorded beat + traceback
+            try:
+                metrics_addr = (str(metrics_addr[0]),
+                                int(metrics_addr[1]))
+            except (IndexError, TypeError, ValueError):
+                raise ValueError(
+                    f"bad metrics_addr {metrics_addr!r}") from None
         now = self._clock()
         with self._lock:
             if generation != self.generation:
@@ -129,6 +160,8 @@ class Supervisor:
             peer.snap_step = max(peer.snap_step, int(snap_step))
             peer.status = status
             peer.beats += 1
+            if metrics_addr is not None:
+                peer.metrics_addr = metrics_addr
             if status == "stall":
                 self.stalls += 1
                 self._fail_locked(f"process {pid} reported a stalled "
@@ -308,6 +341,22 @@ class Supervisor:
                     self._json(200 if ok else 503,
                                {"ok": ok, "state": sup.state,
                                 "generation": sup.generation})
+                elif self.path == "/metrics" and \
+                        sup._collector is not None:
+                    # fleet view of the training job (ISSUE 12):
+                    # merged peer snapshots + the supervisor's own
+                    # registry. Structurally absent (404) when the
+                    # federation plane is off.
+                    from bigdl_tpu import observability as obs
+                    body = sup._collector.render().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", obs.CONTENT_TYPE)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif self.path == "/fleet/status" and \
+                        sup._collector is not None:
+                    self._json(200, sup._collector.status())
                 else:
                     self._json(404, {"error": "unknown path"})
 
@@ -323,7 +372,8 @@ class Supervisor:
                         step=int(req.get("step", 0)),
                         snap_step=int(req.get("snap_step", -1)),
                         status=str(req.get("status", "ok")),
-                        generation=int(req.get("generation", 0)))
+                        generation=int(req.get("generation", 0)),
+                        metrics_addr=req.get("metrics_addr"))
                 except (KeyError, TypeError, ValueError) as e:
                     self._json(422, {"error": f"bad heartbeat: {e}"})
                     return
@@ -335,6 +385,8 @@ class Supervisor:
             target=self._httpd.serve_forever,
             name="bigdl-elastic-supervisor", daemon=True)
         self._thread.start()
+        if self._collector is not None:
+            self._collector.start()
         return self
 
     @property
@@ -344,6 +396,8 @@ class Supervisor:
         return self._httpd.server_address[:2]
 
     def stop(self):
+        if self._collector is not None:
+            self._collector.stop()
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()

@@ -49,7 +49,8 @@ class JournalEntry:
     """One in-flight routed request: the resume state failover needs."""
 
     __slots__ = ("id", "prompt_ids", "max_new_tokens", "tokens",
-                 "attempts", "hedges", "created_at", "finish_reason")
+                 "attempts", "hedges", "created_at", "finish_reason",
+                 "token_times")
 
     def __init__(self, entry_id: int, prompt_ids: List[int],
                  max_new_tokens: int):
@@ -61,6 +62,13 @@ class JournalEntry:
         self.hedges = 0
         self.created_at = time.monotonic()
         self.finish_reason: Optional[str] = None
+        # client-visible arrival stamp per token (ISSUE 12 SLO
+        # accounting): aligned with ``tokens``, written by ``drained``
+        # only for the indices an update actually extends — so a
+        # resume's replayed prefix and a hedge twin's echo never
+        # re-stamp a token, and the failover recovery gap shows up as
+        # one honest inter-token sample
+        self.token_times: List[float] = []
 
     @property
     def remaining(self) -> int:
@@ -78,9 +86,16 @@ class JournalEntry:
         chunks repeat everything drained so far, so shorter/equal
         updates (a hedge twin behind the winner) are no-ops — a plain
         ``extend`` here would duplicate tokens and corrupt
-        :meth:`resume_prompt` on the next failover."""
+        :meth:`resume_prompt` on the next failover (and double-stamp
+        ITL samples, ISSUE 12)."""
         if base + len(cumulative) > len(self.tokens):
+            # the guard means tokens only ever GROW, so stamping the
+            # tail up to the new length covers exactly the indices
+            # this update added
             self.tokens[base:] = [int(t) for t in cumulative]
+            now = time.monotonic()
+            while len(self.token_times) < len(self.tokens):
+                self.token_times.append(now)
 
 
 class RequestJournal:

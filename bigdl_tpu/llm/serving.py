@@ -276,6 +276,12 @@ class Request:
         # here, first-token stamp at the engine's drain
         self.t_submit = time.perf_counter()
         self.t_first_token = 0.0
+        # per-request SLO accounting (ISSUE 12, engine scope): last
+        # token's drain stamp and the worst inter-token gap so far —
+        # two floats, maintained only when the server's SLO account
+        # exists
+        self.t_last_token = 0.0
+        self.itl_max = -1.0
 
     def get(self, timeout: Optional[float] = None) -> List[int]:
         if not self.done.wait(timeout):
@@ -378,7 +384,8 @@ class LLMServer:
                  kvtier: Optional[bool] = None,
                  host_pages: Optional[int] = None,
                  watchdog_timeout: Optional[float] = None,
-                 ragged_prefill: Optional[bool] = None):
+                 ragged_prefill: Optional[bool] = None,
+                 slo: Optional[bool] = None):
         import inspect
 
         from bigdl_tpu.llm.models.llama import forward, init_cache
@@ -492,6 +499,12 @@ class LLMServer:
         # fails pending requests retriably instead of hanging clients
         # forever. 0/None = structurally absent: no monitor thread, no
         # watchdog series, no healthz key.
+        # per-request SLO accounting (ISSUE 12): TTFT/ITL quantile
+        # sketches + threshold classification, engine scope. None (the
+        # default) is structural absence — no sketch series, no
+        # bigdl_slo_* series, no extra work in the drain.
+        from bigdl_tpu.observability.slo import SLOAccount
+        self._slo = SLOAccount.if_enabled("engine", enabled=slo)
         wd = (watchdog_timeout if watchdog_timeout is not None else
               conf.get_float("bigdl.llm.watchdog.step_timeout", 0.0))
         self.watchdog_timeout = float(wd or 0.0)
@@ -1649,13 +1662,19 @@ class LLMServer:
         for args in rec.pop("kv_release", ()):
             self._kv.release_slot(*args)
         finished = applied = cancelled = 0
+        # one clock read per drain, shared by every slot's SLO stamps
+        # (ISSUE 12): the tokens in this pass became host-visible at
+        # the same fence fetch, so one arrival time is the honest one
+        now = time.perf_counter() if self._slo is not None else 0.0
         for i, req in rec["pairs"]:
             if self._slots[i] is not req:
                 continue   # speculative token for a finished request
             if req.cancel_requested:
                 # aborted mid-decode (hedge loser, watchdog, client
                 # gone): release the slot and its pages now — the
-                # drained token is discarded like any speculative one
+                # drained token is discarded like any speculative one.
+                # Not SLO-classified: an abort is the caller's choice,
+                # not a latency verdict.
                 self._finish_slot(i, req)
                 cancelled += 1
                 continue
@@ -1663,12 +1682,26 @@ class LLMServer:
             req.tokens.append(tok)
             if len(req.tokens) == 1:
                 req.t_first_token = time.perf_counter()  # TTFT stamp
+                if self._slo is not None:
+                    self._slo.observe_ttft(now - req.t_submit)
+                    req.t_last_token = now
+            elif self._slo is not None:
+                gap = now - req.t_last_token
+                req.t_last_token = now
+                if gap > req.itl_max:
+                    req.itl_max = gap
+                self._slo.observe_itl(gap)
             applied += 1
             if (self.eos_token_id is not None
                     and tok == self.eos_token_id) \
                     or len(req.tokens) >= req.max_new_tokens:
                 self._finish_slot(i, req)
                 finished += 1
+                if self._slo is not None:
+                    self._slo.finish(
+                        (req.t_first_token - req.t_submit
+                         if req.t_first_token else None),
+                        req.itl_max if req.itl_max >= 0 else None)
         if (finished or cancelled) and self.pipeline_depth == 1:
             # strict synchrony at depth 1: the freed-row resets above
             # must resolve before their consumed buffers drop (exactly

@@ -81,6 +81,8 @@ from bigdl_tpu import observability as obs
 from bigdl_tpu import reliability
 from bigdl_tpu.observability import request_context as rc
 from bigdl_tpu.observability import tracing
+from bigdl_tpu.observability.federation import (
+    federation_enabled, registry_snapshot)
 
 ROLES = ("", "prefill", "decode")
 
@@ -123,7 +125,8 @@ class LLMWorker:
     def __init__(self, server, model_name: str = "bigdl-tpu-llm",
                  host: str = "127.0.0.1", port: int = 0,
                  request_timeout: float = 600.0,
-                 role: Optional[str] = None):
+                 role: Optional[str] = None,
+                 federation: Optional[bool] = None):
         from bigdl_tpu.utils.conf import conf
         self.server = server
         self.model_name = model_name
@@ -133,6 +136,10 @@ class LLMWorker:
         if self.role not in ROLES:
             raise ValueError(f"bigdl.llm.role must be one of {ROLES}, "
                              f"got {self.role!r}")
+        # fleet federation member surface (ISSUE 12): /metrics/snapshot
+        # exists only when the federation plane is on — a disabled
+        # worker keeps the endpoint structurally absent (404)
+        self.federation = federation_enabled(federation)
         self._t0 = time.time()
         self._tokens_out = 0
         worker = self
@@ -229,6 +236,19 @@ class LLMWorker:
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
                     self.wfile.write(body)
+                elif self.path == "/metrics/snapshot":
+                    # federation member surface (ISSUE 12): the full
+                    # registry as JSON incl. sketch state, for the
+                    # fleet collector's label-aware merge. 404 when
+                    # the federation plane is off — structurally
+                    # absent, not empty.
+                    if not worker.federation:
+                        self._json(404,
+                                   {"error": "federation disabled"})
+                    else:
+                        addr = worker.address
+                        self._json(200, registry_snapshot(
+                            instance=f"{addr[0]}:{addr[1]}"))
                 elif self.path == "/healthz":
                     ok, report = reliability.health_report()
                     engine = worker.server._thread
@@ -259,6 +279,11 @@ class LLMWorker:
                             "trips": worker.server.watchdog_trips,
                             "step_timeout_s":
                                 worker.server.watchdog_timeout}
+                    # rolling SLO burn rate (ISSUE 12): absent when
+                    # bigdl.slo.enabled is off
+                    slo = getattr(worker.server, "_slo", None)
+                    if slo is not None:
+                        body["slo"] = slo.status()
                     self._json(200 if healthy else 503, body)
                 else:
                     self._json(404, {"error": "unknown path"})
@@ -655,7 +680,9 @@ class LLMRouter:
                  failover_attempts: Optional[int] = None,
                  hedge_delay_ms: Optional[float] = None,
                  prober_interval: Optional[float] = None,
-                 start_prober: bool = True):
+                 start_prober: bool = True,
+                 slo: Optional[bool] = None,
+                 federation: Optional[bool] = None):
         from bigdl_tpu.utils.conf import conf
         if not decode_workers:
             raise ValueError("the router needs at least one "
@@ -713,6 +740,24 @@ class LLMRouter:
                                              0.5)),
                     on_probe=self._on_probe)
                 self._start_prober = start_prober
+        # client-visible SLO accounting (ISSUE 12): TTFT/ITL from the
+        # journal's streamed-token timestamps — only meaningful in
+        # failover mode (the blocking PR 6 path streams nothing), and
+        # only constructed when bigdl.slo.enabled says so
+        self._slo = None
+        if self._active:
+            from bigdl_tpu.observability.slo import SLOAccount
+            self._slo = SLOAccount.if_enabled("router", enabled=slo)
+        # fleet metric federation (ISSUE 12): a background collector
+        # scraping every pool member's /metrics/snapshot; constructed
+        # ONLY when bigdl.observability.federation is on — disabled
+        # mode has no collector thread and the fleet endpoints 404
+        self._collector = None
+        if federation_enabled(federation):
+            from bigdl_tpu.observability.federation import (
+                FederationCollector)
+            self._collector = FederationCollector(
+                self._federation_targets, include_self="router")
         self._ins = None
         router = self
 
@@ -734,12 +779,26 @@ class LLMRouter:
                     self._json(*router._healthz())
                 elif self.path == "/metrics":
                     router._record_breakers()
-                    body = obs.render().encode()
+                    if router._collector is not None:
+                        # fleet view (ISSUE 12): members' cached
+                        # snapshots merged label-aware, the router's
+                        # own registry riding along as instance
+                        # "router". Render only reads the collector
+                        # cache — a dead member can never stall this.
+                        body = router._collector.render().encode()
+                    else:
+                        body = obs.render().encode()
                     self.send_response(200)
                     self.send_header("Content-Type", obs.CONTENT_TYPE)
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
                     self.wfile.write(body)
+                elif self.path == "/fleet/status":
+                    if router._collector is None:
+                        self._json(404,
+                                   {"error": "federation disabled"})
+                    else:
+                        self._json(200, router._collector.status())
                 elif self.path == "/worker_get_status":
                     self._json(200, router._status_body())
                 else:
@@ -822,6 +881,16 @@ class LLMRouter:
             return ([(a, "prefill") for a in self.prefill_workers]
                     + [(a, "decode") for a in self.decode_workers])
 
+    def _federation_targets(self):
+        """Live pool membership for the fleet collector (ISSUE 12):
+        one member per distinct backend address — a worker in both
+        pools is scraped once."""
+        with self._pool_lock:
+            seen = {}
+            for a in self.prefill_workers + self.decode_workers:
+                seen.setdefault(f"{a[0]}:{a[1]}", a)
+        return sorted(seen.items())
+
     def _on_probe(self, addr, role, healthy, body):
         ins = self._instruments()
         if ins is not None and "healthy" in ins:
@@ -897,6 +966,10 @@ class LLMRouter:
             body["hedges_issued"] = self.hedges_issued
         if self._prober is not None:
             body["prober"] = self._prober.status()
+        if self._slo is not None:
+            # rolling burn rate (ISSUE 12): one number an autoscaler
+            # or alert reads instead of differencing counters
+            body["slo"] = self._slo.status()
         return (200 if healthy else 503), body
 
     def _status_body(self):
@@ -1366,6 +1439,23 @@ class LLMRouter:
                            if rc.current() is not None else {}))
                     continue
             self.requests_routed += 1
+            if self._slo is not None:
+                # client-visible SLO verdict from the journal's token
+                # arrival stamps (ISSUE 12): resumed/hedged tokens were
+                # stamped exactly once by JournalEntry.drained, so a
+                # mid-stream failover contributes its recovery gap as
+                # ONE inter-token sample instead of replayed duplicates
+                from bigdl_tpu.observability.slo import itl_samples
+                times = list(ent.token_times)
+                if times:
+                    ttft = times[0] - ent.created_at
+                    self._slo.observe_ttft(ttft)
+                    gaps = itl_samples(times)
+                    for g in gaps:
+                        self._slo.observe_itl(g)
+                    self._slo.finish(ttft, max(gaps) if gaps else None)
+                else:
+                    self._slo.finish(None, None)
             handler._json(200, {
                 "output_ids": [int(t) for t in ent.tokens],
                 "finish_reason": ent.finish_reason or "length"})
@@ -1381,9 +1471,13 @@ class LLMRouter:
         self._thread.start()
         if self._prober is not None and self._start_prober:
             self._prober.start()
+        if self._collector is not None:
+            self._collector.start()
         return self
 
     def stop(self):
+        if self._collector is not None:
+            self._collector.stop()
         if self._prober is not None:
             self._prober.stop()
         if self._thread is not None:

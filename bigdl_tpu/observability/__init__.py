@@ -28,7 +28,9 @@ import time as _time
 from bigdl_tpu.observability import _state
 from bigdl_tpu.observability.metrics import (
     CONTENT_TYPE, Counter, DEFAULT_BUCKETS, FAST_BUCKETS, Gauge,
-    Histogram, MetricRegistry, parse_prometheus, render_prometheus)
+    Histogram, MetricRegistry, SUMMARY_QUANTILES, Sketch,
+    parse_prometheus, render_prometheus)
+from bigdl_tpu.observability.sketch import QuantileSketch
 from bigdl_tpu.observability import tracing
 from bigdl_tpu.observability.tracing import (
     EXEMPLARS, TRACE, TraceBuffer, add_complete, assemble_trace,
@@ -106,6 +108,13 @@ def histogram(name: str, help: str = "", labelnames=(),
     return REGISTRY.histogram(name, help, labelnames, buckets)
 
 
+def sketch(name: str, help: str = "", labelnames=(), alpha=None):
+    """Mergeable quantile sketch (ISSUE 12): observed like a histogram,
+    rendered as summary quantiles, merged across workers by the
+    federation layer."""
+    return REGISTRY.sketch(name, help, labelnames, alpha)
+
+
 def render() -> str:
     """Prometheus text exposition of the global registry."""
     _ensure_standard_series()
@@ -124,11 +133,13 @@ def reset():
 
 __all__ = [
     "CONTENT_TYPE", "Counter", "EXEMPLARS", "Gauge", "Histogram",
-    "MetricRegistry", "PARENT_HEADER", "PROCESS_START_TIME", "REGISTRY",
+    "MetricRegistry", "PARENT_HEADER", "PROCESS_START_TIME",
+    "QuantileSketch", "REGISTRY", "SUMMARY_QUANTILES", "Sketch",
     "TRACE", "TRACE_HEADER", "TraceBuffer", "TraceContext",
     "DEFAULT_BUCKETS", "FAST_BUCKETS", "add_complete", "assemble_trace",
     "compile_recorder", "compile_stats", "compiled", "configure",
     "counter", "disable", "enable", "enabled", "export_chrome_trace",
     "gauge", "histogram", "parse_prometheus", "render",
-    "render_prometheus", "request_context", "reset", "span", "tracing",
+    "render_prometheus", "request_context", "reset", "sketch", "span",
+    "tracing",
 ]

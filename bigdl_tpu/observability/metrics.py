@@ -36,10 +36,14 @@ import threading
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 from bigdl_tpu.observability import _state
+from bigdl_tpu.observability.sketch import QuantileSketch
 
 #: HTTP Content-Type of the text exposition format — the one string
 #: every /metrics endpoint must agree on.
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+#: Quantiles a Sketch instrument renders as Prometheus summary series.
+SUMMARY_QUANTILES: Tuple[float, ...] = (0.5, 0.9, 0.95, 0.99)
 
 # Prometheus default buckets are tuned for request latency in seconds;
 # training steps and decode steps live in the same range.
@@ -193,6 +197,37 @@ class _HistogramChild:
         return self._buckets[-1] if self._buckets else None
 
 
+class _SketchChild:
+    """One labeled series of a :class:`Sketch`: a
+    :class:`~bigdl_tpu.observability.sketch.QuantileSketch` behind the
+    global observability switch (the sketch itself is switch-agnostic,
+    so federation can build merge scratch sketches freely)."""
+
+    __slots__ = ("sketch",)
+
+    def __init__(self, alpha: Optional[float]):
+        self.sketch = QuantileSketch(alpha=alpha)
+
+    def observe(self, value: float):
+        if not _state.enabled:
+            return
+        self.sketch.observe(value)
+
+    @property
+    def count(self) -> int:
+        return self.sketch.count
+
+    @property
+    def sum(self) -> float:
+        return self.sketch.sum
+
+    def quantile(self, q: float) -> Optional[float]:
+        return self.sketch.quantile(q)
+
+    def to_snapshot(self) -> dict:
+        return self.sketch.to_snapshot()
+
+
 class _Instrument:
     kind = "untyped"
 
@@ -299,6 +334,46 @@ class Histogram(_Instrument):
         return self._sole().sum
 
 
+class Sketch(_Instrument):
+    """Mergeable quantile instrument (ISSUE 12): one
+    :class:`~bigdl_tpu.observability.sketch.QuantileSketch` per labeled
+    series, rendered as Prometheus **summary** quantiles. Unlike a
+    Histogram its percentiles carry a stated relative-error bound
+    (``alpha``) and two workers' series merge losslessly — the signal
+    type the federation layer aggregates."""
+
+    kind = "summary"
+
+    def __init__(self, name: str, help: str,
+                 labelnames: Sequence[str] = (),
+                 alpha: Optional[float] = None):
+        # resolve now so every child (and any merge peer) shares gamma
+        from bigdl_tpu.observability.sketch import default_alpha
+        self.alpha = float(alpha if alpha is not None
+                           else default_alpha())
+        super().__init__(name, help, labelnames)
+
+    def _make_child(self):
+        return _SketchChild(self.alpha)
+
+    def observe(self, value: float):
+        self._sole().observe(value)
+
+    def quantile(self, q: float) -> Optional[float]:
+        return self._sole().quantile(q)
+
+    @property
+    def count(self) -> int:
+        return self._sole().count
+
+    @property
+    def sum(self) -> float:
+        return self._sole().sum
+
+    def to_snapshot(self) -> dict:
+        return self._sole().to_snapshot()
+
+
 class MetricRegistry:
     """Declaration point + exposition surface. Declaring the same name
     twice returns the existing instrument (so module-level hot paths can
@@ -327,6 +402,12 @@ class MetricRegistry:
                     raise ValueError(
                         f"histogram {name} already declared with "
                         f"buckets {existing.buckets}")
+                want_alpha = kw.get("alpha")
+                if want_alpha is not None and \
+                        abs(existing.alpha - float(want_alpha)) > 1e-12:
+                    raise ValueError(
+                        f"sketch {name} already declared with "
+                        f"alpha {existing.alpha}")
                 return existing
             m = cls(name, help, labelnames=labelnames, **kw)
             self._metrics[name] = m
@@ -345,6 +426,11 @@ class MetricRegistry:
                   buckets: Sequence[float] = DEFAULT_BUCKETS) -> Histogram:
         return self._declare(Histogram, name, help, labelnames,
                              buckets=buckets)
+
+    def sketch(self, name: str, help: str = "",
+               labelnames: Sequence[str] = (),
+               alpha: Optional[float] = None) -> Sketch:
+        return self._declare(Sketch, name, help, labelnames, alpha=alpha)
 
     def get(self, name: str) -> Optional[_Instrument]:
         with self._lock:
@@ -369,7 +455,7 @@ class MetricRegistry:
             if m.labelnames else ()
         for k, child in m.children():
             if k == key:
-                if isinstance(child, _HistogramChild):
+                if isinstance(child, (_HistogramChild, _SketchChild)):
                     return float(child.count)
                 return child.value
         return None
@@ -397,6 +483,23 @@ def render_prometheus(registry: MetricRegistry) -> str:
                 s = _labels_suffix(m.labelnames, key)
                 lines.append(f"{m.name}_sum{s} {_format_value(total)}")
                 lines.append(f"{m.name}_count{s} {count}")
+            elif isinstance(child, _SketchChild):
+                # summary exposition: one series per quantile, exact to
+                # the sketch's relative-error bound (no bucket
+                # interpolation). Empty sketches render NaN like the
+                # stock client libraries.
+                for q in SUMMARY_QUANTILES:
+                    suffix = _labels_suffix(
+                        m.labelnames, key,
+                        extra=[("quantile", _format_value(q))])
+                    v = child.quantile(q)
+                    lines.append(
+                        f"{m.name}{suffix} "
+                        f"{_format_value(v) if v is not None else 'NaN'}")
+                s = _labels_suffix(m.labelnames, key)
+                lines.append(
+                    f"{m.name}_sum{s} {_format_value(child.sum)}")
+                lines.append(f"{m.name}_count{s} {child.count}")
             else:
                 s = _labels_suffix(m.labelnames, key)
                 lines.append(f"{m.name}{s} {_format_value(child.value)}")

@@ -54,6 +54,7 @@ SITES = (
     "worker.stall",                # hung engine decode step (ISSUE 7)
     "elastic.heartbeat",           # agent->supervisor beat (ISSUE 10)
     "elastic.step",                # elastic-guarded train step (ISSUE 10)
+    "federation.scrape",           # fleet collector member scrape (ISSUE 12)
 )
 
 

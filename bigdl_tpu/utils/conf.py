@@ -40,6 +40,22 @@ _DEFAULTS: Dict[str, str] = {
     "bigdl.observability.enabled": "true",    # metrics + trace spans
     "bigdl.observability.trace.capacity": "65536",  # span ring entries
     "bigdl.observability.exemplars": "8",     # slowest-N latency traces
+    # quantile-sketch relative-error bound (ISSUE 12): every Sketch
+    # series resolves percentiles to within this fraction, and only
+    # same-alpha sketches merge across the fleet
+    "bigdl.observability.sketch.alpha": "0.01",
+    # fleet metric federation (ISSUE 12): router/supervisor-embedded
+    # collectors scrape member /metrics/snapshot and serve the merged
+    # view. false = no collector thread, endpoints 404
+    "bigdl.observability.federation": "false",
+    "bigdl.observability.federation.interval": "2.0",  # scrape cadence (s)
+    # per-request SLO accounting (ISSUE 12): TTFT/ITL sketches +
+    # threshold classification + rolling burn rate. false = no sketch
+    # series, no bigdl_slo_* series
+    "bigdl.slo.enabled": "false",
+    "bigdl.slo.ttft_ms": "500",               # admission -> first token
+    "bigdl.slo.itl_ms": "200",                # worst inter-token gap
+    "bigdl.slo.window": "100",                # burn-rate request window
     "bigdl.reliability.enabled": "true",      # fault sites + policies
     "bigdl.reliability.retry.max.attempts": "3",   # tries, not retries
     "bigdl.reliability.retry.base.delay": "0.05",  # seconds

@@ -66,6 +66,10 @@ def pytest_configure(config):
         "analysis: static-analysis suite tests (AST passes, baseline "
         "round-trip, lockwatch witness, repo gate; select with "
         "-m analysis)")
+    config.addinivalue_line(
+        "markers",
+        "slo: fleet telemetry plane tests (quantile sketches, metric "
+        "federation, per-request SLO accounting; select with -m slo)")
 
 
 @pytest.fixture(scope="session")

@@ -62,7 +62,14 @@ class TestCifarReader:
                  .add(nn.Linear(64, 10)).add(nn.LogSoftMax()))
         opt = Optimizer(model, ds, nn.ClassNLLCriterion(), batch_size=64,
                         end_trigger=Trigger.max_epoch(12), distributed=True)
-        opt.set_optim_method(Adam(learning_rate=2e-3))
+        # 2e-4, not 2e-3: Adam's first steps are ~sign(g)*lr per
+        # weight, so at 2e-3 the 3072-wide input layer shifts hidden
+        # pre-activations by ~±6 in one step — the loss spikes to ~15,
+        # the ReLU layer dies, and training parks at the uniform
+        # ln(10)≈2.30 forever (acc 0.17, the long-standing tier-1
+        # failure). At 2e-4 the same pipeline memorizes the synthetic
+        # set to acc 1.0 in the same 12 epochs.
+        opt.set_optim_method(Adam(learning_rate=2e-4))
         opt.optimize()
         x, y = load_cifar(synthetic_size=256)
         model.evaluate()

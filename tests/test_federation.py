@@ -1,0 +1,630 @@
+"""Fleet telemetry plane (ISSUE 12): registry snapshots, the
+label-aware merge rules (counters sum, gauges gain an instance label,
+sketches merge losslessly), the background collector's stale-marking
+failure model, the member/fleet HTTP surfaces, per-request SLO
+accounting on the live engine and the failover router, and the
+disabled-mode structural-absence contract.
+
+The acceptance merge-correctness test runs TWO LIVE WORKERS through a
+federation-enabled router: the federated counter values must equal the
+per-worker snapshot sums, and the merged sketch's p99 must agree with
+a sketch built from the pooled per-worker states within the sketch's
+stated relative-error bound."""
+
+import http.client
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from bigdl_tpu import observability as obs
+from bigdl_tpu import reliability as rel
+from bigdl_tpu.observability.federation import (
+    FederationCollector, SnapshotServer, merge_snapshots,
+    registry_snapshot, render_merged)
+from bigdl_tpu.observability.metrics import MetricRegistry
+from bigdl_tpu.observability.sketch import QuantileSketch
+from bigdl_tpu.observability.slo import SLOAccount, itl_samples
+from bigdl_tpu.utils.conf import conf
+
+pytestmark = pytest.mark.slo
+
+
+def _req(addr, method, path, body=None, timeout=120):
+    conn = http.client.HTTPConnection(*addr, timeout=timeout)
+    try:
+        payload = json.dumps(body) if body is not None else None
+        conn.request(method, path, payload,
+                     {"Content-Type": "application/json"}
+                     if body is not None else {})
+        r = conn.getresponse()
+        raw = r.read()
+        try:
+            data = json.loads(raw.decode())
+        except ValueError:
+            data = raw
+        return r.status, data
+    finally:
+        conn.close()
+
+
+def _make_registry(counter=0.0, gauge=None, sketch_vals=(),
+                   hist_vals=()):
+    reg = MetricRegistry()
+    if counter:
+        reg.counter("bigdl_llm_decode_tokens_total", "t").inc(counter)
+    if gauge is not None:
+        reg.gauge("bigdl_llm_active_slots", "t").set(gauge)
+    if sketch_vals:
+        sk = reg.sketch("bigdl_router_ttft_seconds", "t", alpha=0.01)
+        for v in sketch_vals:
+            sk.observe(v)
+    if hist_vals:
+        h = reg.histogram("bigdl_llm_prefill_seconds", "t")
+        for v in hist_vals:
+            h.observe(v)
+    return reg
+
+
+# ---------------------------------------------------------------------------
+# snapshot + merge units
+# ---------------------------------------------------------------------------
+
+class TestMerge:
+    def test_counters_sum(self):
+        snaps = {
+            "a": registry_snapshot(_make_registry(counter=10)),
+            "b": registry_snapshot(_make_registry(counter=5))}
+        merged = merge_snapshots(snaps)
+        m = {d["name"]: d for d in merged["metrics"]}
+        series = m["bigdl_llm_decode_tokens_total"]["series"]
+        assert len(series) == 1 and series[0]["value"] == 15.0
+
+    def test_gauges_gain_instance_label(self):
+        snaps = {
+            "a": registry_snapshot(_make_registry(gauge=2)),
+            "b": registry_snapshot(_make_registry(gauge=3))}
+        merged = merge_snapshots(snaps)
+        m = {d["name"]: d for d in merged["metrics"]}
+        g = m["bigdl_llm_active_slots"]
+        assert g["labelnames"] == ["instance"]
+        vals = {tuple(s["labels"]): s["value"] for s in g["series"]}
+        assert vals == {("a",): 2.0, ("b",): 3.0}
+
+    def test_histograms_sum_bucketwise(self):
+        snaps = {
+            "a": registry_snapshot(_make_registry(hist_vals=(0.01,))),
+            "b": registry_snapshot(_make_registry(hist_vals=(0.02,
+                                                             5.0)))}
+        merged = merge_snapshots(snaps)
+        m = {d["name"]: d for d in merged["metrics"]}
+        s = m["bigdl_llm_prefill_seconds"]["series"][0]
+        assert s["count"] == 3
+        assert s["cum"][-1] == 3          # +Inf bucket
+        assert s["sum"] == pytest.approx(5.03)
+
+    def test_sketches_merge_losslessly(self):
+        va, vb = (0.01, 0.02, 0.5), (0.03, 0.04)
+        snaps = {
+            "a": registry_snapshot(_make_registry(sketch_vals=va)),
+            "b": registry_snapshot(_make_registry(sketch_vals=vb))}
+        merged = merge_snapshots(snaps)
+        m = {d["name"]: d for d in merged["metrics"]}
+        sk = QuantileSketch.from_snapshot(
+            m["bigdl_router_ttft_seconds"]["series"][0]["sketch"])
+        pooled = QuantileSketch(alpha=0.01)
+        for v in va + vb:
+            pooled.observe(v)
+        assert sk.count == 5
+        assert sk.to_snapshot()["buckets"] == \
+            pooled.to_snapshot()["buckets"]
+
+    def test_sketch_alpha_mismatch_falls_back_to_instance(self):
+        ra = MetricRegistry()
+        ra.sketch("bigdl_router_ttft_seconds", "t",
+                  alpha=0.01).observe(0.1)
+        rb = MetricRegistry()
+        rb.sketch("bigdl_router_ttft_seconds", "t",
+                  alpha=0.05).observe(0.2)
+        merged = merge_snapshots({"a": registry_snapshot(ra),
+                                  "b": registry_snapshot(rb)})
+        m = {d["name"]: d for d in merged["metrics"]}
+        series = m["bigdl_router_ttft_seconds"]["series"]
+        # both survive: one plain, one instance-tagged passthrough
+        assert len(series) == 2
+        total = sum(QuantileSketch.from_snapshot(s["sketch"]).count
+                    for s in series)
+        assert total == 2
+
+    def test_render_merged_parses(self):
+        snaps = {
+            "a": registry_snapshot(_make_registry(
+                counter=2, gauge=1, sketch_vals=(0.1, 0.2))),
+            "b": registry_snapshot(_make_registry(counter=3))}
+        text = render_merged(merge_snapshots(snaps))
+        parsed = obs.parse_prometheus(text)
+        assert parsed["bigdl_llm_decode_tokens_total"][()] == 5.0
+        assert parsed["bigdl_llm_active_slots"][
+            (("instance", "a"),)] == 1.0
+        assert parsed["bigdl_router_ttft_seconds_count"][()] == 2
+
+
+# ---------------------------------------------------------------------------
+# collector: scraping, stale marking, lifecycle
+# ---------------------------------------------------------------------------
+
+class _StubMember:
+    """Tiny member serving a fixed snapshot doc (its own registry)."""
+
+    def __init__(self, registry):
+        stub = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                if self.path != "/metrics/snapshot":
+                    self.send_response(404)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                body = json.dumps(registry_snapshot(
+                    stub.registry)).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.registry = registry
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.address = self._httpd.server_address[:2]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._thread.join(timeout=5)
+        self._httpd.server_close()
+
+
+class TestCollector:
+    def test_collects_and_merges(self):
+        a = _StubMember(_make_registry(counter=7))
+        b = _StubMember(_make_registry(counter=4))
+        col = FederationCollector(
+            lambda: [("a", a.address), ("b", b.address)],
+            interval=3600)
+        try:
+            col.collect_now()
+            merged = col.merged()
+            m = {d["name"]: d for d in merged["metrics"]}
+            assert m["bigdl_llm_decode_tokens_total"]["series"][0][
+                "value"] == 11.0
+            st = col.status()
+            assert st["stale"] == 0
+            assert set(st["members"]) == {"a", "b"}
+        finally:
+            a.stop()
+            b.stop()
+
+    def test_dead_member_goes_stale_not_fatal(self):
+        a = _StubMember(_make_registry(counter=7))
+        col = FederationCollector(
+            lambda: [("a", a.address)], interval=3600)
+        try:
+            col.collect_now()
+            assert col.status()["members"]["a"]["stale"] is False
+            a.stop()
+            col.collect_now()          # scrape fails: stale, not raise
+            st = col.status()["members"]["a"]
+            assert st["stale"] is True and st["failures"] >= 1
+            # last-known snapshot keeps serving
+            m = {d["name"]: d for d in col.merged()["metrics"]}
+            assert m["bigdl_llm_decode_tokens_total"]["series"][0][
+                "value"] == 7.0
+        finally:
+            try:
+                a.stop()
+            except Exception:
+                pass
+
+    def test_scrape_fault_site_marks_stale(self, ):
+        was = rel.enabled()
+        if not was:
+            rel.enable()
+        a = _StubMember(_make_registry(counter=7))
+        col = FederationCollector(
+            lambda: [("a", a.address)], interval=3600)
+        try:
+            plan = rel.FaultPlan(seed=0)
+            plan.add("federation.scrape", "raise", times=1)
+            rel.set_plan(plan)
+            col.collect_now()
+            assert col.status()["members"]["a"]["stale"] is True
+            rel.set_plan(None)
+            col.collect_now()          # recovery on the next sweep
+            assert col.status()["members"]["a"]["stale"] is False
+        finally:
+            rel.set_plan(None)
+            if not was:
+                rel.disable()
+            a.stop()
+
+    def test_departed_member_dropped(self):
+        a = _StubMember(_make_registry(counter=7))
+        targets = [("a", a.address)]
+        col = FederationCollector(lambda: list(targets), interval=3600)
+        try:
+            col.collect_now()
+            assert "a" in col.status()["members"]
+            targets.clear()
+            col.collect_now()
+            assert col.status()["members"] == {}
+        finally:
+            a.stop()
+
+    def test_thread_lifecycle(self):
+        col = FederationCollector(lambda: [], interval=3600)
+        col.start()
+        assert any(t.name == FederationCollector.THREAD_NAME
+                   for t in threading.enumerate())
+        col.stop()
+        assert not any(t.name == FederationCollector.THREAD_NAME
+                       for t in threading.enumerate())
+
+    def test_snapshot_server(self):
+        srv = SnapshotServer(instance="pidX").start()
+        try:
+            st, doc = _req(srv.address, "GET", "/metrics/snapshot")
+            assert st == 200 and doc["instance"] == "pidX"
+            st, _ = _req(srv.address, "GET", "/nope")
+            assert st == 404
+        finally:
+            srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# SLO accounting units
+# ---------------------------------------------------------------------------
+
+class TestSLOAccount:
+    def test_if_enabled_gate(self):
+        assert SLOAccount.if_enabled("engine") is None    # default off
+        acct = SLOAccount.if_enabled("engine", enabled=True)
+        assert acct is not None and acct.scope == "engine"
+
+    def test_classification_and_burn_rate(self):
+        acct = SLOAccount("router", ttft_ms=100.0, itl_ms=50.0,
+                          window=4)
+        before = {
+            (v, s): obs.REGISTRY.sample_value(
+                "bigdl_slo_requests_total", slo=s, verdict=v,
+                scope="router") or 0.0
+            for v in ("ok", "violated") for s in ("ttft", "itl")}
+        acct.finish(0.05, 0.01)     # both ok
+        acct.finish(0.25, 0.01)     # ttft violated
+        acct.finish(0.05, 0.30)     # itl violated
+        acct.finish(None, None)     # no token ever: ttft violated,
+        #                             itl vacuously ok
+
+        def delta(v, s):
+            return (obs.REGISTRY.sample_value(
+                "bigdl_slo_requests_total", slo=s, verdict=v,
+                scope="router") or 0.0) - before[(v, s)]
+
+        assert delta("ok", "ttft") == 2 and delta("violated",
+                                                  "ttft") == 2
+        assert delta("ok", "itl") == 3 and delta("violated",
+                                                 "itl") == 1
+        assert acct.burn_rates() == {"ttft": 0.5, "itl": 0.25}
+        st = acct.status()
+        assert st["requests"] == 4
+        assert st["violations"] == {"ttft": 2, "itl": 1}
+
+    def test_window_rolls(self):
+        acct = SLOAccount("engine", ttft_ms=100.0, itl_ms=50.0,
+                          window=2)
+        acct.finish(1.0, None)      # violated
+        acct.finish(0.01, None)     # ok
+        acct.finish(0.01, None)     # ok — the violation rolled out
+        assert acct.burn_rates()["ttft"] == 0.0
+
+    def test_itl_samples_helper(self):
+        assert itl_samples([1.0, 1.5, 1.6]) == \
+            pytest.approx([0.5, 0.1])
+        assert itl_samples([2.0]) == []
+
+
+# ---------------------------------------------------------------------------
+# live engine + router (the tentpole surfaces)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def model():
+    from bigdl_tpu.llm.models.llama import LlamaConfig, LlamaForCausalLM
+    return LlamaForCausalLM.from_config(LlamaConfig.tiny(), seed=0,
+                                        max_cache_len=128)
+
+
+class TestEngineSLO:
+    def test_engine_records_and_classifies(self, model):
+        from bigdl_tpu.llm.serving import LLMServer
+        before_ttft = obs.REGISTRY.sample_value(
+            "bigdl_llm_ttft_seconds") or 0
+        before_itl = obs.REGISTRY.sample_value(
+            "bigdl_llm_itl_seconds") or 0
+        srv = LLMServer(model, max_batch=2, max_seq_len=64, page_size=8,
+                        slo=True).start()
+        try:
+            rs = np.random.RandomState(0)
+            p = rs.randint(0, 250, 8).astype(np.int32)
+            toks = srv.submit(p, max_new_tokens=4).get(timeout=600)
+        finally:
+            srv.stop()
+        assert (obs.REGISTRY.sample_value("bigdl_llm_ttft_seconds")
+                - before_ttft) == 1
+        assert (obs.REGISTRY.sample_value("bigdl_llm_itl_seconds")
+                - before_itl) == len(toks) - 1
+        st = srv._slo.status()
+        assert st["requests"] == 1 and st["scope"] == "engine"
+
+    def test_disabled_engine_structurally_absent(self, model):
+        from bigdl_tpu.llm.serving import LLMServer
+        srv = LLMServer(model, max_batch=2, max_seq_len=64,
+                        page_size=8).start()
+        try:
+            assert srv._slo is None
+            before = set(obs.render().splitlines())
+            rs = np.random.RandomState(0)
+            p = rs.randint(0, 250, 8).astype(np.int32)
+            srv.submit(p, max_new_tokens=2).get(timeout=600)
+            new = "\n".join(set(obs.render().splitlines()) - before)
+            for name in ("bigdl_llm_ttft_seconds",
+                         "bigdl_llm_itl_seconds",
+                         "bigdl_slo_requests_total",
+                         "bigdl_slo_burn_rate"):
+                assert name not in new
+        finally:
+            srv.stop()
+
+
+class TestLiveFleet:
+    """The acceptance criterion: two live workers served through the
+    router — federated counters equal the per-worker sums, merged
+    sketch p99 within the stated relative-error bound of the pooled
+    state."""
+
+    def test_merge_correctness_two_live_workers(self, model):
+        from bigdl_tpu.llm.serving import LLMServer
+        from bigdl_tpu.llm.worker import LLMRouter, LLMWorker
+        s1 = LLMServer(model, max_batch=2, max_seq_len=64, page_size=8,
+                       slo=True).start()
+        s2 = LLMServer(model, max_batch=2, max_seq_len=64, page_size=8,
+                       slo=True).start()
+        w1 = LLMWorker(s1, role="decode", federation=True).start()
+        w2 = LLMWorker(s2, role="decode", federation=True).start()
+        router = LLMRouter([], [w1.address, w2.address], failover=True,
+                           slo=True, federation=True,
+                           start_prober=False).start()
+        try:
+            rs = np.random.RandomState(0)
+            base_ttft = obs.REGISTRY.sample_value(
+                "bigdl_router_ttft_seconds") or 0
+            base_itl = obs.REGISTRY.sample_value(
+                "bigdl_router_itl_seconds") or 0
+            total_toks = 0
+            for j in range(4):
+                p = rs.randint(0, 250, 8 + 2 * j).astype(np.int32)
+                st, body = _req(router.address, "POST",
+                                "/worker_generate",
+                                {"prompt_ids": [int(t) for t in p],
+                                 "max_new_tokens": 3})
+                assert st == 200, body
+                total_toks += len(body["output_ids"])
+            # router-side SLO sketches: one TTFT sample per request,
+            # tokens-1 ITL samples per request
+            assert (obs.REGISTRY.sample_value(
+                "bigdl_router_ttft_seconds") - base_ttft) == 4
+            assert (obs.REGISTRY.sample_value(
+                "bigdl_router_itl_seconds") - base_itl) == \
+                total_toks - 4
+
+            # member snapshots straight off each worker
+            st1, snap1 = _req(w1.address, "GET", "/metrics/snapshot")
+            st2, snap2 = _req(w2.address, "GET", "/metrics/snapshot")
+            assert st1 == 200 and st2 == 200
+
+            # federated counters == per-worker sums (exactly)
+            merged = merge_snapshots({"w1": snap1, "w2": snap2})
+            m = {d["name"]: d for d in merged["metrics"]}
+            for name in ("bigdl_llm_decode_tokens_total",
+                         "bigdl_llm_prefill_tokens_total"):
+                per = []
+                for snap in (snap1, snap2):
+                    for d in snap["metrics"]:
+                        if d["name"] == name:
+                            per.append(sum(s["value"]
+                                           for s in d["series"]))
+                fed = sum(s["value"] for s in m[name]["series"])
+                assert fed == pytest.approx(sum(per)), name
+
+            # merged sketch p99 vs the sketch of the pooled state:
+            # within the stated relative-error bound
+            def member_sketch(snap, name):
+                for d in snap["metrics"]:
+                    if d["name"] == name:
+                        return d["series"][0]["sketch"]
+                return None
+            snaps = [member_sketch(s, "bigdl_router_ttft_seconds")
+                     for s in (snap1, snap2)]
+            snaps = [s for s in snaps if s]
+            pooled = QuantileSketch.merge_snapshots(snaps)
+            fed_sk = QuantileSketch.from_snapshot(
+                member_sketch(merged, "bigdl_router_ttft_seconds"))
+            alpha = pooled.alpha
+            p99_fed, p99_pooled = (fed_sk.quantile(0.99),
+                                   pooled.quantile(0.99))
+            assert abs(p99_fed - p99_pooled) <= \
+                2 * alpha * max(p99_pooled, 1e-12)
+
+            # fleet surfaces: collector sweep -> /fleet/status +
+            # merged /metrics
+            router._collector.collect_now()
+            st, status = _req(router.address, "GET", "/fleet/status")
+            assert st == 200
+            assert set(status["members"]) == {
+                f"{w1.address[0]}:{w1.address[1]}",
+                f"{w2.address[0]}:{w2.address[1]}"}
+            assert status["stale"] == 0
+            st, text = _req(router.address, "GET", "/metrics")
+            assert st == 200
+            parsed = obs.parse_prometheus(text.decode())
+            # three copies of the shared in-process registry (w1, w2,
+            # router self): the federated counter triples the local one
+            local = obs.REGISTRY.sample_value(
+                "bigdl_llm_decode_tokens_total")
+            assert parsed["bigdl_llm_decode_tokens_total"][()] == \
+                pytest.approx(3 * local)
+            # healthz carries the burn-rate block
+            st, hz = _req(router.address, "GET", "/healthz")
+            assert "slo" in hz and "burn_rate" in hz["slo"]
+            st, hz = _req(w1.address, "GET", "/healthz")
+            assert "slo" in hz and hz["slo"]["scope"] == "engine"
+        finally:
+            router.stop()
+            w1.stop()
+            w2.stop()
+            s1.stop()
+            s2.stop()
+
+    def test_disabled_mode_structural_absence(self, model):
+        from bigdl_tpu.llm.serving import LLMServer
+        from bigdl_tpu.llm.worker import LLMRouter, LLMWorker
+        srv = LLMServer(model, max_batch=2, max_seq_len=64,
+                        page_size=8).start()
+        w = LLMWorker(srv, role="decode").start()
+        router = LLMRouter([], [w.address],
+                           start_prober=False).start()
+        try:
+            assert router._collector is None and router._slo is None
+            assert srv._slo is None
+            st, _ = _req(w.address, "GET", "/metrics/snapshot")
+            assert st == 404
+            st, _ = _req(router.address, "GET", "/fleet/status")
+            assert st == 404
+            assert not any(
+                t.name == FederationCollector.THREAD_NAME
+                for t in threading.enumerate())
+            # router /metrics stays the plain process registry
+            st, text = _req(router.address, "GET", "/metrics")
+            assert st == 200
+        finally:
+            router.stop()
+            w.stop()
+            srv.stop()
+
+
+class TestElasticFederation:
+    def test_supervisor_collects_agent_snapshots(self):
+        from bigdl_tpu.elastic.agent import ElasticAgent
+        from bigdl_tpu.elastic.supervisor import Supervisor
+        conf.set("bigdl.observability.federation", "true")
+        try:
+            sup = Supervisor(expected=2).start()
+            a1 = ElasticAgent(0, supervisor_address=sup.address).start()
+            a2 = ElasticAgent(1, supervisor_address=sup.address).start()
+            try:
+                assert a1._metrics_server is not None
+                a1.step_heartbeat(1)
+                a2.step_heartbeat(2)
+                a1.beat()
+                a2.beat()
+                sup._collector.collect_now()
+                st, status = _req(sup.address, "GET", "/fleet/status")
+                assert st == 200
+                assert set(status["members"]) == {"pid0", "pid1"}
+                st, text = _req(sup.address, "GET", "/metrics")
+                assert st == 200
+                assert b"bigdl_elastic_heartbeats_total" in text
+            finally:
+                a1.stop()
+                a2.stop()
+                sup.stop()
+        finally:
+            conf.unset("bigdl.observability.federation")
+
+    def test_malformed_metrics_addr_is_422_and_unrecorded(self):
+        from bigdl_tpu.elastic.supervisor import Supervisor
+        sup = Supervisor(expected=1).start()
+        try:
+            st, body = _req(sup.address, "POST", "/elastic/heartbeat",
+                            {"pid": 0, "metrics_addr": []})
+            assert st == 422, body
+            # the bad beat mutated nothing: the peer never registered
+            assert sup.live_peers() == 0
+            st, _ = _req(sup.address, "POST", "/elastic/heartbeat",
+                         {"pid": 0,
+                          "metrics_addr": ["127.0.0.1", "80"]})
+            assert st == 200
+        finally:
+            sup.stop()
+
+    def test_fleet_status_carries_member_addresses(self):
+        """fleet_report --url re-fetches member snapshots from the
+        advertised address — elastic members are named pidN, so the
+        name alone is not a scrape target."""
+        a = _StubMember(_make_registry(counter=1))
+        col = FederationCollector(lambda: [("pid0", a.address)],
+                                  interval=3600)
+        try:
+            col.collect_now()
+            member = col.status()["members"]["pid0"]
+            assert member["address"] == [a.address[0], a.address[1]]
+        finally:
+            a.stop()
+
+    def test_disabled_supervisor_absent(self):
+        from bigdl_tpu.elastic.agent import ElasticAgent
+        from bigdl_tpu.elastic.supervisor import Supervisor
+        sup = Supervisor(expected=1).start()
+        agent = ElasticAgent(0, supervisor_address=sup.address).start()
+        try:
+            assert sup._collector is None
+            assert agent._metrics_server is None
+            st, _ = _req(sup.address, "GET", "/fleet/status")
+            assert st == 404
+            st, _ = _req(sup.address, "GET", "/metrics")
+            assert st == 404
+        finally:
+            agent.stop()
+            sup.stop()
+
+
+class TestJournalTimestamps:
+    def test_resumed_tokens_stamped_once(self):
+        from bigdl_tpu.llm.failover import RequestJournal
+        j = RequestJournal()
+        ent = j.add([1, 2, 3], 6)
+        ent.drained([10], 0)
+        ent.drained([10, 11], 0)
+        t2 = list(ent.token_times)
+        # the failover resume: a new attempt REPLAYS the prefix
+        # cumulatively from its base — stamps must not change
+        ent.drained([12], 2)
+        ent.drained([12, 13], 2)
+        assert ent.tokens == [10, 11, 12, 13]
+        assert len(ent.token_times) == 4
+        assert ent.token_times[:2] == t2
+        # a hedge-twin echo behind the winner is a no-op
+        times = list(ent.token_times)
+        ent.drained([12], 2)
+        assert ent.token_times == times
+        assert len(itl_samples(ent.token_times)) == 3

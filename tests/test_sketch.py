@@ -1,0 +1,189 @@
+"""Mergeable quantile sketch (ISSUE 12 tentpole layer 1): the DDSketch
+math (relative-error bound at every quantile), lossless merge vs the
+pooled-sample sketch, snapshot round-trip, the `Sketch` registry
+instrument (summary exposition, label children, re-declaration rules),
+and the disabled-mode no-op contract."""
+
+import json
+import math
+
+import numpy as np
+import pytest
+
+from bigdl_tpu import observability as obs
+from bigdl_tpu.observability.metrics import MetricRegistry
+from bigdl_tpu.observability.sketch import QuantileSketch
+
+pytestmark = pytest.mark.slo
+
+
+def _exact_quantile(vals, q):
+    s = sorted(vals)
+    return s[max(int(math.ceil(q * len(s))) - 1, 0)]
+
+
+class TestQuantileSketch:
+    def test_relative_error_bound(self):
+        rs = np.random.RandomState(0)
+        # latencies spanning five orders of magnitude: µs stalls to
+        # minute-long prefills in one sketch
+        vals = np.concatenate([
+            rs.lognormal(mean=-8, sigma=1.0, size=2000),
+            rs.lognormal(mean=-2, sigma=1.5, size=2000),
+            rs.uniform(10.0, 100.0, size=500)])
+        sk = QuantileSketch(alpha=0.01)
+        for v in vals:
+            sk.observe(v)
+        for q in (0.01, 0.1, 0.5, 0.9, 0.95, 0.99, 0.999):
+            exact = _exact_quantile(vals, q)
+            est = sk.quantile(q)
+            assert abs(est - exact) / exact <= 0.0101, \
+                f"q={q}: {est} vs {exact}"
+
+    def test_count_sum_min_max(self):
+        sk = QuantileSketch(alpha=0.02)
+        for v in (0.5, 1.5, 3.0):
+            sk.observe(v)
+        assert sk.count == 3
+        assert sk.sum == pytest.approx(5.0)
+        assert sk.min == 0.5 and sk.max == 3.0
+
+    def test_empty_and_zero_bucket(self):
+        sk = QuantileSketch(alpha=0.01)
+        assert sk.quantile(0.5) is None
+        assert sk.min is None and sk.max is None
+        sk.observe(0.0)
+        sk.observe(0.0)
+        sk.observe(1.0)
+        assert sk.quantile(0.5) == 0.0          # rank 2 of 3 is a zero
+        assert sk.quantile(1.0) == pytest.approx(1.0, rel=0.0101)
+        assert sk.count == 3
+
+    def test_nan_ignored(self):
+        sk = QuantileSketch(alpha=0.01)
+        sk.observe(float("nan"))
+        assert sk.count == 0
+
+    def test_merge_is_lossless(self):
+        """The federation property: merging two shards is
+        bucket-identical to sketching the pooled samples (sum differs
+        only by float association order)."""
+        rs = np.random.RandomState(7)
+        vals = rs.lognormal(mean=-3, sigma=1.2, size=4000)
+        pooled = QuantileSketch(alpha=0.01)
+        a, b = QuantileSketch(alpha=0.01), QuantileSketch(alpha=0.01)
+        for v in vals:
+            pooled.observe(v)
+        for v in vals[:1500]:
+            a.observe(v)
+        for v in vals[1500:]:
+            b.observe(v)
+        a.merge(b)
+        sa, sp = a.to_snapshot(), pooled.to_snapshot()
+        assert sa["buckets"] == sp["buckets"]
+        assert sa["count"] == sp["count"] and sa["zero"] == sp["zero"]
+        assert sa["sum"] == pytest.approx(sp["sum"])
+        assert sa["min"] == sp["min"] and sa["max"] == sp["max"]
+        # and therefore every quantile agrees exactly
+        for q in (0.5, 0.95, 0.99):
+            assert a.quantile(q) == pooled.quantile(q)
+
+    def test_merged_p99_within_bound_of_pooled_raw(self):
+        """The acceptance-criterion form: merged p99 vs the exact p99
+        of the pooled RAW samples, within the stated alpha."""
+        rs = np.random.RandomState(3)
+        shard1 = rs.lognormal(mean=-4, sigma=1.0, size=3000)
+        shard2 = rs.lognormal(mean=-3, sigma=1.5, size=2000)
+        a, b = QuantileSketch(alpha=0.01), QuantileSketch(alpha=0.01)
+        for v in shard1:
+            a.observe(v)
+        for v in shard2:
+            b.observe(v)
+        merged = QuantileSketch.merge_snapshots(
+            [a.to_snapshot(), b.to_snapshot()])
+        pooled = np.concatenate([shard1, shard2])
+        for q in (0.5, 0.95, 0.99):
+            exact = _exact_quantile(pooled, q)
+            assert abs(merged.quantile(q) - exact) / exact <= 0.0101
+
+    def test_merge_gamma_mismatch_raises(self):
+        a, b = QuantileSketch(alpha=0.01), QuantileSketch(alpha=0.05)
+        with pytest.raises(ValueError, match="gamma"):
+            a.merge(b)
+
+    def test_snapshot_roundtrip_through_json(self):
+        sk = QuantileSketch(alpha=0.01)
+        for v in (0.0, 1e-4, 0.5, 2.0, 300.0):
+            sk.observe(v)
+        wire = json.dumps(sk.to_snapshot())
+        back = QuantileSketch.from_snapshot(json.loads(wire))
+        assert back.to_snapshot() == sk.to_snapshot()
+        assert back.quantile(0.5) == sk.quantile(0.5)
+
+    def test_merge_snapshots_empty(self):
+        assert QuantileSketch.merge_snapshots([]) is None
+
+
+class TestSketchInstrument:
+    def test_registry_declaration_and_render(self):
+        reg = MetricRegistry()
+        sk = reg.sketch("bigdl_test_latency_seconds", "test sketch")
+        for v in (0.01, 0.02, 0.04):
+            sk.observe(v)
+        from bigdl_tpu.observability.metrics import render_prometheus
+        text = render_prometheus(reg)
+        assert "# TYPE bigdl_test_latency_seconds summary" in text
+        assert 'bigdl_test_latency_seconds{quantile="0.99"}' in text
+        assert "bigdl_test_latency_seconds_count 3" in text
+        parsed = obs.parse_prometheus(text)
+        assert parsed["bigdl_test_latency_seconds_count"][()] == 3
+        p50 = parsed["bigdl_test_latency_seconds"][
+            (("quantile", "0.5"),)]
+        assert p50 == pytest.approx(0.02, rel=0.0101)
+
+    def test_labeled_children(self):
+        reg = MetricRegistry()
+        sk = reg.sketch("bigdl_test_latency_seconds", "t",
+                        labelnames=("stage",))
+        sk.labels(stage="prefill").observe(0.1)
+        sk.labels(stage="decode").observe(0.2)
+        assert reg.sample_value("bigdl_test_latency_seconds",
+                                stage="prefill") == 1
+
+    def test_redeclare_same_returns_existing(self):
+        reg = MetricRegistry()
+        a = reg.sketch("bigdl_test_latency_seconds", "t")
+        b = reg.sketch("bigdl_test_latency_seconds", "t")
+        assert a is b
+
+    def test_redeclare_alpha_mismatch_raises(self):
+        reg = MetricRegistry()
+        reg.sketch("bigdl_test_latency_seconds", "t", alpha=0.01)
+        with pytest.raises(ValueError, match="alpha"):
+            reg.sketch("bigdl_test_latency_seconds", "t", alpha=0.05)
+
+    def test_redeclare_other_kind_raises(self):
+        reg = MetricRegistry()
+        reg.counter("bigdl_test_latency_seconds", "t")
+        with pytest.raises(ValueError, match="already declared"):
+            reg.sketch("bigdl_test_latency_seconds", "t")
+
+    def test_disabled_mode_noop(self):
+        reg = MetricRegistry()
+        sk = reg.sketch("bigdl_test_latency_seconds", "t")
+        sk.observe(1.0)
+        assert sk.count == 1
+        obs.disable()
+        try:
+            sk.observe(2.0)
+            assert sk.count == 1    # nothing recorded
+        finally:
+            obs.enable()
+
+    def test_empty_sketch_renders_nan(self):
+        reg = MetricRegistry()
+        reg.sketch("bigdl_test_latency_seconds", "t")
+        from bigdl_tpu.observability.metrics import render_prometheus
+        text = render_prometheus(reg)
+        assert 'bigdl_test_latency_seconds{quantile="0.5"} NaN' in text
+        assert "bigdl_test_latency_seconds_count 0" in text

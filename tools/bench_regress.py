@@ -179,6 +179,18 @@ def _flatten_full(rec: dict) -> Dict[str, float]:
         val = rb.get(field)
         if isinstance(val, (int, float)) and not isinstance(val, bool):
             flat[f"ragged.{field}"] = float(val)
+    # ISSUE 12: the fleet telemetry plane's merged sketch percentiles —
+    # client-visible tail latency through the federated router. A
+    # regression in p99 TTFT or inter-token latency between rounds is
+    # exactly the number the serving PRs are judged on, so it diffs
+    # like any throughput metric (±10% warn, same alias machinery)
+    fb = (((rec.get("extra") or {}).get("telemetry") or {})
+          .get("fleet") or {})
+    for field in ("ttft_p50_ms", "ttft_p95_ms", "ttft_p99_ms",
+                  "itl_p99_ms"):
+        val = fb.get(field)
+        if isinstance(val, (int, float)) and not isinstance(val, bool):
+            flat[f"fleet.{field}"] = float(val)
     return flat
 
 

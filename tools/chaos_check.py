@@ -350,12 +350,25 @@ def run_failover_chaos(seed: int = 0, n_requests: int = 4,
             for name in ("bigdl_router_failovers_total",
                          "bigdl_router_hedges_total",
                          "bigdl_router_journal_inflight",
-                         "bigdl_router_backend_healthy"):
+                         "bigdl_router_backend_healthy",
+                         # ISSUE 12: SLO sketches and classification
+                         # series must be structurally absent too
+                         "bigdl_llm_ttft_seconds",
+                         "bigdl_llm_itl_seconds",
+                         "bigdl_router_ttft_seconds",
+                         "bigdl_router_itl_seconds",
+                         "bigdl_slo_requests_total",
+                         "bigdl_slo_burn_rate"):
                 assert name not in new, \
                     f"disabled mode grew metric series {name}"
+        assert s0._slo is None and r0._slo is None, \
+            "disabled mode built an SLO account"
+        assert r0._collector is None, \
+            "disabled mode built a federation collector"
         assert not [t for t in threading.enumerate()
-                    if t.name == "bigdl-router-prober"], \
-            "disabled mode started a prober thread"
+                    if t.name in ("bigdl-router-prober",
+                                  "bigdl-federation-collector")], \
+            "disabled mode started a prober/collector thread"
     finally:
         r0.stop()
         w0.stop()
@@ -367,14 +380,34 @@ def run_failover_chaos(seed: int = 0, n_requests: int = 4,
         rel.enable()
     # watchdog above the warmed per-step time but under the stall; the
     # engines are warmed below so compiles don't masquerade as stalls
+    # SLO accounting rides the storm (ISSUE 12): the counters and the
+    # router's token-arrival sketches must survive mid-stream failover
+    # with resumed tokens counted exactly once
     s1 = LLMServer(model, max_batch=2, max_seq_len=64, page_size=8,
-                   kvcache=True, watchdog_timeout=0.6).start()
+                   kvcache=True, watchdog_timeout=0.6, slo=True).start()
     s2 = LLMServer(model, max_batch=2, max_seq_len=64, page_size=8,
-                   kvcache=True, watchdog_timeout=0.6).start()
+                   kvcache=True, watchdog_timeout=0.6, slo=True).start()
     w1 = LLMWorker(s1, role="decode").start()
     w2 = LLMWorker(s2, role="decode").start()
     router = LLMRouter([], [w1.address, w2.address], failover=True,
-                       failover_attempts=8, start_prober=False).start()
+                       failover_attempts=8, start_prober=False,
+                       slo=True).start()
+    # sketch/counter state BEFORE the storm: the registry is process-
+    # global (bench's chaos_all runs several suites), so every SLO
+    # assertion below is on the delta
+    def _slo_counts():
+        if not obs.enabled():
+            return None
+        reg = obs.REGISTRY
+        classified = sum(
+            reg.sample_value("bigdl_slo_requests_total", slo="ttft",
+                             verdict=v, scope="router") or 0.0
+            for v in ("ok", "violated"))
+        return {
+            "ttft": reg.sample_value("bigdl_router_ttft_seconds") or 0.0,
+            "itl": reg.sample_value("bigdl_router_itl_seconds") or 0.0,
+            "classified": classified}
+    slo_before = _slo_counts()
     try:
         # warm EVERY shape the storm will hit on both engines: the
         # first submit compiles the full prefill + decode steps, the
@@ -449,6 +482,33 @@ def run_failover_chaos(seed: int = 0, n_requests: int = 4,
             raise AssertionError(
                 f"failover chaos divergence (fired: "
                 f"{out['events_fired']}): {got} vs {want}")
+        # ISSUE 12: SLO accounting survived the storm. Each of the
+        # n_requests classified exactly once; the router's ITL sketch
+        # holds exactly (tokens - 1) samples per request — a resume
+        # that double-stamped its replayed prefix would inflate this,
+        # a resume that dropped stamps would deflate it.
+        slo_after = _slo_counts()
+        if slo_after is not None:
+            ttft_n = slo_after["ttft"] - slo_before["ttft"]
+            itl_n = slo_after["itl"] - slo_before["itl"]
+            cls_n = slo_after["classified"] - slo_before["classified"]
+            want_itl = sum(len(w) - 1 for w in want)
+            out["slo_ttft_samples"] = ttft_n
+            out["slo_itl_samples"] = itl_n
+            if ttft_n != len(want):
+                raise AssertionError(
+                    f"SLO ttft sketch holds {ttft_n} samples for "
+                    f"{len(want)} requests — failover double- or "
+                    "under-counted first tokens")
+            if itl_n != want_itl:
+                raise AssertionError(
+                    f"SLO itl sketch holds {itl_n} samples, expected "
+                    f"{want_itl} (tokens-1 per request): resumed "
+                    "tokens were not counted exactly once")
+            if cls_n != len(want):
+                raise AssertionError(
+                    f"bigdl_slo_requests_total classified {cls_n} "
+                    f"requests, expected {len(want)}")
         return out
     finally:
         router.stop()

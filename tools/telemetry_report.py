@@ -16,6 +16,15 @@ CLI:
     python tools/telemetry_report.py --json trace.json   # machine output
     python tools/telemetry_report.py --trace <id> trace.json  # one
         request's spans only (distributed-trace filter, ISSUE 3)
+    python tools/telemetry_report.py --fleet snapA.json snapB.json
+        # percentile tables from /metrics/snapshot docs — sketch
+        # series resolve to EXACT sketch quantiles (ISSUE 12), not
+        # bucket interpolation
+
+Quantile sources (ISSUE 12): where a metric is backed by a quantile
+sketch, every percentile this tool prints is the sketch's own value
+(bounded relative error, mergeable across workers); bucket-boundary
+interpolation remains only for plain fixed-bucket histograms.
 """
 
 from __future__ import annotations
@@ -25,6 +34,11 @@ import math
 import os
 import sys
 from typing import Dict, List, Optional
+
+# runnable both as `python tools/telemetry_report.py` and as an import:
+# the script dir is on sys.path then, the package root is not
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 
 
 def _pct(sorted_vals: List[float], q: float) -> Optional[float]:
@@ -102,9 +116,12 @@ def summarize_trace(path_or_doc, trace_id: Optional[str] = None) -> dict:
 def summarize_registry(registry=None) -> dict:
     """Compact snapshot of the live metric registry (every counter/gauge
     value, histogram count/mean/p50/p99) — the block ``bench.py`` embeds
-    in its output record."""
+    in its output record. Sketch-backed series report EXACT sketch
+    quantiles (bounded relative error, ISSUE 12) instead of the
+    histogram bucket interpolation."""
     from bigdl_tpu import observability as obs
-    from bigdl_tpu.observability.metrics import _HistogramChild
+    from bigdl_tpu.observability.metrics import (_HistogramChild,
+                                                 _SketchChild)
     registry = registry or obs.REGISTRY
     out: Dict[str, object] = {}
     for m in registry.collect():
@@ -118,11 +135,30 @@ def summarize_registry(registry=None) -> dict:
                     "mean": (total / count) if count else None,
                     "p50": child.percentile(0.5),
                     "p99": child.percentile(0.99)}
+            elif isinstance(child, _SketchChild):
+                count = child.count
+                series[label or "_"] = {
+                    "count": count,
+                    "mean": (child.sum / count) if count else None,
+                    "p50": child.quantile(0.5),
+                    "p95": child.quantile(0.95),
+                    "p99": child.quantile(0.99),
+                    "sketch": True}
             else:
                 series[label or "_"] = child.value
         if series:
             out[m.name] = series if m.labelnames else series["_"]
     return out
+
+
+def summarize_fleet(paths: List[str]) -> dict:
+    """Percentile tables from saved ``/metrics/snapshot`` documents
+    (ISSUE 12): per-instance and merged sketch quantiles, exact to the
+    sketch's relative-error bound. Loading and row construction are
+    fleet_report's — one column mapping, not two."""
+    from tools.fleet_report import load_snapshots, sketch_dicts
+    return {"kind": "fleet", "paths": list(paths),
+            "sketches": sketch_dicts(load_snapshots(paths))}
 
 
 def _fmt(v) -> str:
@@ -194,6 +230,27 @@ def main(argv: List[str]) -> int:
     paths = [a for i, a in enumerate(argv)
              if not a.startswith("--")
              and (i == 0 or argv[i - 1] != "--trace")]
+    if "--fleet" in argv:
+        if not paths:
+            print("--fleet needs /metrics/snapshot JSON files",
+                  file=sys.stderr)
+            return 2
+        for p in paths:
+            if not os.path.exists(p):
+                print(f"no such file: {p}", file=sys.stderr)
+                return 1
+        summary = summarize_fleet(paths)
+        if as_json:
+            print(json.dumps(summary))
+        else:
+            _print_table(
+                "fleet sketch percentiles (ms, exact sketch quantiles)",
+                ["instance", "series", "n", "p50", "p90", "p95", "p99",
+                 "max"],
+                [[s["instance"], s["series"], s["count"], s["p50_ms"],
+                  s["p90_ms"], s["p95_ms"], s["p99_ms"], s["max_ms"]]
+                 for s in summary["sketches"]])
+        return 0
     if not paths:
         print(__doc__)
         return 2
