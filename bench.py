@@ -1006,6 +1006,11 @@ def _static_analysis_block() -> dict:
     root = os.path.dirname(os.path.abspath(__file__))
     sa = static_check(root)
     block = {"ok": sa["ok"], "by_rule": sa["by_rule"],
+             # per-pass finding counts (ISSUE 13): bench_regress diffs
+             # these so a finding-count regression in any one pass
+             # (donation/gatecheck/httpdrift included) is a visible
+             # delta in PROGRESS.jsonl, not a buried by_rule reshuffle
+             "by_pass": sa.get("by_pass", {}),
              "new": len(sa["new"]), "suppressed": sa["suppressed"],
              "stale_baseline": len(sa["stale_baseline"]),
              "baseline_errors": len(sa["baseline_errors"])}

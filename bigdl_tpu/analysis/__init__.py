@@ -1,9 +1,9 @@
-"""Repo-native static-analysis suite (ISSUE 11).
+"""Repo-native static-analysis suite (ISSUE 11 + ISSUE 13).
 
-Three AST passes over ``bigdl_tpu/`` (stdlib ``ast`` only — the
-analyzed code is never imported or executed; ``tools/check_static.py``
-loads this package standalone via its relative imports, so the CLI
-gate runs without jax):
+Six AST passes over ``bigdl_tpu/`` (stdlib ``ast`` only — the analyzed
+code is never imported or executed; ``tools/check_static.py`` loads
+this package standalone via its relative imports, so the CLI gate runs
+without jax):
 
 - **concurrency** — lock-order cycles, unlocked cross-thread writes,
   threads with no join path, bare ``acquire()`` (``concurrency.py``);
@@ -12,12 +12,25 @@ gate runs without jax):
   step loop (``hotpath.py``);
 - **registry** — conf keys / metric series / span names / fault sites /
   pytest markers must resolve to the declared registries and appear in
-  docs (``registrydrift.py`` + ``registries.py``).
+  docs (``registrydrift.py`` + ``registries.py``);
+- **donation** — buffer-lifetime rules over the def-use dataflow layer:
+  use-after-donate (incl. callees and loop back-edges), aliased donated
+  argument positions, unfenced partial drains of pipelined dispatch
+  results (``donation.py``, ISSUE 13);
+- **gatecheck** — feature-gate discipline: default-off, no import-time
+  side effects in gated packages, gate-guarded construction, a
+  disabled-mode absence test per gate (``gatecheck.py``, ISSUE 13);
+- **httpdrift** — served routes vs client call sites vs docs vs tests
+  across the five HTTP surfaces, plus 404-when-off on gated endpoints
+  (``httpdrift.py``, ISSUE 13).
 
-Findings carry ``file:line`` + rule id; the checked-in
-``analysis/baseline.json`` suppresses triaged pre-existing findings
-(each with a required justification), so ``tools/check_static.py`` is
-a zero-new-findings CI gate from day one. The opt-in runtime witness
+All six passes share ONE parsed-AST index per run: the superset scan
+(bigdl_tpu + tools + tests + examples) is built once and filtered into
+enforcement/usage views without re-parsing (``ProjectIndex.
+from_modules``). Findings carry ``file:line`` + rule id; the checked-in
+``analysis/baseline.json`` suppresses triaged findings (each with a
+required justification), so ``tools/check_static.py`` is a
+zero-new-findings CI gate. The opt-in runtime witness
 (``bigdl.analysis.lockwatch``, ``lockwatch.py``) asserts observed lock
 orderings against the same lock names during chaos runs.
 
@@ -35,12 +48,47 @@ from .baseline import (BASELINE_RELPATH, Baseline,
                        BaselineEntry)
 from .core import Finding, ProjectIndex
 
-PASSES = ("concurrency", "hotpath", "registry")
+PASSES = ("concurrency", "hotpath", "registry", "donation", "gatecheck",
+          "httpdrift")
+
+#: rule id -> owning pass, for per-pass telemetry (bench.py) and SARIF
+#: rule metadata. Kept as a literal so the mapping is greppable.
+PASS_RULES: Dict[str, Sequence[str]] = {
+    "concurrency": ("lock-order", "unlocked-write", "thread-no-join",
+                    "bare-acquire"),
+    "hotpath": ("host-sync-item", "host-sync-transfer", "host-sync-cast",
+                "traced-branch", "compiled-self-ref"),
+    "registry": ("conf-unregistered", "conf-undocumented", "conf-dead",
+                 "metric-unregistered", "metric-undocumented",
+                 "metric-dead", "span-unregistered", "span-dead",
+                 "site-unregistered", "marker-unregistered",
+                 "registry-source-drift"),
+    "donation": ("use-after-donate", "aliased-donate", "unfenced-drain"),
+    "gatecheck": ("gate-default-on", "gate-module-side-effect",
+                  "gate-unguarded-construction", "gate-no-absence-test"),
+    "httpdrift": ("route-unregistered", "route-unserved",
+                  "http-client-unhandled", "http-route-no-client",
+                  "http-route-undocumented", "http-route-untested",
+                  "http-gated-no-404"),
+}
+
+RULE_TO_PASS: Dict[str, str] = {
+    rule: p for p, rules in PASS_RULES.items() for rule in rules}
 
 
 def build_index(root: str,
                 subdirs: Sequence[str] = ("bigdl_tpu",)) -> ProjectIndex:
     return ProjectIndex.scan(root, subdirs)
+
+
+def _superset_index(root: str) -> ProjectIndex:
+    """ONE scan serving every pass's scope: enforcement (bigdl_tpu [+
+    tools for the registry pass]) and usage (tests/examples for
+    dead-entry, absence-test and route-coverage checks). Each pass gets
+    a filtered view over the SAME parsed modules — nothing re-parses."""
+    return ProjectIndex.scan(
+        root, [d for d in ("bigdl_tpu", "tools", "tests", "examples")
+               if os.path.exists(os.path.join(root, d))])
 
 
 def run_analysis(root: str,
@@ -50,18 +98,20 @@ def run_analysis(root: str,
     every raw finding (baseline application is the caller's concern —
     see :func:`check`)."""
     usage: Optional[ProjectIndex] = None
-    if "registry" in passes:
-        # one superset scan serves all three scopes — the registry
-        # pass's usage index, its bigdl_tpu/tools enforcement subset,
-        # and (below) the bigdl_tpu-only index the other passes walk
-        usage = ProjectIndex.scan(
-            root, [d for d in ("bigdl_tpu", "tools", "tests", "examples")
-                   if os.path.exists(os.path.join(root, d))])
+    needs_usage = any(p in passes
+                      for p in ("registry", "gatecheck", "httpdrift"))
     if index is None:
+        usage = _superset_index(root)
         index = ProjectIndex.from_modules(root, {
             rel: m for rel, m in usage.modules.items()
-            if rel.startswith("bigdl_tpu")}) \
-            if usage is not None else build_index(root)
+            if rel.startswith("bigdl_tpu")})
+    elif needs_usage:
+        # an explicit (bigdl_tpu-only) index still needs the superset
+        # usage view — tests/examples feed the dead-entry, absence-test
+        # and route-coverage checks
+        usage = _superset_index(root)
+    if usage is None:
+        usage = index
     findings: List[Finding] = []
     if "concurrency" in passes:
         from .concurrency import run_concurrency_pass
@@ -76,26 +126,51 @@ def run_analysis(root: str,
             if rel.startswith(("bigdl_tpu", "tools"))})
         findings += run_registry_pass(enforce, usage_index=usage,
                                       root=root)
+    if "donation" in passes:
+        from .donation import run_donation_pass
+        findings += run_donation_pass(index)
+    if "gatecheck" in passes:
+        from .gatecheck import run_gatecheck_pass
+        findings += run_gatecheck_pass(index, usage_index=usage,
+                                       root=root)
+    if "httpdrift" in passes:
+        from .httpdrift import run_httpdrift_pass
+        findings += run_httpdrift_pass(index, usage_index=usage,
+                                       root=root)
     findings.sort(key=lambda f: (f.rule, f.file, f.line, f.key))
     return findings
 
 
 def check(root: str, baseline_path: Optional[str] = None,
-          passes: Sequence[str] = PASSES) -> dict:
+          passes: Sequence[str] = PASSES,
+          findings: Optional[List[Finding]] = None) -> dict:
     """The gate: run passes, apply the baseline, summarize.
 
     Returns a dict with ``ok`` (zero unbaselined findings and zero
     baseline errors), ``new``/``suppressed`` finding lists,
-    ``stale_baseline`` fingerprints and per-rule counts — the shape
-    ``tools/check_static.py`` prints and ``bench.py`` embeds in its
-    telemetry block."""
+    ``stale_baseline`` fingerprints and per-rule AND per-pass counts —
+    the shape ``tools/check_static.py`` prints and ``bench.py`` embeds
+    in its telemetry block. Pass ``findings`` (a prior
+    :func:`run_analysis` result) to summarize without re-running —
+    the CLI shares one run between the summary and the SARIF view."""
     baseline_path = baseline_path or os.path.join(root, BASELINE_RELPATH)
-    findings = run_analysis(root, passes=passes)
+    if findings is None:
+        findings = run_analysis(root, passes=passes)
     bl = Baseline.load(baseline_path)
     new, suppressed, stale = bl.split(findings)
+    # a subset run (--only/--passes) can't see other passes' findings —
+    # their baseline entries are out of scope, not stale
+    selected_rules = {r for p in passes for r in PASS_RULES.get(p, ())}
+    stale = [fp for fp in stale
+             if fp.split("::", 1)[0] in selected_rules or
+             fp.split("::", 1)[0] not in RULE_TO_PASS]
     by_rule: Dict[str, int] = {}
+    by_pass: Dict[str, int] = {p: 0 for p in passes if p in PASS_RULES}
     for f in findings:
         by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+        p = RULE_TO_PASS.get(f.rule)
+        if p in by_pass:
+            by_pass[p] += 1
     return {
         "ok": not new and not bl.errors,
         "total": len(findings),
@@ -104,10 +179,11 @@ def check(root: str, baseline_path: Optional[str] = None,
         "stale_baseline": stale,
         "baseline_errors": bl.errors,
         "by_rule": dict(sorted(by_rule.items())),
+        "by_pass": dict(sorted(by_pass.items())),
         "baseline_path": baseline_path,
     }
 
 
 __all__ = ["Finding", "ProjectIndex", "Baseline", "BaselineEntry",
-           "BASELINE_RELPATH", "PASSES", "build_index", "run_analysis",
-           "check"]
+           "BASELINE_RELPATH", "PASSES", "PASS_RULES", "RULE_TO_PASS",
+           "build_index", "run_analysis", "check"]

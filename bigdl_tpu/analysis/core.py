@@ -353,3 +353,411 @@ def iter_functions(index: ProjectIndex):
         for cinfo in mod.classes.values():
             for name, node in cinfo.methods.items():
                 yield mod, cinfo, name, node
+
+
+# ---------------------------------------------------------------------------
+# def-use dataflow layer (ISSUE 13 tentpole)
+# ---------------------------------------------------------------------------
+#
+# The donation / gate / drift passes need more than "which calls exist":
+# they ask *ordering* questions — is this name read again after that
+# call, is it reassigned before the loop's back-edge, does a callee
+# touch this ``self`` attr first thing. :class:`FunctionDataflow` answers
+# them with a linearized event stream per function: every def and use of
+# a local name or ``self.<attr>``, in (approximate) execution order,
+# plus loop extents, call-site spans, and escape-to-closure/thread
+# tracking. Branches are concatenated (a def in the ``if`` arm shadows a
+# later use in the ``else`` arm) — deliberately conservative toward
+# *fewer* findings, the same bias as :class:`CallResolver`.
+
+@dataclass
+class DfEvent:
+    """One dataflow event. ``kind`` is "def" or "use"; ``name`` is a
+    local name (``x``) or a self attribute (``self.x``)."""
+    seq: int
+    kind: str
+    name: str
+    line: int
+
+
+class FunctionDataflow:
+    """Ordered def/use events for one function body.
+
+    - ``events``    — the linearized stream;
+    - ``loops``     — (start_seq, end_seq) extents of for/while bodies;
+    - ``call_spans``— ``id(call_node) -> (start_seq, end_seq)`` so a
+      pass can ask "what happens after this call";
+    - ``calls``     — (seq, Call) in stream order;
+    - ``escapes``   — names captured by a nested def/lambda or passed
+      to a ``threading.Thread`` — their lifetime leaves this frame;
+    - ``copies``    — (seq, target, source) for simple ``x = y`` /
+      ``x = self.attr`` copies, the alias-resolution substrate.
+    """
+
+    def __init__(self, node: ast.AST):
+        self.events: List[DfEvent] = []
+        self.loops: List[Tuple[int, int]] = []
+        #: (body_start, body_end, else_start, else_end) per if/else —
+        #: events in opposite arms are mutually exclusive, never an
+        #: ordered pair
+        self.branches: List[Tuple[int, int, int, int]] = []
+        self.call_spans: Dict[int, Tuple[int, int]] = {}
+        self.calls: List[Tuple[int, ast.Call]] = []
+        self.escapes: Dict[str, int] = {}
+        self.copies: List[Tuple[int, str, str]] = []
+        for arg in _all_args(node):
+            self._emit("def", arg.arg, getattr(node, "lineno", 1))
+        self._stmts(getattr(node, "body", []))
+
+    # -- emission ------------------------------------------------------------
+    def _emit(self, kind: str, name: str, line: int):
+        self.events.append(DfEvent(len(self.events), kind, name, line))
+
+    def _name_of(self, expr: ast.AST) -> Optional[str]:
+        if isinstance(expr, ast.Name):
+            return expr.id
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name) and \
+                expr.value.id == "self":
+            return f"self.{expr.attr}"
+        return None
+
+    # -- statement walk ------------------------------------------------------
+    def _stmts(self, stmts):
+        for stmt in stmts:
+            self._stmt(stmt)
+
+    def _stmt(self, stmt):
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            self._escape_scan(stmt)
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._emit("def", stmt.name, stmt.lineno)
+            return
+        if isinstance(stmt, ast.Assign):
+            self._expr(stmt.value)
+            for tgt in stmt.targets:
+                self._target(tgt, value=stmt.value)
+            return
+        if isinstance(stmt, ast.AnnAssign):
+            if stmt.value is not None:
+                self._expr(stmt.value)
+            self._target(stmt.target, value=stmt.value)
+            return
+        if isinstance(stmt, ast.AugAssign):
+            self._expr(stmt.value)
+            name = self._name_of(stmt.target)
+            if name:
+                self._emit("use", name, stmt.lineno)
+                self._emit("def", name, stmt.lineno)
+            else:
+                self._expr(stmt.target)
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._expr(stmt.iter)
+            start = len(self.events)
+            self._target(stmt.target)
+            self._stmts(stmt.body)
+            self.loops.append((start, len(self.events)))
+            self._stmts(stmt.orelse)
+            return
+        if isinstance(stmt, ast.While):
+            start = len(self.events)
+            self._expr(stmt.test)
+            self._stmts(stmt.body)
+            self.loops.append((start, len(self.events)))
+            self._stmts(stmt.orelse)
+            return
+        if isinstance(stmt, ast.If):
+            self._expr(stmt.test)
+            b0 = len(self.events)
+            self._stmts(stmt.body)
+            b1 = len(self.events)
+            self._stmts(stmt.orelse)
+            if stmt.orelse:
+                self.branches.append((b0, b1, b1, len(self.events)))
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self._expr(item.context_expr)
+                if item.optional_vars is not None:
+                    self._target(item.optional_vars)
+            self._stmts(stmt.body)
+            return
+        if isinstance(stmt, ast.Try):
+            self._stmts(stmt.body)
+            for handler in stmt.handlers:
+                self._stmts(handler.body)
+            self._stmts(stmt.orelse)
+            self._stmts(stmt.finalbody)
+            return
+        if isinstance(stmt, (ast.Return, ast.Expr)):
+            if stmt.value is not None:
+                self._expr(stmt.value)
+            return
+        if isinstance(stmt, ast.Delete):
+            for tgt in stmt.targets:
+                name = self._name_of(tgt)
+                if name:
+                    self._emit("def", name, stmt.lineno)
+            return
+        # fallback: any expression children, in field order
+        for _, val in ast.iter_fields(stmt):
+            items = val if isinstance(val, list) else [val]
+            for item in items:
+                if isinstance(item, ast.stmt):
+                    self._stmt(item)
+                elif isinstance(item, ast.expr):
+                    self._expr(item)
+
+    def _target(self, tgt, value: Optional[ast.AST] = None):
+        if isinstance(tgt, (ast.Tuple, ast.List)):
+            for elt in tgt.elts:
+                self._target(elt)
+            return
+        if isinstance(tgt, ast.Starred):
+            self._target(tgt.value)
+            return
+        name = self._name_of(tgt)
+        if name:
+            self._emit("def", name, tgt.lineno)
+            src = self._name_of(value) if value is not None else None
+            if src:
+                self.copies.append((len(self.events) - 1, name, src))
+            return
+        if isinstance(tgt, ast.Subscript):
+            # a[i] = v reads a (and i), it does not rebind it
+            self._expr(tgt.value)
+            self._expr(tgt.slice)
+            return
+        if isinstance(tgt, ast.Attribute):
+            self._expr(tgt.value)
+
+    def _expr(self, expr):
+        if expr is None:
+            return
+        if isinstance(expr, (ast.Lambda,)):
+            self._escape_scan(expr)
+            return
+        if isinstance(expr, ast.Call):
+            start = len(self.events)
+            self._expr(expr.func)
+            for a in expr.args:
+                self._expr(a)
+            for kw in expr.keywords:
+                self._expr(kw.value)
+            self.call_spans[id(expr)] = (start, len(self.events))
+            self.calls.append((start, expr))
+            self._thread_escapes(expr)
+            return
+        name = self._name_of(expr)
+        if name is not None:
+            self._emit("use", name, expr.lineno)
+            if isinstance(expr, ast.Attribute):
+                return          # self.<attr>: don't also record `self`
+        if isinstance(expr, (ast.ListComp, ast.SetComp, ast.DictComp,
+                             ast.GeneratorExp)):
+            # comprehensions read eagerly at this point in the stream —
+            # ordinary use events, NOT escapes (no reference outlives
+            # the expression the way a stored def/lambda does)
+            self._escape_scan(expr, record_escape=False)
+            return
+        for child in ast.iter_child_nodes(expr):
+            if isinstance(child, ast.expr):
+                self._expr(child)
+            elif isinstance(child, ast.keyword):
+                self._expr(child.value)
+
+    # -- escapes -------------------------------------------------------------
+    def _escape_scan(self, node: ast.AST, record_escape: bool = True):
+        """Free names read inside a nested scope. A stored def/lambda
+        escapes this frame (it can observe the name at any later time,
+        so ordering guarantees end there — recorded in ``escapes``); a
+        comprehension reads eagerly and only contributes use events."""
+        bound: Set[str] = {a.arg for a in _all_args(node)} \
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)) else set()
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Name) and \
+                    isinstance(sub.ctx, ast.Store):
+                bound.add(sub.id)
+        for sub in ast.walk(node):
+            name = None
+            if isinstance(sub, ast.Name) and \
+                    isinstance(sub.ctx, ast.Load) and sub.id not in bound:
+                name = sub.id
+            elif isinstance(sub, ast.Attribute) and \
+                    isinstance(sub.value, ast.Name) and \
+                    sub.value.id == "self":
+                name = f"self.{sub.attr}"
+            if name is not None:
+                if record_escape:
+                    self.escapes.setdefault(name,
+                                            getattr(sub, "lineno", 0))
+                self._emit("use", name, getattr(sub, "lineno", 0))
+
+    def _thread_escapes(self, call: ast.Call):
+        f = call.func
+        if isinstance(f, ast.Attribute) and f.attr == "Thread":
+            for kw in call.keywords:
+                if kw.arg in ("target", "args", "kwargs"):
+                    for sub in ast.walk(kw.value):
+                        name = self._name_of(sub)
+                        if name:
+                            self.escapes.setdefault(name, call.lineno)
+
+    # -- queries -------------------------------------------------------------
+    def loop_containing(self, seq: int) -> Optional[Tuple[int, int]]:
+        best = None
+        for start, end in self.loops:
+            if start <= seq < end:
+                if best is None or (end - start) < (best[1] - best[0]):
+                    best = (start, end)
+        return best
+
+    def defs_in(self, name: str, start: int, end: int) -> bool:
+        return any(e.kind == "def" and e.name == name and
+                   start <= e.seq < end for e in self.events)
+
+    def mutually_exclusive(self, a: int, b: int) -> bool:
+        """True when events ``a`` and ``b`` sit in opposite arms of the
+        same if/else — linearization puts them in sequence, execution
+        never does."""
+        for b0, b1, o0, o1 in self.branches:
+            if (b0 <= a < b1 and o0 <= b < o1) or \
+                    (b0 <= b < b1 and o0 <= a < o1):
+                return True
+        return False
+
+    def first_use_after(self, name: str, seq: int) -> Optional[DfEvent]:
+        """The first read of ``name`` after ``seq`` with no intervening
+        redefinition; None when it is reassigned (or never read).
+        Events in the opposite arm of an if/else from ``seq`` are
+        skipped in both roles — a sibling-arm def does not protect and
+        a sibling-arm use cannot follow."""
+        for e in self.events:
+            if e.seq <= seq or e.name != name:
+                continue
+            if self.mutually_exclusive(seq, e.seq):
+                continue
+            if e.kind == "def":
+                return None
+            return e
+        return None
+
+    def canonical(self, name: str, seq: int) -> str:
+        """Resolve ``name`` through simple-copy chains active at
+        ``seq``: ``k = self._pool`` makes ``k`` canonicalize to
+        ``self._pool`` until either is reassigned — a source rebound
+        *after* the copy breaks the chain (``old = self._pool;
+        self._pool = alloc()`` leaves ``old`` pointing at the old
+        object, the double-buffer swap idiom). Stops at the first
+        non-copy def."""
+        orig = seq
+        for _ in range(8):
+            last_def = None
+            for e in self.events:
+                if e.seq >= seq:
+                    break
+                if e.kind == "def" and e.name == name:
+                    last_def = e
+            if last_def is None:
+                return name
+            src = None
+            for cseq, tgt, source in self.copies:
+                if cseq == last_def.seq and tgt == name:
+                    src = source
+                    break
+            if src is None:
+                return name
+            if self.defs_in(src, last_def.seq + 1, orig):
+                return name     # source rebound since the copy: the
+            name, seq = src, last_def.seq   # alias no longer holds
+        return name
+
+
+def _all_args(node: ast.AST):
+    a = getattr(node, "args", None)
+    if a is None or not isinstance(a, ast.arguments):
+        return []
+    out = list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)
+    if a.vararg:
+        out.append(a.vararg)
+    if a.kwarg:
+        out.append(a.kwarg)
+    return out
+
+
+def attrs_read_before_write(index: ProjectIndex
+                            ) -> Dict[FuncRef, Set[str]]:
+    """Per-function set of ``self`` attrs the function may READ before
+    (re)assigning them, transitively through the conservative call
+    graph — the interprocedural half of use-after-donate: a callee that
+    opens with ``self._pool[...]`` reads a buffer its caller may just
+    have donated."""
+    resolver = CallResolver(index)
+    local: Dict[FuncRef, Set[str]] = {}
+    call_ctx: Dict[FuncRef, List[Tuple[FuncRef, frozenset]]] = {}
+    for mod, cinfo, name, node in iter_functions(index):
+        ref = FuncRef(mod.relpath, cinfo.name if cinfo else None, name)
+        # slim source-order walk over self attrs only (the full
+        # FunctionDataflow is reserved for the donation pass's few
+        # donating functions — this runs over EVERY function)
+        reads: Set[str] = set()
+        defined: Set[str] = set()
+        calls: List[Tuple[ast.Call, frozenset]] = []
+
+        def scan(sub):
+            if isinstance(sub, ast.Attribute) and \
+                    isinstance(sub.value, ast.Name) and \
+                    sub.value.id == "self":
+                if isinstance(sub.ctx, ast.Store):
+                    defined.add(sub.attr)
+                elif sub.attr not in defined:
+                    reads.add(sub.attr)
+                return
+            if isinstance(sub, ast.Call):
+                calls.append((sub, frozenset(defined)))
+            if isinstance(sub, ast.Assign):
+                scan(sub.value)             # RHS executes first
+                for tgt in sub.targets:
+                    scan(tgt)
+                return
+            if isinstance(sub, (ast.AugAssign, ast.AnnAssign)):
+                if sub.value is not None:
+                    scan(sub.value)
+                if isinstance(sub, ast.AugAssign):
+                    name = None
+                    if isinstance(sub.target, ast.Attribute) and \
+                            isinstance(sub.target.value, ast.Name) and \
+                            sub.target.value.id == "self":
+                        name = sub.target.attr
+                    if name is not None and name not in defined:
+                        reads.add(name)     # x += 1 reads x first
+                scan(sub.target)
+                return
+            for child in ast.iter_child_nodes(sub):
+                scan(child)
+
+        for stmt in getattr(node, "body", []):
+            scan(stmt)
+        local[ref] = reads
+        for call, defined_before in calls:
+            for callee in resolver.resolve(call, mod, cinfo):
+                call_ctx.setdefault(ref, []).append(
+                    (callee, defined_before))
+    # fixpoint: a callee's first-reads count as the caller's unless the
+    # caller already redefined the attr before the call
+    result = {ref: set(r) for ref, r in local.items()}
+    for _ in range(len(result)):
+        changed = False
+        for ref, sites in call_ctx.items():
+            for callee, defined_before in sites:
+                for attr in result.get(callee, ()):
+                    if attr in result[ref] or attr in defined_before:
+                        continue
+                    result[ref].add(attr)
+                    changed = True
+        if not changed:
+            break
+    return result

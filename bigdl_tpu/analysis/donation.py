@@ -1,0 +1,535 @@
+"""Donation / buffer-lifetime pass (ISSUE 13 tentpole pass 1).
+
+Twelve JAX modules donate buffers (``donate_argnums`` /
+``donate_argnames`` on ``jax.jit`` / ``obs.compiled``): the optimizer
+step, the data-parallel and pipeline builders, every LLM family's
+decode/prefill entry points and the paged engine's step cache. Donation
+is the repo's core perf idiom — the runtime aliases the input buffer
+into the output so a (L,B,S,H,D) cache generation costs zero extra HBM —
+and its failure mode is silent garbage: a donated buffer read after the
+dispatch observes whatever the aliased computation wrote over it. PRs
+4/5/6/8 each re-derived the same three invariants by hand; this pass
+checks them over the :class:`~bigdl_tpu.analysis.core.FunctionDataflow`
+layer:
+
+- ``use-after-donate`` — a name (or ``self`` attr) passed at a donated
+  position is read again before reassignment: in the same function, by
+  a resolved callee that reads the attr before writing it
+  (interprocedural via :func:`core.attrs_read_before_write`), or by the
+  next iteration of an enclosing loop when nothing in the loop body
+  rebinds it (the dispatch itself re-reads its donated arg on the
+  back-edge);
+- ``aliased-donate`` — two argument positions of one donating call
+  resolve (through simple-copy chains, e.g. a ``k = self._pool``
+  handle) to the same underlying object while at least one of them is
+  donated: XLA aliases the donated buffer, the other position reads it;
+- ``unfenced-drain`` — the engine's pipelining contract: a *deferred*
+  dispatch result (stored into an in-flight ``self`` container rather
+  than fetched) must be drained through the designed fence — one host
+  fetch of the FULL stored record (the (tokens ‖ fence) vector carries
+  the completion barrier) or an explicit
+  ``_sync_barrier``/``block_until_ready``. Fetching a *component* of a
+  deferred record fetches the data but not the fence, so host
+  bookkeeping (page frees, slot reuse) can run before the step that
+  consumed those buffers retired.
+
+Donated callables are found by value flow, not annotation: a direct
+``self._step = obs.compiled(fn, donate_argnums=...)``, a builder method
+that *returns* one (``fn = self._build_paged_prefill(bucket)``), and
+simple local/attr copies of either all mark their call sites as
+donating at the declared positions.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import (CallResolver, ClassInfo, Finding, FunctionDataflow,
+                   FuncRef, ModuleInfo, ProjectIndex,
+                   attrs_read_before_write, iter_functions)
+
+#: callables that produce a compiled/donating function when handed
+#: donate keywords
+_JIT_NAMES = frozenset({"jit", "pjit", "compiled"})
+
+#: host-read callables: their argument crosses device->host
+_HOST_READS = frozenset({"asarray", "device_get", "item"})
+
+#: barrier idioms: presence in a function means the author thought
+#: about ordering — the unfenced-drain rule stands down
+_BARRIER_HINTS = ("_sync_barrier", "sync_barrier", "block_until_ready")
+
+
+class DonationSpec:
+    """Which argument positions/names of a compiled callable are
+    donated."""
+
+    def __init__(self, positions: Sequence[int] = (),
+                 names: Sequence[str] = ()):
+        self.positions = frozenset(positions)
+        self.names = frozenset(names)
+
+    def __bool__(self):
+        return bool(self.positions or self.names)
+
+
+def _const_seq(node: ast.AST) -> List:
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [e.value for e in node.elts if isinstance(e, ast.Constant)]
+    if isinstance(node, ast.Constant):
+        return [node.value]
+    return []
+
+
+def _donation_spec(call: ast.Call,
+                   local_defs: Dict[str, ast.AST]) -> Optional[DonationSpec]:
+    """``obs.compiled(f, donate_argnums=(1, 2))`` -> its DonationSpec.
+    ``donate_argnames`` resolves to positions when the wrapped ``def``
+    is a visible local (its signature maps names to indices); otherwise
+    the names match keyword call sites only. Conditional donation
+    (``donate_argnums=(...) if flag else ()``) counts as donating — the
+    rule must hold on the donating path."""
+    fname = call.func.attr if isinstance(call.func, ast.Attribute) \
+        else call.func.id if isinstance(call.func, ast.Name) else ""
+    if fname not in _JIT_NAMES:
+        return None
+    positions: Set[int] = set()
+    names: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            val = kw.value
+            if isinstance(val, ast.IfExp):
+                positions.update(p for branch in (val.body, val.orelse)
+                                 for p in _const_seq(branch)
+                                 if isinstance(p, int))
+            else:
+                positions.update(p for p in _const_seq(val)
+                                 if isinstance(p, int))
+        elif kw.arg == "donate_argnames":
+            names.update(n for n in _const_seq(kw.value)
+                         if isinstance(n, str))
+    if not positions and not names:
+        return None
+    if names and call.args and isinstance(call.args[0], ast.Name):
+        fn = local_defs.get(call.args[0].id)
+        if fn is not None:
+            params = [a.arg for a in list(fn.args.posonlyargs) +
+                      list(fn.args.args)]
+            for n in list(names):
+                if n in params:
+                    positions.add(params.index(n))
+                    names.discard(n)
+    return DonationSpec(positions, names)
+
+
+class _ModuleDonations:
+    """Donated-callable bindings visible in one module."""
+
+    def __init__(self):
+        #: (class name or None, attr/local scope key) -> spec
+        self.attr_specs: Dict[Tuple[Optional[str], str], DonationSpec] = {}
+        #: FuncRef-local: function qualname -> {local name: spec}
+        self.local_specs: Dict[str, Dict[str, DonationSpec]] = {}
+
+
+def _builder_summaries(index: ProjectIndex) -> Dict[FuncRef, DonationSpec]:
+    """Functions that RETURN a donating compiled callable."""
+    out: Dict[FuncRef, DonationSpec] = {}
+    for mod, cinfo, name, node in iter_functions(index):
+        local_defs = {n.name: n for n in ast.walk(node)
+                      if isinstance(n, ast.FunctionDef)}
+        returned: Dict[str, DonationSpec] = {}
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Assign) and \
+                    isinstance(sub.value, ast.Call):
+                spec = _donation_spec(sub.value, local_defs)
+                if spec:
+                    for tgt in sub.targets:
+                        if isinstance(tgt, ast.Name):
+                            returned[tgt.id] = spec
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Return) or sub.value is None:
+                continue
+            spec = None
+            if isinstance(sub.value, ast.Call):
+                spec = _donation_spec(sub.value, local_defs)
+            elif isinstance(sub.value, ast.Name):
+                spec = returned.get(sub.value.id)
+            if spec:
+                ref = FuncRef(mod.relpath,
+                              cinfo.name if cinfo else None, name)
+                out[ref] = spec
+    return out
+
+
+def _collect_bindings(index: ProjectIndex,
+                      builders: Dict[FuncRef, DonationSpec]
+                      ) -> Dict[str, _ModuleDonations]:
+    """Where donated callables land: ``self._step = obs.compiled(...)``,
+    ``fn = self._build_x(...)`` (builder call resolved through the call
+    graph), and plain local ``fn = jax.jit(..., donate_argnums=...)``."""
+    resolver = CallResolver(index)
+    out: Dict[str, _ModuleDonations] = {}
+    # phase 1: bindings from donating calls (direct or via a builder)
+    for mod, cinfo, name, node in iter_functions(index):
+        md = out.setdefault(mod.relpath, _ModuleDonations())
+        qual = f"{cinfo.name}.{name}" if cinfo else name
+        local_defs = {n.name: n for n in ast.walk(node)
+                      if isinstance(n, ast.FunctionDef)}
+        locals_here: Dict[str, DonationSpec] = {}
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Assign) or \
+                    not isinstance(sub.value, ast.Call):
+                continue
+            spec = _donation_spec(sub.value, local_defs)
+            if not spec:
+                for callee in resolver.resolve(sub.value, mod, cinfo):
+                    if callee in builders:
+                        spec = builders[callee]
+                        break
+            if not spec:
+                continue
+            for tgt in sub.targets:
+                if isinstance(tgt, ast.Name):
+                    locals_here[tgt.id] = spec
+                elif isinstance(tgt, ast.Attribute) and \
+                        isinstance(tgt.value, ast.Name) and \
+                        tgt.value.id == "self" and cinfo is not None:
+                    md.attr_specs[(cinfo.name, tgt.attr)] = spec
+        if locals_here:
+            md.local_specs[qual] = locals_here
+    # phase 2: plain copies of a donated attr to a local
+    # (`step = self._step_fn` — the optimizer-loop idiom) now that
+    # every class's attr specs are known
+    for mod, cinfo, name, node in iter_functions(index):
+        if cinfo is None:
+            continue
+        md = out.get(mod.relpath)
+        if md is None:
+            continue
+        qual = f"{cinfo.name}.{name}"
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Assign) or \
+                    not isinstance(sub.value, ast.Attribute) or \
+                    not isinstance(sub.value.value, ast.Name) or \
+                    sub.value.value.id != "self":
+                continue
+            spec = md.attr_specs.get((cinfo.name, sub.value.attr))
+            if not spec:
+                continue
+            for tgt in sub.targets:
+                if isinstance(tgt, ast.Name):
+                    md.local_specs.setdefault(qual, {}) \
+                        .setdefault(tgt.id, spec)
+    return out
+
+
+def _donated_args(call: ast.Call, spec: DonationSpec
+                  ) -> List[Tuple[int, ast.AST]]:
+    out = []
+    for pos in spec.positions:
+        if 0 <= pos < len(call.args):
+            out.append((pos, call.args[pos]))
+    if spec.names:
+        for i, kw in enumerate(call.keywords):
+            if kw.arg in spec.names:
+                out.append((len(call.args) + i, kw.value))
+    return out
+
+
+def _simple_name(expr: ast.AST) -> Optional[str]:
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute) and \
+            isinstance(expr.value, ast.Name) and expr.value.id == "self":
+        return f"self.{expr.attr}"
+    return None
+
+
+def _spec_for_call(call: ast.Call, qual: str, cinfo: Optional[ClassInfo],
+                   md: _ModuleDonations) -> Optional[DonationSpec]:
+    f = call.func
+    if isinstance(f, ast.Name):
+        return md.local_specs.get(qual, {}).get(f.id)
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name) \
+            and f.value.id == "self" and cinfo is not None:
+        return md.attr_specs.get((cinfo.name, f.attr))
+    return None
+
+
+def run_donation_pass(index: ProjectIndex) -> List[Finding]:
+    builders = _builder_summaries(index)
+    bindings = _collect_bindings(index, builders)
+    rbw = attrs_read_before_write(index)
+    resolver = CallResolver(index)
+    findings: List[Finding] = []
+    seen: Set[str] = set()
+
+    def emit(f: Finding):
+        if f.fingerprint not in seen:
+            seen.add(f.fingerprint)
+            findings.append(f)
+
+    for mod, cinfo, fname, node in iter_functions(index):
+        md = bindings.get(mod.relpath)
+        if md is None:
+            continue
+        qual = f"{cinfo.name}.{fname}" if cinfo else fname
+        ref_qual = f"{mod.relpath}::{qual}"
+        df: Optional[FunctionDataflow] = None
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            spec = _spec_for_call(sub, qual, cinfo, md)
+            if spec is None:
+                continue
+            if df is None:
+                df = FunctionDataflow(node)
+            span = df.call_spans.get(id(sub))
+            donated = _donated_args(sub, spec)
+            _check_use_after(emit, mod, cinfo, ref_qual, sub, span, df,
+                             donated, rbw, resolver)
+            _check_aliasing(emit, mod, ref_qual, sub, span, df, donated,
+                            spec)
+    # one drain audit per class (not per method — the scan walks every
+    # method of the class anyway)
+    for mod in index.modules.values():
+        if mod.relpath not in bindings:
+            continue
+        for cinfo in mod.classes.values():
+            _check_unfenced_drain(emit, index, mod, cinfo, bindings)
+    return findings
+
+
+def _check_use_after(emit, mod, cinfo, ref_qual, call, span, df,
+                     donated, rbw, resolver):
+    if span is None:
+        return
+    start, end = span
+    loop = df.loop_containing(start)
+    for pos, arg in donated:
+        name = _simple_name(arg)
+        if name is None:
+            continue
+        # 1. straight-line re-read before reassignment
+        use = df.first_use_after(name, end - 1)
+        if use is not None:
+            emit(Finding(
+                rule="use-after-donate", file=mod.relpath, line=use.line,
+                key=f"{ref_qual}:{name}@{pos}",
+                message=f"{ref_qual} reads {name} (line {use.line}) "
+                        f"after donating it at position {pos} of the "
+                        f"compiled call on line {call.lineno} — a "
+                        f"donated buffer's contents are undefined after "
+                        f"dispatch; rebind it from the call's result "
+                        f"first"))
+            continue
+        # 2. loop back-edge: nothing in the loop rebinds the buffer, so
+        # the next iteration's dispatch re-reads the donated ref
+        if loop is not None and not df.defs_in(name, *loop):
+            emit(Finding(
+                rule="use-after-donate", file=mod.relpath,
+                line=call.lineno,
+                key=f"{ref_qual}:{name}@loop",
+                message=f"{ref_qual} donates {name} inside a loop that "
+                        f"never reassigns it — the next iteration "
+                        f"passes a donated (dead) buffer"))
+            continue
+        # 3. the donated ref escapes this frame: a thread or closure in
+        # the same function holds it and can read it at any later time
+        if name in df.escapes:
+            emit(Finding(
+                rule="use-after-donate", file=mod.relpath,
+                line=df.escapes[name],
+                key=f"{ref_qual}:{name}@escape",
+                message=f"{ref_qual} donates {name} while a nested "
+                        f"closure/thread (line {df.escapes[name]}) "
+                        f"holds a reference to it — the escaped ref "
+                        f"can read the donated buffer after dispatch"))
+            continue
+        # 4. interprocedural: a callee invoked before the rebind reads
+        # the attr first thing
+        if not name.startswith("self."):
+            continue
+        attr = name[len("self."):]
+        for seq, later_call in df.calls:
+            if seq < end:
+                continue
+            if df.mutually_exclusive(start, seq):
+                continue            # sibling if/else arm: never runs
+            if df.defs_in(name, end, seq):
+                break               # rebound before this call
+            for callee in resolver.resolve(later_call, mod, cinfo):
+                if attr in rbw.get(callee, ()):
+                    emit(Finding(
+                        rule="use-after-donate", file=mod.relpath,
+                        line=later_call.lineno,
+                        key=f"{ref_qual}:{name}->"
+                            f"{callee.qualname.split('::')[-1]}",
+                        message=f"{ref_qual} donates {name} then calls "
+                                f"{callee.qualname.split('::')[-1]} "
+                                f"(line {later_call.lineno}) which "
+                                f"reads {name} before any reassignment "
+                                f"— use-after-donate through the call "
+                                f"graph"))
+
+
+def _check_aliasing(emit, mod, ref_qual, call, span, df, donated, spec):
+    if span is None:
+        return
+    start, _ = span
+    donated_pos = {p for p, _ in donated}
+    canon: Dict[int, str] = {}
+    for i, arg in enumerate(call.args):
+        name = _simple_name(arg)
+        if name is not None:
+            canon[i] = df.canonical(name, start)
+    for i, kw in enumerate(call.keywords):
+        name = _simple_name(kw.value)
+        if name is not None:
+            canon[len(call.args) + i] = df.canonical(name, start)
+    by_value: Dict[str, List[int]] = {}
+    for pos, val in canon.items():
+        by_value.setdefault(val, []).append(pos)
+    for val, positions in sorted(by_value.items()):
+        if len(positions) < 2:
+            continue
+        hit = sorted(set(positions) & donated_pos)
+        if not hit:
+            continue
+        emit(Finding(
+            rule="aliased-donate", file=mod.relpath, line=call.lineno,
+            key=f"{ref_qual}:{val}",
+            message=f"{ref_qual} passes the same object ({val}) at "
+                    f"argument positions {sorted(positions)} of a "
+                    f"donating call and position {hit[0]} is donated — "
+                    f"the other position reads a buffer XLA just "
+                    f"aliased away"))
+
+
+# ---------------------------------------------------------------------------
+# unfenced-drain
+# ---------------------------------------------------------------------------
+
+def _check_unfenced_drain(emit, index, mod, cinfo, bindings):
+    """Per class: find in-flight containers (``self.<c>.append(rec)``
+    where rec derives from a donated dispatch result), then audit every
+    drain site (``rec = self.<c>.popleft()/pop()``) for partial host
+    fetches."""
+    if cinfo is None:
+        return
+    md = bindings.get(mod.relpath)
+    if md is None:
+        return
+    containers: Dict[str, Optional[str]] = {}   # attr -> full-record key
+    # pass 1: dispatch side — which containers hold deferred results
+    for mname, meth in cinfo.methods.items():
+        qual = f"{cinfo.name}.{mname}"
+        result_names: Set[str] = set()
+        for sub in ast.walk(meth):
+            if isinstance(sub, ast.Assign) and \
+                    isinstance(sub.value, ast.Call) and \
+                    _spec_for_call(sub.value, qual, cinfo, md):
+                for tgt in sub.targets:
+                    if isinstance(tgt, ast.Name):
+                        result_names.add(tgt.id)
+                    elif isinstance(tgt, (ast.Tuple, ast.List)):
+                        result_names.update(
+                            e.id for e in tgt.elts
+                            if isinstance(e, ast.Name))
+        if not result_names:
+            continue
+        for sub in ast.walk(meth):
+            if not (isinstance(sub, ast.Call) and
+                    isinstance(sub.func, ast.Attribute) and
+                    sub.func.attr == "append" and
+                    isinstance(sub.func.value, ast.Attribute) and
+                    isinstance(sub.func.value.value, ast.Name) and
+                    sub.func.value.value.id == "self" and sub.args):
+                continue
+            rec = sub.args[0]
+            names_in = {n.id for n in ast.walk(rec)
+                        if isinstance(n, ast.Name)}
+            if not names_in & result_names:
+                continue
+            attr = sub.func.value.attr
+            full_key = None
+            if isinstance(rec, ast.Dict):
+                for k, v in zip(rec.keys, rec.values):
+                    if isinstance(k, ast.Constant) and \
+                            isinstance(v, ast.Name) and \
+                            v.id in result_names:
+                        full_key = k.value
+            containers[attr] = full_key
+    if not containers:
+        return
+    # pass 2: drain side — popped records must be fetched whole
+    for mname, meth in cinfo.methods.items():
+        src = mod.segment(meth)
+        if any(h in src for h in _BARRIER_HINTS):
+            continue        # an explicit barrier covers the partial read
+        popped: Dict[str, str] = {}     # local -> container attr
+        for sub in ast.walk(meth):
+            if isinstance(sub, ast.Assign) and \
+                    isinstance(sub.value, ast.Call) and \
+                    isinstance(sub.value.func, ast.Attribute) and \
+                    sub.value.func.attr in ("popleft", "pop") and \
+                    isinstance(sub.value.func.value, ast.Attribute) and \
+                    isinstance(sub.value.func.value.value, ast.Name) and \
+                    sub.value.func.value.value.id == "self" and \
+                    sub.value.func.value.attr in containers:
+                for tgt in sub.targets:
+                    if isinstance(tgt, ast.Name):
+                        popped[tgt.id] = sub.value.func.value.attr
+        if not popped:
+            continue
+        for sub in ast.walk(meth):
+            if not isinstance(sub, ast.Call):
+                continue
+            fn = sub.func
+            host_read = (isinstance(fn, ast.Attribute) and
+                         fn.attr in _HOST_READS) or \
+                        (isinstance(fn, ast.Name) and
+                         fn.id in ("float", "int"))
+            if not host_read:
+                continue
+            target = None
+            if isinstance(fn, ast.Attribute) and fn.attr == "item":
+                target = fn.value
+            elif sub.args:
+                target = sub.args[0]
+            if target is None:
+                continue
+            rec_name, path = _record_path(target)
+            if rec_name not in popped:
+                continue
+            full_key = containers[popped[rec_name]]
+            if path == [full_key] and full_key is not None:
+                continue        # the designed full-record fence fetch
+            if not path and full_key is None:
+                continue        # bare record fetched whole
+            emit(Finding(
+                rule="unfenced-drain", file=mod.relpath, line=sub.lineno,
+                key=f"{cinfo.name}.{mname}:{rec_name}"
+                    f"[{'.'.join(map(str, path))}]",
+                message=f"{cinfo.name}.{mname} host-reads a component "
+                        f"of in-flight record {rec_name!r} (line "
+                        f"{sub.lineno}) instead of the full stored "
+                        f"result — the fetch delivers data without the "
+                        f"step's completion fence; fetch the whole "
+                        f"record (or barrier first) before releasing "
+                        f"the buffers it consumed"))
+
+
+def _record_path(expr: ast.AST) -> Tuple[Optional[str], List]:
+    """``rec["out"][0]`` -> ("rec", ["out", 0]); non-Name bases ->
+    (None, [])."""
+    path: List = []
+    while isinstance(expr, ast.Subscript):
+        sl = expr.slice
+        path.append(sl.value if isinstance(sl, ast.Constant) else "?")
+        expr = expr.value
+    if isinstance(expr, ast.Name):
+        return expr.id, list(reversed(path))
+    return None, []

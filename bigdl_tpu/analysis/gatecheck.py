@@ -1,0 +1,320 @@
+"""Feature-gate discipline pass (ISSUE 13 tentpole pass 2).
+
+Every subsystem since PR 2 ships behind a conf gate with the same
+contract: **disabled = structurally absent** — no threads, no metric
+series, no endpoints, byte-identical behavior to the pre-subsystem
+code. Each PR proved its own gate by hand-written absence tests; this
+pass mechanizes the three structural halves of the contract over the
+declared :data:`~bigdl_tpu.analysis.registries.FEATURE_GATES`:
+
+- ``gate-default-on`` — a registered gate whose ``conf._DEFAULTS``
+  value is not off: a new subsystem must be opt-in (the two
+  foundational planes that predate the rule are baselined, with
+  justifications);
+- ``gate-module-side-effect`` — a module inside a gated package runs a
+  side effect at import time (thread start, ``bigdl_*`` metric
+  declaration, ``conf.set``): imports happen regardless of the gate,
+  so the "absent" mode would not be absent;
+- ``gate-unguarded-construction`` — a class defined in a gated package
+  is constructed from outside it with no gate in sight: neither the
+  enclosing function nor any enclosing ``if``/conditional mentions the
+  gate key or a name derived from it (``kv_enabled = conf.get_bool(
+  "bigdl.llm.kvcache.enabled", ...)`` marks ``kv_enabled`` as
+  gate-derived);
+- ``gate-no-absence-test`` — no file under ``tests/`` mentions the
+  gate key at all: the disabled-mode absence assertion every PR wrote
+  by hand must exist somewhere.
+
+The pass never imports the analyzed code; defaults come from an AST
+parse of ``conf.py`` (same idiom as the registry-drift mirrors).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from . import registries
+from .core import Finding, ModuleInfo, ProjectIndex
+
+_FALSEY = ("false", "0", "no", "off", "")
+
+#: metric-declaration callables (mirrors registrydrift's list)
+_METRIC_DECL_FUNCS = ("counter", "gauge", "histogram", "sketch")
+
+
+def parse_conf_default_values(root: str) -> Optional[Dict[str, str]]:
+    """``conf._DEFAULTS`` as {key: default} — values this time, not
+    just keys. ``None`` when conf.py is absent (fixture trees)."""
+    path = os.path.join(root, "bigdl_tpu/utils/conf.py")
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        tree = ast.parse(f.read())
+    for node in tree.body:
+        tgt = None
+        if isinstance(node, ast.AnnAssign):
+            tgt = node.target
+        elif isinstance(node, ast.Assign):
+            tgt = node.targets[0]
+        if isinstance(tgt, ast.Name) and tgt.id == "_DEFAULTS" and \
+                isinstance(node.value, ast.Dict):
+            return {k.value: (v.value if isinstance(v, ast.Constant)
+                              else "")
+                    for k, v in zip(node.value.keys, node.value.values)
+                    if isinstance(k, ast.Constant)}
+    return {}
+
+
+def _gated_modules(index: ProjectIndex, package: str
+                   ) -> List[Tuple[str, ModuleInfo]]:
+    """Modules under a gated package path (a dir prefix or one .py)."""
+    out = []
+    for rel, mod in index.modules.items():
+        if rel == package or rel.startswith(package.rstrip("/") + "/"):
+            out.append((rel, mod))
+    return out
+
+
+def _package_dotted(package: str) -> str:
+    return package[:-3].replace("/", ".") if package.endswith(".py") \
+        else package.replace("/", ".")
+
+
+def _module_level_side_effects(mod: ModuleInfo) -> List[Tuple[str, int]]:
+    """Import-time side effects: (what, line). Walks only module-level
+    statements — bodies of defs/classes run post-gate."""
+    out: List[Tuple[str, int]] = []
+
+    def scan_expr(expr: ast.AST):
+        for sub in ast.walk(expr):
+            if not isinstance(sub, ast.Call):
+                continue
+            f = sub.func
+            name = f.attr if isinstance(f, ast.Attribute) else \
+                f.id if isinstance(f, ast.Name) else ""
+            if name == "start" and isinstance(f, ast.Attribute):
+                out.append(("thread start", sub.lineno))
+            elif name == "Thread" and isinstance(f, ast.Attribute) and \
+                    isinstance(f.value, ast.Name) and \
+                    f.value.id == "threading":
+                out.append(("thread construction", sub.lineno))
+            elif name in _METRIC_DECL_FUNCS and sub.args and \
+                    isinstance(sub.args[0], ast.Constant) and \
+                    isinstance(sub.args[0].value, str) and \
+                    sub.args[0].value.startswith("bigdl_"):
+                out.append((f"metric declaration "
+                            f"{sub.args[0].value!r}", sub.lineno))
+            elif name == "set" and isinstance(f, ast.Attribute) and \
+                    isinstance(f.value, ast.Name) and \
+                    f.value.id == "conf":
+                out.append(("conf.set", sub.lineno))
+
+    for node in mod.tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Import, ast.ImportFrom)):
+            continue
+        if isinstance(node, ast.If):
+            # `if TYPE_CHECKING:` / __main__ guards: skip entirely
+            continue
+        for _, val in ast.iter_fields(node):
+            items = val if isinstance(val, list) else [val]
+            for item in items:
+                if isinstance(item, ast.expr):
+                    scan_expr(item)
+    return out
+
+
+def _gate_derived_names(mod: ModuleInfo,
+                        gate_keys: Tuple[str, ...]) -> Set[str]:
+    """Names/attrs assigned from an expression that mentions one of the
+    gate keys — conditions over them count as guarding."""
+    derived: Set[str] = set()
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue
+        value = node.value
+        if value is None:
+            continue
+        seg = mod.segment(value)
+        if not any(k in seg for k in gate_keys):
+            continue
+        targets = node.targets if isinstance(node, ast.Assign) \
+            else [node.target]
+        for tgt in targets:
+            if isinstance(tgt, ast.Name):
+                derived.add(tgt.id)
+            elif isinstance(tgt, ast.Attribute):
+                derived.add(tgt.attr)
+    return derived
+
+
+def _guarded(call: ast.Call, func_node: ast.AST, mod: ModuleInfo,
+             gate_keys: Tuple[str, ...], derived: Set[str]) -> bool:
+    """Is this construction dominated by a gate check we can see?"""
+    seg = mod.segment(func_node)
+    if any(k in seg for k in gate_keys):
+        return True
+    # enclosing if/conditional tests mentioning a gate-derived name
+    for test in _enclosing_tests(func_node, call):
+        for sub in ast.walk(test):
+            if isinstance(sub, ast.Name) and sub.id in derived:
+                return True
+            if isinstance(sub, ast.Attribute) and sub.attr in derived:
+                return True
+    return False
+
+
+def _enclosing_tests(func_node: ast.AST, call: ast.Call):
+    """Tests of every If/IfExp/BoolOp lexically enclosing ``call``."""
+    out: List[ast.AST] = []
+
+    def walk(node, stack):
+        if node is call:
+            out.extend(stack)
+            return True
+        found = False
+        if isinstance(node, ast.If):
+            if walk_many(node.body, stack + [node.test]):
+                found = True
+            if walk_many(node.orelse, stack):
+                found = True
+            if walk_one(node.test, stack):
+                found = True
+            return found
+        if isinstance(node, ast.IfExp):
+            for part, st in ((node.body, stack + [node.test]),
+                             (node.orelse, stack), (node.test, stack)):
+                if walk_one(part, st):
+                    found = True
+            return found
+        if isinstance(node, ast.BoolOp):
+            # `flag and Thing()`: earlier operands guard later ones
+            for i, v in enumerate(node.values):
+                if walk_one(v, stack + node.values[:i]):
+                    found = True
+            return found
+        for child in ast.iter_child_nodes(node):
+            if walk(child, stack):
+                found = True
+        return found
+
+    def walk_many(nodes, stack):
+        return any(walk(n, stack) for n in list(nodes))
+
+    def walk_one(node, stack):
+        return walk(node, stack)
+
+    walk(func_node, [])
+    return out
+
+
+def run_gatecheck_pass(index: ProjectIndex,
+                       usage_index: Optional[ProjectIndex] = None,
+                       root: Optional[str] = None,
+                       gates: Optional[Dict[str, dict]] = None
+                       ) -> List[Finding]:
+    """``gates`` overrides the declared FEATURE_GATES registry (fixture
+    tests); the real gate always runs against the declaration."""
+    root = root or index.root
+    usage = usage_index if usage_index is not None else index
+    if gates is None:
+        gates = registries.FEATURE_GATES
+    defaults = parse_conf_default_values(root)
+    findings: List[Finding] = []
+
+    test_sources = [m.source for rel, m in usage.modules.items()
+                    if rel.startswith("tests/")]
+    have_tests = os.path.isdir(os.path.join(root, "tests"))
+
+    # package -> all gates mapped to it (hedge+failover share a module)
+    pkg_gates: Dict[str, List[str]] = {}
+    for key, info in gates.items():
+        pkg = info.get("package")
+        if pkg:
+            pkg_gates.setdefault(pkg, []).append(key)
+
+    for key, info in sorted(gates.items()):
+        # -- default must be off ---------------------------------------------
+        if defaults is not None and key in defaults:
+            val = str(defaults[key]).strip().lower()
+            if val not in _FALSEY:
+                findings.append(Finding(
+                    rule="gate-default-on", file="bigdl_tpu/utils/conf.py",
+                    line=0, key=key,
+                    message=f"feature gate {key!r} defaults to "
+                            f"{defaults[key]!r} — gated subsystems must "
+                            f"be opt-in (default off)"))
+        # -- a disabled-mode absence test must exist -------------------------
+        if have_tests and not any(key in src for src in test_sources):
+            findings.append(Finding(
+                rule="gate-no-absence-test",
+                file="bigdl_tpu/analysis/registries.py", line=0, key=key,
+                message=f"feature gate {key!r} appears in no file under "
+                        f"tests/ — the disabled-mode absence contract "
+                        f"is unasserted"))
+
+    for pkg, gates in sorted(pkg_gates.items()):
+        gate_keys = tuple(gates)
+        gated = _gated_modules(index, pkg)
+        gated_rels = {rel for rel, _ in gated}
+        gated_classes: Set[str] = set()
+        for rel, mod in gated:
+            gated_classes.update(mod.classes)
+            # -- import-time side effects in the gated package ---------------
+            for what, line in _module_level_side_effects(mod):
+                findings.append(Finding(
+                    rule="gate-module-side-effect", file=rel, line=line,
+                    key=f"{rel}:{what}",
+                    message=f"module-level {what} in gated package "
+                            f"{pkg!r} runs at import time, before any "
+                            f"{gate_keys[0]!r} check — disabled mode "
+                            f"would not be structurally absent"))
+        if not gated_classes:
+            continue
+        dotted = _package_dotted(pkg)
+        # -- construction outside the package must be gate-guarded -----------
+        for rel, mod in index.modules.items():
+            if rel in gated_rels or rel.startswith("tests/") or \
+                    rel.startswith("tools/"):
+                continue
+            imported_gated = {
+                local for local, target in mod.imports.items()
+                if target.startswith(dotted) and
+                (local in gated_classes or
+                 target.rsplit(".", 1)[-1] in gated_classes)}
+            if not imported_gated:
+                continue
+            derived = _gate_derived_names(mod, gate_keys)
+            for fnode in _all_function_nodes(mod):
+                for sub in ast.walk(fnode):
+                    if not (isinstance(sub, ast.Call) and
+                            isinstance(sub.func, ast.Name) and
+                            sub.func.id in imported_gated):
+                        continue
+                    if _guarded(sub, fnode, mod, gate_keys, derived):
+                        continue
+                    findings.append(Finding(
+                        rule="gate-unguarded-construction", file=rel,
+                        line=sub.lineno,
+                        key=f"{sub.func.id}@{_fn_name(fnode)}",
+                        message=f"{rel} constructs gated class "
+                                f"{sub.func.id} (package {pkg!r}) in "
+                                f"{_fn_name(fnode)} with no "
+                                f"{gate_keys[0]!r} check in sight — "
+                                f"the subsystem would exist with the "
+                                f"gate off"))
+    return findings
+
+
+def _all_function_nodes(mod: ModuleInfo):
+    for fn in mod.functions.values():
+        yield fn
+    for cinfo in mod.classes.values():
+        for meth in cinfo.methods.values():
+            yield meth
+
+
+def _fn_name(node: ast.AST) -> str:
+    return getattr(node, "name", "<module>")

@@ -1,0 +1,335 @@
+"""HTTP surface-drift pass (ISSUE 13 tentpole pass 3).
+
+Five hand-rolled HTTP surfaces (ServingFrontend, LLMWorker, LLMRouter,
+elastic Supervisor, federation SnapshotServer) share one idiom: a
+``do_GET``/``do_POST`` method matching ``self.path`` against string
+literals, a final ``else: 404``, and clients scattered across the
+router, the prober, the elastic agent, the fleet collector and the
+tools. Nothing ties the four views (served routes, client call sites,
+docs, tests) together — a renamed endpoint keeps compiling and fails at
+runtime on whichever surface didn't get the memo. This pass extracts
+all four views statically and cross-checks them against the declared
+:data:`~bigdl_tpu.analysis.registries.HTTP_ENDPOINTS`:
+
+- ``route-unregistered`` — a surface serves a path the registry does
+  not declare (typo, or an undeclared endpoint);
+- ``route-unserved`` — a registered endpoint no surface serves any
+  more (the registry only ever shrinks with the code);
+- ``http-client-unhandled`` — an in-tree client calls a path no
+  surface handles: a guaranteed 404 at runtime;
+- ``http-route-no-client`` — a served route with no client call site
+  and no mention in tests/tools/examples: unreachable in practice;
+- ``http-route-undocumented`` — a served route named in no user-facing
+  doc (README.md, docs/*.md);
+- ``http-route-untested`` — a served route no file under ``tests/``
+  mentions;
+- ``http-gated-no-404`` — an endpoint whose registry entry declares a
+  feature gate must answer 404 when the gate is off (the structural-
+  absence contract): its match branch needs an explicit 404 arm, or a
+  conjunctive test (``path == X and collector is not None``) falling
+  through to the handler's final 404.
+
+Route matching understands the repo's three idioms: ``self.path ==
+"/x"`` / ``in ("/x", "/y")`` chains, the early-return ``self.path !=
+"/x"`` guard (the route is the fall-through), and the shared
+``tracing.debug_endpoint(self.path)`` helper (serves ``/debug/traces``
++ ``/debug/trace/*`` with its own internal gate-404).
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from . import registries
+from .core import Finding, ModuleInfo, ProjectIndex
+from .registrydrift import load_docs
+
+#: routes the shared tracing.debug_endpoint helper serves (with its own
+#: observability-gate 404 inside the helper)
+DEBUG_HELPER_ROUTES = ("/debug/traces", "/debug/trace/*")
+
+#: client callables whose string args are request paths
+_CLIENT_FUNCS = frozenset({"request", "_call", "post", "_post", "_get",
+                           "_http_get", "http_get", "urlopen"})
+
+
+class Route:
+    """One served (surface, method, path) with its match branches."""
+
+    def __init__(self, file: str, cls: str, method: str, path: str,
+                 line: int):
+        self.file = file
+        self.cls = cls
+        self.method = method            # "GET" / "POST"
+        self.path = path                # may end in "*" (prefix match)
+        self.line = line
+        #: (test node or None, body stmts, negated) per match site
+        self.branches: List[Tuple[Optional[ast.AST], list, bool]] = []
+
+    @property
+    def key(self) -> str:
+        return f"{self.method} {self.path}"
+
+
+def _is_self_path(expr: ast.AST) -> bool:
+    return isinstance(expr, ast.Attribute) and expr.attr == "path" and \
+        isinstance(expr.value, ast.Name) and expr.value.id == "self"
+
+
+def _path_consts(expr: ast.AST) -> List[str]:
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        return [expr.value]
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        return [e.value for e in expr.elts
+                if isinstance(e, ast.Constant) and
+                isinstance(e.value, str)]
+    return []
+
+
+def extract_routes(index: ProjectIndex) -> List[Route]:
+    routes: Dict[Tuple[str, str, str, str], Route] = {}
+
+    def route(file, cls, method, path, line) -> Route:
+        k = (file, cls, method, path)
+        if k not in routes:
+            routes[k] = Route(file, cls, method, path, line)
+        return routes[k]
+
+    for rel, mod in index.modules.items():
+        for cls_node in ast.walk(mod.tree):
+            if not isinstance(cls_node, ast.ClassDef):
+                continue
+            for meth in cls_node.body:
+                if not isinstance(meth, ast.FunctionDef) or \
+                        not meth.name.startswith("do_"):
+                    continue
+                verb = meth.name[3:]
+                _scan_handler(rel, cls_node.name, verb, meth, route)
+    return list(routes.values())
+
+
+def _scan_handler(rel: str, cls: str, verb: str, meth: ast.FunctionDef,
+                  route):
+    for node in ast.walk(meth):
+        if isinstance(node, ast.Call):
+            fn = node.func
+            name = fn.attr if isinstance(fn, ast.Attribute) else \
+                fn.id if isinstance(fn, ast.Name) else ""
+            if name == "debug_endpoint" and node.args and \
+                    _is_self_path(node.args[0]):
+                for p in DEBUG_HELPER_ROUTES:
+                    r = route(rel, cls, verb, p, node.lineno)
+                    r.branches.append((None, [], False))
+            elif name == "startswith" and isinstance(fn, ast.Attribute) \
+                    and _is_self_path(fn.value) and node.args and \
+                    isinstance(node.args[0], ast.Constant):
+                r = route(rel, cls, verb,
+                          str(node.args[0].value) + "*", node.lineno)
+                r.branches.append((None, [], False))
+        if not isinstance(node, ast.If):
+            continue
+        for cmp_node in ast.walk(node.test):
+            if not isinstance(cmp_node, ast.Compare) or \
+                    not _is_self_path(cmp_node.left) or \
+                    len(cmp_node.ops) != 1:
+                continue
+            op = cmp_node.ops[0]
+            paths = _path_consts(cmp_node.comparators[0])
+            negated = isinstance(op, ast.NotEq)
+            if not isinstance(op, (ast.Eq, ast.In, ast.NotEq)):
+                continue
+            for p in paths:
+                r = route(rel, cls, verb, p, cmp_node.left.lineno)
+                r.branches.append((node.test, node.body, negated))
+
+
+def extract_clients(index: ProjectIndex) -> Dict[str, Tuple[str, int]]:
+    """{path: first (file, line)} of in-tree client call sites."""
+    out: Dict[str, Tuple[str, int]] = {}
+    for rel, mod in index.modules.items():
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            name = fn.attr if isinstance(fn, ast.Attribute) else \
+                fn.id if isinstance(fn, ast.Name) else ""
+            if name not in _CLIENT_FUNCS:
+                continue
+            for arg in node.args:
+                if isinstance(arg, ast.Constant) and \
+                        isinstance(arg.value, str) and \
+                        arg.value.startswith("/") and \
+                        " " not in arg.value:
+                    out.setdefault(arg.value, (rel, node.lineno))
+                    break
+    return out
+
+
+def _gate_conjunct(test: Optional[ast.AST]) -> bool:
+    """Does the match test conjoin a *subsystem-handle* check with the
+    path compare (``self.path == X and sup._collector is not None``)?
+    Only None-comparisons and attribute-handle truthiness count — a
+    bare local (``and req_ok``) is request state, not gate state, and
+    must not satisfy the 404-when-off contract."""
+    if test is None or not isinstance(test, ast.BoolOp) or \
+            not isinstance(test.op, ast.And):
+        return False
+    for v in test.values:
+        if isinstance(v, ast.Compare) and _is_self_path(v.left):
+            continue                    # the path match itself
+        if isinstance(v, ast.Compare) and any(
+                isinstance(c, ast.Constant) and c.value is None
+                for c in v.comparators):
+            return True                 # handle is (not) None
+        if isinstance(v, ast.Attribute) or (
+                isinstance(v, ast.UnaryOp) and
+                isinstance(v.op, ast.Not) and
+                isinstance(v.operand, ast.Attribute)):
+            return True                 # obj.enabled-style handle
+    return False
+
+
+def _emits_404(nodes) -> bool:
+    for n in nodes:
+        for sub in ast.walk(n):
+            if not isinstance(sub, ast.Call):
+                continue
+            fn = sub.func
+            name = fn.attr if isinstance(fn, ast.Attribute) else ""
+            if name in ("_json", "send_response", "send_error") and \
+                    sub.args and isinstance(sub.args[0], ast.Constant) \
+                    and sub.args[0].value == 404:
+                return True
+    return False
+
+
+def _match(path: str, routes: List[Route]) -> bool:
+    for r in routes:
+        pat = r.path
+        if pat.endswith("*"):
+            if path.startswith(pat[:-1]) or path == pat:
+                return True
+        elif path == pat:
+            return True
+    return False
+
+
+def _registered(path: str, endpoints: Dict[str, dict]) -> bool:
+    if path in endpoints:
+        return True
+    return any(fnmatch.fnmatch(path, pat) for pat in endpoints)
+
+
+def run_httpdrift_pass(index: ProjectIndex,
+                       usage_index: Optional[ProjectIndex] = None,
+                       root: Optional[str] = None,
+                       endpoints: Optional[Dict[str, dict]] = None
+                       ) -> List[Finding]:
+    """``endpoints`` overrides the declared HTTP_ENDPOINTS registry
+    (fixture tests); the real gate runs against the declaration."""
+    root = root or index.root
+    usage = usage_index if usage_index is not None else index
+    if endpoints is None:
+        endpoints = registries.HTTP_ENDPOINTS
+    routes = extract_routes(index)
+    clients = extract_clients(index)
+    docs = load_docs(root)
+    findings: List[Finding] = []
+
+    test_text = "\n".join(m.source for rel, m in usage.modules.items()
+                          if rel.startswith("tests/"))
+    aux_text = "\n".join(m.source for rel, m in usage.modules.items()
+                         if rel.startswith(("tests/", "tools/",
+                                            "examples/")))
+    have_tests = os.path.isdir(os.path.join(root, "tests"))
+
+    # -- served vs registry --------------------------------------------------
+    by_path: Dict[str, List[Route]] = {}
+    for r in routes:
+        by_path.setdefault(r.path, []).append(r)
+    for path, rlist in sorted(by_path.items()):
+        r0 = min(rlist, key=lambda r: (r.file, r.line))
+        if not _registered(path, endpoints):
+            findings.append(Finding(
+                rule="route-unregistered", file=r0.file, line=r0.line,
+                key=path,
+                message=f"surface {r0.cls}.do_{r0.method} serves "
+                        f"{path!r} but analysis/registries.py "
+                        f"HTTP_ENDPOINTS does not declare it"))
+            continue
+        ent = endpoints.get(path) or next(
+            (v for k, v in endpoints.items()
+             if fnmatch.fnmatch(path, k)), {})
+        probe = path[:-1] if path.endswith("*") else path
+        # -- docs / tests / clients ------------------------------------------
+        if not docs.covers(probe.rstrip("/")):
+            findings.append(Finding(
+                rule="http-route-undocumented", file=r0.file,
+                line=r0.line, key=path,
+                message=f"endpoint {path!r} appears in no user-facing "
+                        f"doc (README.md, docs/*.md)"))
+        if have_tests and probe.rstrip("/") not in test_text:
+            findings.append(Finding(
+                rule="http-route-untested", file=r0.file, line=r0.line,
+                key=path,
+                message=f"endpoint {path!r} is exercised by no file "
+                        f"under tests/"))
+        has_client = any(
+            c == probe or c.startswith(probe) if path.endswith("*")
+            else c == path for c in clients)
+        if not has_client and probe.rstrip("/") not in aux_text:
+            findings.append(Finding(
+                rule="http-route-no-client", file=r0.file, line=r0.line,
+                key=path,
+                message=f"endpoint {path!r} has no in-tree client call "
+                        f"site and no mention under tests/tools/"
+                        f"examples — an unreachable handler"))
+        # -- gated endpoints need the 404-when-off branch --------------------
+        gate = ent.get("gate")
+        if gate and ent.get("gate404") != "helper":
+            for r in rlist:
+                ok = False
+                for test, body, negated in r.branches:
+                    if negated:
+                        ok = True       # fall-through serve: else is 404
+                        break
+                    if body and _emits_404(body):
+                        ok = True
+                        break
+                    if _gate_conjunct(test):
+                        ok = True       # conjunct falls through to 404
+                        break
+                if not ok:
+                    findings.append(Finding(
+                        rule="http-gated-no-404", file=r.file,
+                        line=r.line, key=f"{r.cls}:{path}",
+                        message=f"{r.cls}.do_{r.method} serves gated "
+                                f"endpoint {path!r} (gate {gate!r}) "
+                                f"with no 404-when-off branch — "
+                                f"disabled mode must answer 404, not "
+                                f"serve the subsystem"))
+
+    # -- registry entries nothing serves -------------------------------------
+    for path in sorted(endpoints):
+        if not any(r.path == path or fnmatch.fnmatch(r.path, path)
+                   for r in routes):
+            findings.append(Finding(
+                rule="route-unserved",
+                file="bigdl_tpu/analysis/registries.py", line=0,
+                key=path,
+                message=f"HTTP_ENDPOINTS declares {path!r} but no "
+                        f"surface serves it — delete the entry or the "
+                        f"endpoint regressed away"))
+
+    # -- client calls nothing handles ----------------------------------------
+    for path, (file, line) in sorted(clients.items()):
+        if not _match(path, routes):
+            findings.append(Finding(
+                rule="http-client-unhandled", file=file, line=line,
+                key=path,
+                message=f"client call to {path!r} matches no served "
+                        f"route on any surface — a guaranteed 404"))
+    return findings

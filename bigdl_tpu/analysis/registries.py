@@ -41,6 +41,21 @@ FAULT_SITES: Dict[str, str] = {}
 
 #: pytest markers — must mirror ``tests/conftest.py``.
 PYTEST_MARKERS: Dict[str, str] = {}
+
+#: feature gates (ISSUE 13): conf keys whose FALSE value must make a
+#: subsystem structurally absent. ``package`` names the gated code (a
+#: directory or single module, repo-relative) — None for the pervasive
+#: planes whose gating is runtime state rather than construction. The
+#: gatecheck pass enforces default-off, no import-time side effects in
+#: the package, gate-guarded construction from outside it, and the
+#: existence of a disabled-mode test.
+FEATURE_GATES: Dict[str, dict] = {}
+
+#: HTTP endpoints served by the five hand-rolled surfaces. Keys may end
+#: in ``*`` (prefix routes). ``gate`` names the feature gate that must
+#: 404 the endpoint when off; ``gate404: "helper"`` marks routes whose
+#: 404-when-off lives inside a shared helper (tracing.debug_endpoint).
+HTTP_ENDPOINTS: Dict[str, dict] = {}
 CONF_KEYS.update({
     "bigdl.analysis.lockwatch":
         "runtime lock-order witness for chaos runs; off = stock lock factories",
@@ -435,6 +450,95 @@ FAULT_SITES.update({
         "HTTP /predict admission",
     "worker.stall":
         "hung engine decode step (ISSUE 7)",
+})
+
+FEATURE_GATES.update({
+    "bigdl.analysis.lockwatch": {
+        "package": "bigdl_tpu/analysis/lockwatch.py",
+        "desc": "runtime lock-order witness; off = stock lock factories"},
+    "bigdl.elastic.enabled": {
+        "package": "bigdl_tpu/elastic",
+        "desc": "elastic training: supervisor/agent/snapshot ring"},
+    "bigdl.llm.failover.enabled": {
+        "package": "bigdl_tpu/llm/failover.py",
+        "desc": "router journal + prober + resume machinery"},
+    "bigdl.llm.hedge.enabled": {
+        "package": "bigdl_tpu/llm/failover.py",
+        "desc": "hedged dispatch (shares the failover module)"},
+    "bigdl.llm.kvcache.enabled": {
+        "package": "bigdl_tpu/llm/kvcache",
+        "desc": "radix prefix index + refcounted page pool"},
+    "bigdl.llm.kvtier.enabled": {
+        "package": "bigdl_tpu/llm/kvtier",
+        "desc": "host-RAM arena + async migration + handoff"},
+    "bigdl.observability.enabled": {
+        "package": None,            # pervasive: runtime-gated via _state
+        "desc": "metrics + spans; no-op instruments when off"},
+    "bigdl.observability.federation": {
+        "package": "bigdl_tpu/observability/federation.py",
+        "desc": "fleet collector + snapshot endpoints"},
+    "bigdl.reliability.enabled": {
+        "package": None,            # pervasive: runtime-gated via _state
+        "desc": "fault sites + retry/deadline/breaker policies"},
+    "bigdl.slo.enabled": {
+        "package": "bigdl_tpu/observability/slo.py",
+        "desc": "per-request TTFT/ITL accounting"},
+})
+
+HTTP_ENDPOINTS.update({
+    "/backends": {
+        "methods": ("POST",), "gate": "bigdl.llm.failover.enabled",
+        "desc": "live router pool membership (add/remove backends)"},
+    "/debug/kvcache": {
+        "methods": ("GET",), "gate": "bigdl.llm.kvcache.enabled",
+        "desc": "prefix-cache pool/radix/tier state"},
+    "/debug/trace/*": {
+        "methods": ("GET",), "gate": "bigdl.observability.enabled",
+        "gate404": "helper",
+        "desc": "assembled spans + stage rollup for one trace id"},
+    "/debug/traces": {
+        "methods": ("GET",), "gate": "bigdl.observability.enabled",
+        "gate404": "helper",
+        "desc": "slowest-N latency exemplars"},
+    "/elastic/heartbeat": {
+        "methods": ("POST",),
+        "desc": "agent->supervisor beat (membership + commit floor)"},
+    "/elastic/status": {
+        "methods": ("GET",),
+        "desc": "supervisor membership/state/commit-floor view"},
+    "/fleet/status": {
+        "methods": ("GET",), "gate": "bigdl.observability.federation",
+        "desc": "fleet collector member/staleness status"},
+    "/healthz": {
+        "methods": ("GET",),
+        "desc": "liveness + checks (503 = drain/stall/restarting)"},
+    "/metrics": {
+        "methods": ("GET",),
+        "desc": "Prometheus exposition (fleet-merged when federated)"},
+    "/metrics.json": {
+        "methods": ("GET",),
+        "desc": "legacy JSON counters on ServingFrontend"},
+    "/metrics/snapshot": {
+        "methods": ("GET",), "gate": "bigdl.observability.federation",
+        "desc": "full registry JSON for the fleet collector's merge"},
+    "/predict": {
+        "methods": ("POST",),
+        "desc": "ServingFrontend inference request"},
+    "/worker_generate": {
+        "methods": ("POST",),
+        "desc": "blocking generate on worker and router"},
+    "/worker_generate_stream": {
+        "methods": ("POST",),
+        "desc": "chunked streaming generate (failover drain path)"},
+    "/worker_get_status": {
+        "methods": ("GET",),
+        "desc": "model/role/queue/speed worker status"},
+    "/worker_import_chain": {
+        "methods": ("POST",),
+        "desc": "land a serialized KV handoff blob (disaggregation)"},
+    "/worker_prefill": {
+        "methods": ("POST",),
+        "desc": "prefill-role side of the KV handoff"},
 })
 
 PYTEST_MARKERS.update({

@@ -925,6 +925,12 @@ class TestDisabledStructurallyAbsent:
         before = set(obs.render().splitlines()) if obs.enabled() else set()
         r = LLMRouter([], [w.address], start_prober=False).start()
         try:
+            # the gates themselves default off (the gatecheck pass's
+            # absence-test contract names the conf keys explicitly)
+            assert conf.get_bool("bigdl.llm.failover.enabled",
+                                 False) is False
+            assert conf.get_bool("bigdl.llm.hedge.enabled",
+                                 False) is False
             assert not r._active and not r.failover_enabled
             assert r._journal is None
             assert r._prober is None

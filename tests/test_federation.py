@@ -381,6 +381,8 @@ class TestEngineSLO:
         srv = LLMServer(model, max_batch=2, max_seq_len=64,
                         page_size=8).start()
         try:
+            # the gate defaults off (gatecheck absence-test contract)
+            assert conf.get_bool("bigdl.slo.enabled", False) is False
             assert srv._slo is None
             before = set(obs.render().splitlines())
             rs = np.random.RandomState(0)

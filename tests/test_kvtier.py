@@ -432,6 +432,39 @@ class TestRouterDisaggregated:
             pf_srv.stop()
             de_srv.stop()
 
+    def test_import_chain_endpoint_direct(self, model, sync_tier):
+        """`POST /worker_import_chain` exercised through the HTTP
+        surface itself (the router path covers it indirectly): a blob
+        exported engine-side lands via the worker and reports its page
+        count; malformed payloads answer 400."""
+        import base64
+
+        from bigdl_tpu.llm.worker import LLMWorker
+        prompt = np.arange(1, 21, dtype=np.int32)      # 2 full pages
+        a = LLMServer(model, max_batch=2, max_seq_len=64,
+                      page_size=PAGE, kvcache=True, kvtier=True).start()
+        b_srv = LLMServer(model, max_batch=2, max_seq_len=64,
+                          page_size=PAGE, kvcache=True,
+                          kvtier=True).start()
+        w = LLMWorker(b_srv, role="decode").start()
+        try:
+            a.submit(prompt, max_new_tokens=1).get(timeout=600)
+            blob = a.export_chain(prompt)
+            st, body, _ = _req(
+                w.address, "POST", "/worker_import_chain",
+                {"handoff": base64.b64encode(blob).decode()})
+            assert st == 200, body
+            assert body["imported_pages"] == len(prompt) // PAGE
+            assert b_srv._tier.handoffs_in == 1
+            st, body, _ = _req(w.address, "POST",
+                               "/worker_import_chain",
+                               {"handoff": "!!!not-base64"})
+            assert st == 400
+        finally:
+            w.stop()
+            a.stop()
+            b_srv.stop()
+
     def test_router_relays_decode_shed_without_tripping_breaker(self):
         """A 503 from a decode backend is backpressure, not death: the
         router must relay it with Retry-After and keep the breaker
@@ -544,6 +577,8 @@ class TestTierFlows:
 class TestDisabledMode:
     def test_no_tier_no_series_no_debug_block(self, model):
         from bigdl_tpu import observability as obs
+        # the gate defaults off (gatecheck absence-test contract)
+        assert conf.get_bool("bigdl.llm.kvtier.enabled", False) is False
         # registry is process-global (earlier enabled-mode tests minted
         # bigdl_kvtier_* series), so structural absence is a DELTA: a
         # tier-off server must declare nothing new

@@ -179,6 +179,19 @@ def _flatten_full(rec: dict) -> Dict[str, float]:
         val = rb.get(field)
         if isinstance(val, (int, float)) and not isinstance(val, bool):
             flat[f"ragged.{field}"] = float(val)
+    # ISSUE 13: the static-analysis gate's per-pass finding counts — a
+    # pass whose total creeps up between rounds means new baselined (or
+    # worse, about-to-be-baselined) findings; surface the drift next to
+    # the perf metrics instead of inside a JSON blob nobody diffs
+    sa = (((rec.get("extra") or {}).get("telemetry") or {})
+          .get("static_analysis") or {})
+    for p, n in (sa.get("by_pass") or {}).items():
+        if isinstance(n, (int, float)) and not isinstance(n, bool):
+            flat[f"analysis.findings.{p}"] = float(n)
+    for field in ("new", "suppressed", "stale_baseline"):
+        val = sa.get(field)
+        if isinstance(val, (int, float)) and not isinstance(val, bool):
+            flat[f"analysis.{field}"] = float(val)
     # ISSUE 12: the fleet telemetry plane's merged sketch percentiles —
     # client-visible tail latency through the federated router. A
     # regression in p99 TTFT or inter-token latency between rounds is
@@ -227,7 +240,12 @@ def compare(base_path: str, head_path: str,
     for name in sorted(set(base) & set(head)):
         b, h = base[name], head[name]
         pct = (h - b) / abs(b) * 100.0 if b else None
-        warn = pct is not None and abs(pct) >= warn_pct
+        # a zero base has no percentage, but 0 -> N is never noise: a
+        # pass gaining its first findings (analysis.findings.*), dense
+        # staging reappearing from 0 — exactly the regressions the
+        # zero-valued metrics exist to catch
+        warn = (pct is not None and abs(pct) >= warn_pct) or \
+            (b == 0 and h != 0)
         deltas[name] = {"base": b, "head": h,
                         "pct": round(pct, 2) if pct is not None else None,
                         "warn": warn}

@@ -1,11 +1,14 @@
-"""Static-analysis CI gate (ISSUE 11): run the three AST passes over
+"""Static-analysis CI gate (ISSUE 11 + 13): run the six AST passes over
 ``bigdl_tpu/`` and fail on any finding the checked-in baseline does not
 suppress.
 
 Usage:
     python tools/check_static.py                  # the gate: 0 = clean
     python tools/check_static.py --json           # machine-readable
-    python tools/check_static.py --passes hotpath # one pass only
+    python tools/check_static.py --only donation  # one pass (triage)
+    python tools/check_static.py --passes hotpath,gatecheck
+    python tools/check_static.py --sarif          # SARIF 2.1.0 -> stdout
+    python tools/check_static.py --sarif-out f.sarif
     python tools/check_static.py --write-baseline --justify "..."
                                                   # absorb current NEW
                                                   # findings (triage!)
@@ -18,7 +21,11 @@ Exit codes: 0 clean; 1 unbaselined findings; 2 baseline hygiene errors
 
 The analyzer imports nothing from the analyzed code — this script
 loads ``bigdl_tpu/analysis`` as a standalone package, so the gate runs
-without jax in milliseconds (CI pre-commit friendly).
+without jax in a few seconds (CI pre-commit friendly; all six passes
+share one parsed-AST index, see ``analysis.run_analysis``). The SARIF
+output carries rule ids, file:line regions, the stable fingerprint and
+— for baselined findings — a suppression with the triage justification,
+so CI can annotate diffs.
 """
 
 from __future__ import annotations
@@ -45,8 +52,16 @@ def main():
     ap.add_argument("--passes", default=",".join(analysis.PASSES),
                     help="comma-separated subset of "
                          f"{analysis.PASSES}")
+    ap.add_argument("--only", default=None, metavar="PASS",
+                    help="run a single pass (triage shorthand for "
+                         "--passes PASS)")
     ap.add_argument("--json", action="store_true",
                     help="print the full summary record as JSON")
+    ap.add_argument("--sarif", action="store_true",
+                    help="print SARIF 2.1.0 to stdout instead of the "
+                         "human summary")
+    ap.add_argument("--sarif-out", default=None, metavar="PATH",
+                    help="also write SARIF 2.1.0 to PATH")
     ap.add_argument("--write-baseline", action="store_true",
                     help="add every currently-NEW finding to the "
                          "baseline (requires --justify)")
@@ -67,7 +82,15 @@ def main():
         print(json.dumps(lock_graph(idx), indent=1))
         return 0
 
-    passes = tuple(p.strip() for p in args.passes.split(",") if p.strip())
+    if args.only:
+        if args.only not in analysis.PASSES:
+            print(f"--only {args.only!r}: unknown pass "
+                  f"(choose from {analysis.PASSES})", file=sys.stderr)
+            return 2
+        passes = (args.only,)
+    else:
+        passes = tuple(p.strip() for p in args.passes.split(",")
+                       if p.strip())
     baseline_path = args.baseline or os.path.join(
         args.root, analysis.BASELINE_RELPATH)
 
@@ -85,8 +108,12 @@ def main():
         print(f"baselined {len(new)} finding(s) -> {baseline_path}")
         return 0
 
+    findings = None
+    if args.sarif or args.sarif_out:
+        # one analysis run feeds both the summary and the SARIF view
+        findings = analysis.run_analysis(args.root, passes=passes)
     out = analysis.check(args.root, baseline_path=baseline_path,
-                         passes=passes)
+                         passes=passes, findings=findings)
 
     if args.prune and out["stale_baseline"]:
         bl = Baseline.load(baseline_path)
@@ -96,9 +123,17 @@ def main():
               f"entr(y/ies)")
         out["stale_baseline"] = []
 
+    if args.sarif or args.sarif_out:
+        doc = _sarif(args.root, passes, baseline_path, findings)
+        if args.sarif_out:
+            with open(args.sarif_out, "w") as f:
+                json.dump(doc, f, indent=1)
+                f.write("\n")
+        if args.sarif:
+            print(json.dumps(doc, indent=1))
     if args.json:
         print(json.dumps(out, indent=1))
-    else:
+    elif not args.sarif:
         _print_human(out)
 
     if out["baseline_errors"]:
@@ -110,9 +145,61 @@ def main():
     return 0
 
 
+def _sarif(root: str, passes, baseline_path: str,
+           findings=None) -> dict:
+    """Minimal SARIF 2.1.0: one run, one result per finding. Baselined
+    findings carry a ``suppressions`` entry whose justification is the
+    triage note from baseline.json — CI diff annotators can show new
+    findings loud and suppressed ones dimmed."""
+    if findings is None:
+        findings = analysis.run_analysis(root, passes=passes)
+    bl = Baseline.load(baseline_path)
+    rules = sorted({f.rule for f in findings} |
+                   {r for p in passes
+                    for r in analysis.PASS_RULES.get(p, ())})
+    results = []
+    for f in findings:
+        entry = bl.entries.get(f.fingerprint)
+        res = {
+            "ruleId": f.rule,
+            "level": "note" if entry else "warning",
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.file},
+                    "region": {"startLine": max(f.line, 1)},
+                }}],
+            "fingerprints": {"bigdlAnalysis/v1": f.fingerprint},
+        }
+        if entry:
+            res["suppressions"] = [{
+                "kind": "external",
+                "justification": entry.justification}]
+        results.append(res)
+    return {
+        "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
+                    "sarif-spec/master/Schemata/sarif-schema-2.1.0.json"),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "bigdl-tpu-check-static",
+                "informationUri": "docs/ANALYSIS.md",
+                "rules": [{"id": r,
+                           "properties": {
+                               "pass": analysis.RULE_TO_PASS.get(r, "")}}
+                          for r in rules],
+            }},
+            "results": results,
+        }],
+    }
+
+
 def _print_human(out: dict):
     print(f"check_static: {out['total']} finding(s) total, "
           f"{out['suppressed']} baselined, {len(out['new'])} NEW")
+    if out.get("by_pass"):
+        print("  per pass: " + "  ".join(
+            f"{p}={n}" for p, n in out["by_pass"].items()))
     if out["by_rule"]:
         width = max(len(r) for r in out["by_rule"])
         for rule, n in out["by_rule"].items():
