@@ -900,6 +900,23 @@ def _compact_northstar(out: dict) -> dict:
             "staged_on": rb.get("dense_staged_tokens_on"),
             "speedup": rb.get("ttft_speedup"),
         }
+    # ISSUE 14: unified-dispatch headline — the decode stream's p99
+    # inter-token gap while a long prompt is admitted, split vs mixed
+    # (the spike the chunked admission deletes), plus the TTFT trade
+    xb = ((ex.get("telemetry") or {}).get("mixed_dispatch") or {})
+    if "error" in xb:
+        ns["mixed_dispatch"] = {"error": str(xb["error"])[:80]}
+    else:
+        ns["mixed_dispatch"] = {
+            "itl_p99_off_ms": (xb.get("mixed_off") or {}).get(
+                "itl_p99_ms"),
+            "itl_p99_on_ms": (xb.get("mixed_on") or {}).get(
+                "itl_p99_ms"),
+            "ttft_off_ms": (xb.get("mixed_off") or {}).get("ttft_ms"),
+            "ttft_on_ms": (xb.get("mixed_on") or {}).get("ttft_ms"),
+            "chunks": (xb.get("mixed_on") or {}).get("chunks"),
+            "p99_ratio": xb.get("itl_p99_ratio_off_on"),
+        }
     return {"metric": out["metric"], "value": out["value"],
             "unit": out["unit"], "vs_baseline": out.get("vs_baseline"),
             "extra": {"northstar_summary": ns,
@@ -983,6 +1000,17 @@ def _telemetry_block() -> dict:
         out["microbench_ragged"] = run_ragged_bench()
     except Exception as e:
         out["microbench_ragged"] = {"error": repr(e)}
+    try:
+        # ISSUE 14: mixed-load microbench — steady decode streams with
+        # a long admission mid-run, unified dispatch off/on. The p99
+        # inter-token spike the split engine pays for the admission
+        # must be gone in the on mode (bench_regress diffs
+        # mixed.itl_p99_ms / mixed.ttft_ms and the off/on pairs)
+        from tools.microbench_mixed import run_mixed_bench
+        out["mixed_dispatch"] = run_mixed_bench(
+            prompt_len=192, stream_tokens=24)
+    except Exception as e:
+        out["mixed_dispatch"] = {"error": repr(e)}
     try:
         # ISSUE 12: the fleet telemetry plane — two live workers behind
         # a federation+SLO router; merged sketch percentiles

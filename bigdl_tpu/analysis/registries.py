@@ -111,6 +111,12 @@ CONF_KEYS.update({
         "inline migration (tests)",
     "bigdl.llm.pipeline_depth":
         "decode steps dispatched ahead of the host drain; 1 = synchronous",
+    "bigdl.llm.mixed.enabled":
+        "unified mixed prefill+decode dispatch: one compiled step serves decode rows + one prefill chunk",
+    "bigdl.llm.prefill.chunk.wait":
+        "seconds a budget-starved chunked admission waits before shedding with a clean rollback",
+    "bigdl.llm.prefill.chunk_tokens":
+        "page-aligned prefill chunk size for the unified dispatch; 0 = auto (4 pages)",
     "bigdl.llm.prefill.ragged":
         "prefill attends cached prefix pages in place; auto = on where Mosaic runs",
     "bigdl.llm.prober.interval":
@@ -262,8 +268,14 @@ METRICS.update({
         "Physical KV pages owned by live requests",
     "bigdl_llm_kv_pool_occupancy":
         "Fraction of the KV page pool in use (0..1)",
+    "bigdl_llm_pass_mix":
+        "Decode-row fraction of the last unified engine pass (1.0 = pure decode, 0.0 = chunk-only)",
+    "bigdl_llm_pass_rows_total":
+        "Rows served by unified engine passes, by kind (decode | prefill_chunk)",
     "bigdl_llm_pipeline_inflight":
         "Decode steps dispatched but not yet drained (bounded by bigdl.llm.pipeline_depth)",
+    "bigdl_llm_prefill_chunks_total":
+        "Prefill chunks dispatched by the unified mixed engine",
     "bigdl_llm_prefill_seconds":
         "Host wall of one request prefill (compile excluded after first hit per length bucket). At pipeline_depth 1 this covers execution (the prefill barriers); at depth > 1 it is DISPATCH time — execution overlaps decode by design",
     "bigdl_llm_prefill_tokens_total":
@@ -381,6 +393,8 @@ SPAN_NAMES.update({
         "KV chain serialized for disaggregated handoff",
     "llm/handoff_import":
         "KV handoff blob landed into pool/arena",
+    "llm/mixed_step":
+        "one unified mixed prefill+decode pass (decode rows + a chunk)",
     "llm/prefill":
         "prompt prefill (full/partial/ragged) on the engine",
     "llm/queue_wait":
@@ -430,6 +444,8 @@ FAULT_SITES.update({
         "host->HBM page fetch (ISSUE 6)",
     "kvtier.spill":
         "HBM->host page spill (ISSUE 6)",
+    "llm.chunk":
+        "between chunks of one chunked admission (ISSUE 14)",
     "llm.step":
         "LLM engine decode step",
     "llm.submit":
@@ -471,6 +487,14 @@ FEATURE_GATES.update({
     "bigdl.llm.kvtier.enabled": {
         "package": "bigdl_tpu/llm/kvtier",
         "desc": "host-RAM arena + async migration + handoff"},
+    "bigdl.llm.mixed.enabled": {
+        "package": None,            # lives inside the engine hot path:
+        "desc": "unified mixed prefill+decode dispatch with chunked "
+                "admission; off = the split engine exactly"},
+    "bigdl.llm.prefill.chunk_tokens": {
+        "package": None,            # tuning knob of the mixed gate
+        "desc": "chunk size for the unified dispatch (0 = 4 pages); "
+                "read only when bigdl.llm.mixed.enabled"},
     "bigdl.observability.enabled": {
         "package": None,            # pervasive: runtime-gated via _state
         "desc": "metrics + spans; no-op instruments when off"},
@@ -556,6 +580,8 @@ PYTEST_MARKERS.update({
         "prefix-aware KV-cache subsystem tests",
     "kvtier":
         "tiered KV-cache (host arena / migration / handoff) tests",
+    "mixed":
+        "unified mixed prefill+decode dispatch tests (ISSUE 14)",
     "perf":
         "performance microbenchmarks (advisory on shared hosts)",
     "slo":

@@ -281,20 +281,34 @@ class KVCacheManager:
                     len(prompt_ids), max_new, matched),
                 "pages_free": self.pool.budget_avail,
                 "matched_tokens": matched_total,
+                # the device-only match (host chunks excluded) — the
+                # engine's chunked-admission decision needs the suffix
+                # it would actually chunk (ISSUE 14)
+                "matched_device": matched,
             }
 
-    def admit(self, prompt_ids, max_new: int) -> Optional[Admission]:
+    def admit(self, prompt_ids, max_new: int,
+              chunk_pages: Optional[int] = None) -> Optional[Admission]:
         """Look up the longest cached prefix, charge the suffix-only
         budget (+ pins for newly-adopted shared pages), take adoption
         refs, and pre-evict enough free pages for the prompt's own
         pages. Returns None when the budget cannot cover it (the
         engine's head-of-line wait). Raises only from the seeded
         ``kvcache.evict`` fault site, with NOTHING charged or adopted —
-        the engine retries the whole admission."""
+        the engine retries the whole admission.
+
+        ``chunk_pages`` (ISSUE 14, chunked admission): charge only that
+        many pages — the FIRST prefill chunk's — instead of the whole
+        worst case; later chunks extend the ledger incrementally via
+        :meth:`charge_chunk` and the final chunk tops up the decode
+        budget. The host tier is bypassed in this mode (the engine
+        routes arena-extending admissions through the unchunked path),
+        and pre-eviction covers only the first chunk's own pages."""
         T = len(prompt_ids)
         with self._lock:
             if not self.enabled:
-                charge = self.suffix_budget(T, max_new, 0)
+                charge = (chunk_pages if chunk_pages is not None
+                          else self.suffix_budget(T, max_new, 0))
                 if charge > self.pool.budget_avail:
                     return None
                 self.pool.charge(charge)
@@ -305,7 +319,7 @@ class KVCacheManager:
             # match; a host chunk always beats a device tail (>= one
             # full page vs < one), so the tail is dropped un-adopted
             host_chunks = []
-            if self.tier is not None:
+            if self.tier is not None and chunk_pages is None:
                 base = len(m.full_pages) * self.page
                 host_chunks = self.tier.arena.lookup_chunks(
                     prompt_ids, base, T - 1)
@@ -328,7 +342,8 @@ class KVCacheManager:
             if not m.tail_len:
                 m.tail_src = None
             n_fetch = len(host_chunks)
-            charge = self.suffix_budget(T, max_new, m.matched_len)
+            charge = (chunk_pages if chunk_pages is not None
+                      else self.suffix_budget(T, max_new, m.matched_len))
             adopt = list(m.full_pages)
             if m.tail_src is not None:
                 adopt.append(m.tail_src)
@@ -349,7 +364,8 @@ class KVCacheManager:
             adm.device_matched = (len(m.full_pages) * self.page
                                   if host_chunks else m.matched_len)
             try:
-                own_prompt = (_ceil_div(T, self.page)
+                own_prompt = (chunk_pages if chunk_pages is not None
+                              else _ceil_div(T, self.page)
                               - m.matched_len // self.page)
                 self.ensure_free(own_prompt)
             except BaseException:
@@ -397,6 +413,34 @@ class KVCacheManager:
             adm.fetch_reserved = 0
             adm.fetch = []
             adm.fetch_job = None
+
+    def charge_chunk(self, adm: Admission, n: int) -> bool:
+        """Extend a chunked admission's ledger charge by ``n`` pages —
+        the next prefill chunk's own pages, plus (at the final chunk)
+        the decode-budget top-up (ISSUE 14). False = the ledger cannot
+        cover it RIGHT NOW with nothing charged; the engine keeps
+        decoding and retries next pass, shedding (full rollback) after
+        its bounded wait so concurrent chunkers can never deadlock the
+        pool. Σ(chunk charges) over a completed admission equals the
+        unchunked worst-case charge exactly, so EOS release balances."""
+        if n <= 0:
+            return True
+        with self._lock:
+            if n > self.pool.budget_avail:
+                return False
+            self.pool.charge(n)
+            adm.charge += n
+            return True
+
+    def uncharge_chunk(self, adm: Admission, n: int):
+        """Return an unused chunk charge (a chunk dispatch that failed
+        after charging): the exact inverse of :meth:`charge_chunk`, so
+        the engine's pass retry starts from the pre-pass ledger."""
+        if n <= 0:
+            return
+        with self._lock:
+            self.pool.release(n)
+            adm.charge -= n
 
     def release_transient(self, adm: Admission):
         """Drop the COW fork source's transient ref/pin — safe as soon

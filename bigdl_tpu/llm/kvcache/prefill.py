@@ -123,6 +123,65 @@ def scatter_suffix_kv(k_pages, v_pages, phys, slots, k_new, v_new):
     return k_pages, v_pages
 
 
+def make_mixed_step(fam_step, fam_ragged):
+    """Lift a family ``(paged_decode_step, paged_prefill_ragged)`` pair
+    into the engine's UNIFIED mixed prefill+decode step (ISSUE 14).
+
+    The split engine compiles prefill and decode as separate programs,
+    so a long admission stalls every in-flight decode for a whole pass.
+    The lifted step fuses both legs into ONE compiled program per
+    chunk-suffix bucket — the Ragged-Paged-Attention batch shape (one
+    dispatch serving rows with suffix length 1 and rows with a chunk of
+    suffix tokens) realized by composition of the two proven per-family
+    bodies, so each leg's math is BIT-IDENTICAL to the program the
+    split engine would have run:
+
+    - the **chunk leg** runs first: exactly the family's
+      ``paged_prefill_ragged`` over the ``(1, bucket)`` chunk — COW
+      tail fork fused ahead of its layer scan, attention reading the
+      cached prefix (and earlier chunks) in place via the ragged
+      kernel, one post-scan scatter of the chunk's K/V into its own
+      pages. Its page writes are disjoint from every decode row's
+      (shared radix pages are never decode-written; the chunk's own
+      pages belong to no decode row), so leg order cannot change any
+      row's result;
+    - the **decode leg** is exactly the family's
+      ``make_sampled_step`` body: sample every active row's next token
+      from ``last`` on device, one token of forward+attend per row,
+      one post-scan scatter, lengths advanced for active rows. The
+      chunk's slot rides this leg MASKED INACTIVE (trash-page dummy
+      write), exactly like an empty slot in the split engine.
+
+    Returns ``(out, logits, k_pages, v_pages, new_lens, key, clast)``
+    — the sampled-ids ‖ fence vector (the fence data-depends on the
+    pools AFTER both legs' scatters, so one drain fetch bounds the
+    whole pass), the decode logits, and ``clast``: the chunk's
+    last-true-token logits, which the engine scatters into its ``last``
+    row when the final chunk completes the prompt (mid-prompt chunks
+    discard it). Compile-relevant shapes: the decode batch width and
+    the chunk bucket ``ctoks.shape[1]`` only — offsets, block tables
+    and scatter targets are runtime data, so the grid stays
+    O(suffix-buckets).
+    """
+    from bigdl_tpu.llm.kernels.sampling import make_sampled_step
+    sampled = make_sampled_step(fam_step)
+
+    def mixed_step(params, cfg, k_pages, v_pages, bt, lens, last,
+                   active, temperature, key, ctoks, clen, coff, cbt_row,
+                   cphys, cslots, fork_dst, fork_src, *, page: int,
+                   do_sample: bool = False, top_k: int = 0):
+        k_pages, v_pages, clast = fam_ragged(
+            params, cfg, k_pages, v_pages, ctoks, clen, coff, cbt_row,
+            cphys, cslots, fork_dst, fork_src, page=page)
+        out, logits, k_pages, v_pages, new_lens, key = sampled(
+            params, cfg, k_pages, v_pages, bt, lens, last, active,
+            temperature, key, page=page, do_sample=do_sample,
+            top_k=top_k)
+        return out, logits, k_pages, v_pages, new_lens, key, clast
+
+    return mixed_step
+
+
 def make_partial_prefill(forward_fn, init_cache_fn):
     """Lift a family ``forward``/``init_cache`` pair into the engine's
     partial-prefill shape.

@@ -957,6 +957,25 @@ def paged_prefill_ragged(params, cfg, k_pages, v_pages, toks, length,
     return k_pages, v_pages, last.astype(jnp.float32)
 
 
+def paged_step_mixed(params, cfg, k_pages, v_pages, bt, lens, last,
+                     active, temperature, key, ctoks, clen, coff,
+                     cbt_row, cphys, cslots, fork_dst, fork_src, *,
+                     page: int, do_sample: bool = False,
+                     top_k: int = 0):
+    """Unified mixed prefill+decode engine step (ISSUE 14): one
+    compiled program whose batch carries every active decode row PLUS
+    one suffix-prefill chunk — the composition of
+    :func:`serving.paged_decode_step` (sampled) and
+    :func:`paged_prefill_ragged`, see
+    :func:`bigdl_tpu.llm.kvcache.prefill.make_mixed_step`."""
+    from bigdl_tpu.llm.kvcache.prefill import make_mixed_step
+    from bigdl_tpu.llm.serving import paged_decode_step
+    return make_mixed_step(paged_decode_step, paged_prefill_ragged)(
+        params, cfg, k_pages, v_pages, bt, lens, last, active,
+        temperature, key, ctoks, clen, coff, cbt_row, cphys, cslots,
+        fork_dst, fork_src, page=page, do_sample=do_sample, top_k=top_k)
+
+
 # ---------------------------------------------------------------------------
 # generation facade
 # ---------------------------------------------------------------------------

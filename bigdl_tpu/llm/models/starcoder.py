@@ -299,6 +299,21 @@ def paged_prefill_ragged(params, cfg, k_pages, v_pages, toks, length,
     return k_pages, v_pages, last.astype(jnp.float32)
 
 
+def paged_step_mixed(params, cfg, k_pages, v_pages, bt, lens, last,
+                     active, temperature, key, ctoks, clen, coff,
+                     cbt_row, cphys, cslots, fork_dst, fork_src, *,
+                     page: int, do_sample: bool = False,
+                     top_k: int = 0):
+    """Unified mixed prefill+decode step (ISSUE 14) — the StarCoder
+    decode and ragged-chunk legs fused into one program (see
+    :func:`bigdl_tpu.llm.kvcache.prefill.make_mixed_step`)."""
+    from bigdl_tpu.llm.kvcache.prefill import make_mixed_step
+    return make_mixed_step(paged_decode_step, paged_prefill_ragged)(
+        params, cfg, k_pages, v_pages, bt, lens, last, active,
+        temperature, key, ctoks, clen, coff, cbt_row, cphys, cslots,
+        fork_dst, fork_src, page=page, do_sample=do_sample, top_k=top_k)
+
+
 class StarCoderForCausalLM(CausalLMFacade):
     """Generation facade — shared driver (see models._facade)."""
 

@@ -282,6 +282,11 @@ class Request:
         # exists
         self.t_last_token = 0.0
         self.itl_max = -1.0
+        # per-token drain stamps (ISSUE 14 microbenches): appended only
+        # when the SLO account exists — the exact fence-arrival clocks
+        # the ITL sketches observe, so tools can compute per-request
+        # gap percentiles without polling
+        self.t_tokens: List[float] = []
 
     def get(self, timeout: Optional[float] = None) -> List[int]:
         if not self.done.wait(timeout):
@@ -371,6 +376,26 @@ class LLMServer:
     serialized blob (see llm/worker.py's router). Disabled, no arena,
     no migration thread, no ``bigdl_kvtier_*`` series — bit-identical
     to the PR 5 engine. See docs/KVCACHE.md ("Host tier").
+
+    **Unified mixed prefill+decode dispatch (ISSUE 14,
+    ``bigdl.llm.mixed.enabled`` / ``mixed=`` ctor arg; default off;
+    needs the ragged prefill).** The two dispatch paths merge: a
+    prompt whose uncached suffix exceeds
+    ``bigdl.llm.prefill.chunk_tokens`` (``chunk_tokens=``; 0 = 4
+    pages) is fed in page-aligned chunks, each fused with the pass's
+    decode rows into ONE compiled step (the family's
+    ``paged_step_mixed`` — the sampled decode body and the ragged
+    chunk body verbatim, so each leg stays bit-identical to the split
+    program). A long admission therefore never stalls in-flight
+    decodes for a whole prefill pass — the mixed-load microbench's
+    stream p99 ITL no longer spikes at admission. Chunks charge the
+    page ledger incrementally (final chunk tops up the decode budget;
+    a chunk that cannot charge within ``bigdl.llm.prefill.chunk.wait``
+    / ``chunk_wait=`` seconds sheds with a complete rollback and a
+    retriable failure). Disabled: no chunk state, no
+    ``bigdl_llm_pass_*``/``bigdl_llm_prefill_chunks_total`` series —
+    the split engine exactly. See docs/PERFORMANCE.md ("Mixed
+    prefill+decode dispatch").
     """
 
     def __init__(self, model, max_batch: int = 4, max_seq_len: int = 256,
@@ -385,7 +410,10 @@ class LLMServer:
                  host_pages: Optional[int] = None,
                  watchdog_timeout: Optional[float] = None,
                  ragged_prefill: Optional[bool] = None,
-                 slo: Optional[bool] = None):
+                 slo: Optional[bool] = None,
+                 mixed: Optional[bool] = None,
+                 chunk_tokens: Optional[int] = None,
+                 chunk_wait: Optional[float] = None):
         import inspect
 
         from bigdl_tpu.llm.models.llama import forward, init_cache
@@ -406,6 +434,7 @@ class LLMServer:
             self._fam_sampled_step = paged_decode_step_sampled
             self._fam_partial_prefill = _llama_mod.paged_prefill_partial
             self._fam_ragged_prefill = _llama_mod.paged_prefill_ragged
+            self._fam_mixed_step = _llama_mod.paged_step_mixed
             self._family = "llama"
         else:
             self._fam_forward = fam_forward
@@ -423,6 +452,8 @@ class LLMServer:
                 fam_mod, "paged_prefill_partial", None)
             self._fam_ragged_prefill = getattr(
                 fam_mod, "paged_prefill_ragged", None)
+            self._fam_mixed_step = getattr(
+                fam_mod, "paged_step_mixed", None)
             self._family = fam_mod.__name__.rsplit(".", 1)[-1]
             if paged and self._fam_paged_step is None:
                 raise NotImplementedError(
@@ -484,6 +515,14 @@ class LLMServer:
         # suffix bucket). The ragged in-place path adds ZERO here —
         # tools/microbench_ragged.py asserts exactly that.
         self.prefill_dense_staged_tokens = 0
+        # unified-dispatch accounting (ISSUE 14, always-on plain ints):
+        # chunks dispatched and passes that fused decode rows with a
+        # prefill chunk — tools/microbench_mixed.py and the parity
+        # tests read these without observability
+        self.prefill_chunks_total = 0
+        self.mixed_passes = 0
+        self._mixed_ins = None
+        self._chunk_rr = 0
         # ISSUE 3 flight recorder: every jit entry point of the engine
         # is wrapped so compiles/recompiles (the per-length prefill
         # buckets, a batch-width drift on the decode step) are counted,
@@ -564,6 +603,36 @@ class LLMServer:
                 else:
                     rag = conf.get_bool("bigdl.llm.prefill.ragged")
             self._ragged = rag and self._fam_ragged_prefill is not None
+            # unified mixed prefill+decode dispatch (ISSUE 14): one
+            # compiled step serves every active decode row PLUS one
+            # page-aligned prefill chunk, so a long admission is fed in
+            # chunk_tokens slices interleaved with decode instead of
+            # monopolizing a pass. Chunking needs the ragged in-place
+            # prefill (the chunk attends the prefix and its own earlier
+            # chunks where they sit in the pool): under the dense
+            # escape hatch (bigdl.llm.prefill.ragged=false) the gate is
+            # inert and admissions prefill whole through the split
+            # paths — documented + tested, see docs/PERFORMANCE.md.
+            mx = (mixed if mixed is not None else
+                  conf.get_bool("bigdl.llm.mixed.enabled", False))
+            self._mixed = bool(mx)
+            ct = (chunk_tokens if chunk_tokens is not None else
+                  conf.get_int("bigdl.llm.prefill.chunk_tokens", 0))
+            if ct <= 0:
+                ct = 4 * page_size          # "a few pages" default
+            self._chunk_tokens = max(
+                page_size, -(-ct // page_size) * page_size)
+            self._chunk_wait = (
+                chunk_wait if chunk_wait is not None else
+                conf.get_float("bigdl.llm.prefill.chunk.wait", 30.0))
+            self._mixed_active = (self._mixed and self._ragged
+                                  and self._fam_mixed_step is not None)
+            # per-slot chunked-admission state (None entries = slot not
+            # chunking); the list itself exists only when the unified
+            # dispatch is live — bigdl.llm.mixed.enabled off keeps the
+            # engine structurally identical to the split one
+            self._chunk_state: Optional[List[Optional[dict]]] = (
+                [None] * max_batch if self._mixed_active else None)
             self._kv = KVCacheManager(self._num_pages, page_size,
                                       enabled=bool(kv_on))
             # host spill tier (ISSUE 6): constructed ONLY when enabled —
@@ -611,6 +680,12 @@ class LLMServer:
             if kvtier:
                 raise ValueError("the host tier is page-pool only; "
                                  "the slot-static cache has no pages")
+            if mixed:
+                raise ValueError("unified mixed dispatch is page-pool "
+                                 "only; the slot-static cache has no "
+                                 "chunked prefill")
+            self._mixed = self._mixed_active = False
+            self._chunk_state = None
             self._kv = None       # the slot-static cache has no pages
             self._tier = None
             self._fetch_wait, self._fetch_ready = [], []
@@ -625,8 +700,15 @@ class LLMServer:
     @property
     def pages_in_use(self) -> int:
         """Physical pages currently owned by live requests (the
-        proportional-HBM claim, testable)."""
-        return sum(len(p) for p in self._slot_pages) if self.paged else -1
+        proportional-HBM claim, testable) — including the partial
+        chains of chunked admissions still mid-prompt (ISSUE 14)."""
+        if not self.paged:
+            return -1
+        n = sum(len(p) for p in self._slot_pages)
+        if self._chunk_state is not None:
+            n += sum(len(st["own"]) for st in self._chunk_state
+                     if st is not None)
+        return n
 
     # the pool moved into the kvcache subsystem (ISSUE 5); these views
     # keep the embedded-pool names the tests and tools read
@@ -1068,7 +1150,16 @@ class LLMServer:
                     self._kv.ensure_free(own)
                 self._fetch_ready.pop(0)
                 self._slot_adm[i] = adm
-                self._prefill_admitted(i, req, adm)
+                # a landed fetch is indistinguishable from a device
+                # prefix hit: a still-long suffix chunks like any
+                # other, but its budget was fully charged at admit
+                # (the fetch pre-charge contract) — prepaid
+                self._prefill_admitted(
+                    i, req, adm,
+                    chunked=(self._mixed_active
+                             and len(req.prompt_ids) - adm.matched_len
+                             > self._chunk_tokens),
+                    prepaid=True)
                 return True
             # a budget-blocked head is HELD here (not re-queued: put()
             # appends, and clients submit concurrently, so
@@ -1086,14 +1177,48 @@ class LLMServer:
                 # nothing was charged for it yet
                 continue
             adm = None
+            chunked = False
             if self.paged:
                 t_lk = time.perf_counter()
+                chunk_first = None
+                if self._mixed_active and \
+                        len(req.prompt_ids) > self._chunk_tokens:
+                    # chunked-admission decision (ISSUE 14): a long
+                    # uncached DEVICE suffix is fed in page-aligned
+                    # chunks, charging only the first chunk now.
+                    # Arena-extending matches keep the unchunked fetch
+                    # path (their budget pre-charges at admit); the
+                    # peek→admit window is race-free — the engine
+                    # thread is the only index mutator. Prompts at or
+                    # under chunk_tokens skip the peek outright (no
+                    # second radix walk on the short-prompt hot path).
+                    pk = self._kv.peek(req.prompt_ids,
+                                       req.max_new_tokens)
+                    if pk["matched_tokens"] == pk["matched_device"] \
+                            and pk["pages_needed"] <= \
+                            self._num_pages - 1:
+                        # the pool-size guard keeps never-admittable
+                        # requests (cached prefix evicted since
+                        # submit) on the unchunked path, where admit
+                        # returns None and the permanent-failure
+                        # check below fires — a chunked admit would
+                        # loop charge→starve→"retriable" shed forever
+                        off0 = pk["matched_device"]
+                        suffix = len(req.prompt_ids) - off0
+                        if suffix > self._chunk_tokens:
+                            end0 = self._chunk_end(
+                                off0, len(req.prompt_ids))
+                            chunk_first = (-(-end0 // self._page)
+                                           - off0 // self._page)
                 try:
                     # lookup + suffix-only budget charge + adoption refs
                     # + pre-eviction for the prompt's own pages, in one
-                    # atomic manager call (ISSUE 5)
+                    # atomic manager call (ISSUE 5); chunked admissions
+                    # charge the first chunk only (ISSUE 14)
                     adm = self._kv.admit(req.prompt_ids,
-                                         req.max_new_tokens)
+                                         req.max_new_tokens,
+                                         chunk_pages=chunk_first)
+                    chunked = chunk_first is not None
                 except BaseException:
                     # injected kvcache.evict fault: nothing was charged
                     # or adopted — hold the head, let the loop retry
@@ -1130,12 +1255,16 @@ class LLMServer:
                          "t0": time.perf_counter()})
                     continue
                 self._slot_adm[i] = adm
-            self._prefill_admitted(i, req, adm)
+            self._prefill_admitted(i, req, adm, chunked=chunked)
             return True
 
-    def _prefill_admitted(self, i: int, req: Request, adm):
+    def _prefill_admitted(self, i: int, req: Request, adm,
+                          chunked: bool = False, prepaid: bool = False):
         """Prefill a request whose cache grant is already held (shared
-        tail of direct and fetch-parked admissions)."""
+        tail of direct and fetch-parked admissions). ``chunked`` routes
+        long-suffix admissions to the unified dispatch (ISSUE 14): no
+        model dispatch here — the prompt is fed chunk by chunk in
+        subsequent engine passes, interleaved with decode."""
         ctx = rc.from_wire(req.trace)
         if ctx is not None and req.submitted_at:
             # engine-side admission wait, parented to the submitter
@@ -1145,6 +1274,9 @@ class LLMServer:
                 "llm/queue_wait", req.submitted_at,
                 time.time() - req.submitted_at, trace=ctx.trace_id,
                 stage="queue", request=req.id, **args)
+        if chunked:
+            self._begin_chunked(i, req, adm, prepaid)
+            return
         t0 = time.perf_counter()
         try:
             with rc.activate(ctx), \
@@ -1545,6 +1677,392 @@ class LLMServer:
             self._kv.insert(req.prompt_ids[:nfull * self._page],
                             self._bt[i, :nfull])
 
+    # -- unified mixed prefill+decode dispatch (ISSUE 14) --------------------
+    def _chunk_end(self, off: int, T: int) -> int:
+        """Page-aligned end of the next chunk from offset ``off``: the
+        largest page multiple within ``chunk_tokens`` of ``off`` — so
+        every chunk after the first starts page-aligned and only the
+        final one (which runs to the prompt end) may end mid-page."""
+        end = ((off + self._chunk_tokens) // self._page) * self._page
+        return T if end >= T else max(end, off + 1)
+
+    def _begin_chunked(self, i: int, req: Request, adm, prepaid: bool):
+        """Admit a long-suffix request WITHOUT prefilling it: the
+        prompt is fed in page-aligned chunks by subsequent engine
+        passes (fused with decode rows — see ``_dispatch_mixed``), so
+        one admission never monopolizes a pass. The slot is held
+        (admission order and ``stop(drain=True)`` semantics preserved)
+        but stays decode-inactive until the final chunk lands.
+        ``prepaid`` admissions (host-tier fetches) charged their whole
+        budget at admit; everyone else charges chunk by chunk."""
+        self._chunk_state[i] = {
+            "req": req, "adm": adm, "off": adm.matched_len,
+            "row_pages": list(adm.shared_pages), "own": [],
+            "prepaid": prepaid, "first": True,
+            "t0": time.perf_counter(), "wait_t0": None,
+        }
+        self._slots[i] = req
+        self._remaining[i] = 0
+        self._slot_adm[i] = adm
+
+    def _chunk_slot(self) -> Optional[int]:
+        """Round-robin pick of ONE chunking slot to advance this pass —
+        the scheduler's per-pass prefill budget is a single chunk of at
+        most ``chunk_tokens`` tokens, so concurrent chunkers share the
+        engine fairly. Dead requests (aborted, watchdog-failed) roll
+        back here before they can waste a dispatch."""
+        if self._chunk_state is None:
+            return None
+        n = self.max_batch
+        for k in range(n):
+            i = (self._chunk_rr + k) % n
+            st = self._chunk_state[i]
+            if st is None:
+                continue
+            if st["req"].cancel_requested or st["req"].done.is_set():
+                self._rollback_chunk(i, None)
+                continue
+            self._chunk_rr = (i + 1) % n
+            return i
+        return None
+
+    def _prepare_chunk(self, i: int) -> Optional[dict]:
+        """Ledger charge + operand build for slot ``i``'s next chunk.
+        None = nothing to dispatch this pass: the ``llm.chunk`` fault
+        fired (chain rolled back, request failed retriably) or the
+        ledger cannot cover the chunk yet — the engine keeps decoding
+        and retries next pass, shedding past ``chunk_wait`` so
+        concurrent chunkers can never deadlock the pool against each
+        other (each holds pages the others wait on)."""
+        st = self._chunk_state[i]
+        req, adm = st["req"], st["adm"]
+        page = self._page
+        T = len(req.prompt_ids)
+        off = st["off"]
+        if not st["first"]:
+            # the mid-admission fault site (ISSUE 14): a raise between
+            # chunks frees the partial chain and fails the request
+            # retriably — chaos_check --mixed proves a resubmission is
+            # then bit-identical
+            try:
+                reliability.inject("llm.chunk")
+            except BaseException as e:
+                self._rollback_chunk(
+                    i, f"chunked admission failed between chunks: "
+                       f"{type(e).__name__}: {e} (retriable: partial "
+                       "chain rolled back; resubmit)")
+                return None
+        end = self._chunk_end(off, T)
+        c = end - off
+        n_new = -(-end // page) - len(st["row_pages"])
+        final = end == T
+        need = n_new
+        if final and not st["prepaid"]:
+            # decode-budget top-up: every page the request may still
+            # need past its prompt — the reserve that keeps decode
+            # deadlock-free, charged at the last possible moment so
+            # Σ(admit + chunk charges) equals the unchunked worst case
+            # exactly (the first chunk never charges here: suffix >
+            # chunk_tokens means it never reaches the prompt end)
+            need += (-(-(T + req.max_new_tokens) // page)
+                     - (-(-T // page)))
+        # ledger FIRST: admit(chunk_pages=) already charged the FIRST
+        # chunk, and prepaid (fetch-path) admissions charged in full —
+        # only later chunks extend the charge here. A successful
+        # charge guarantees free+evictable covers n_new (allocated <=
+        # charged pool-wide), so the disabled-cache ensure_free can
+        # never hit its "shortage with the cache disabled" invariant.
+        # An ensure_free raise (the injected kvcache.evict) uncharges
+        # before propagating — the pass retry starts from a clean
+        # ledger.
+        charge_now = 0 if (st["prepaid"] or st["first"]) else need
+        if charge_now and not self._kv.charge_chunk(adm, charge_now):
+            now = time.perf_counter()
+            if st["wait_t0"] is None:
+                st["wait_t0"] = now
+            elif now - st["wait_t0"] > self._chunk_wait:
+                self._rollback_chunk(
+                    i, f"chunked admission starved: the ledger could "
+                       f"not cover the next {charge_now} pages within "
+                       f"{self._chunk_wait:g}s (retriable: partial "
+                       "chain rolled back; resubmit)")
+            return None
+        st["wait_t0"] = None
+        try:
+            if n_new > 0:
+                self._kv.ensure_free(n_new)
+            new_pages = self._kv.alloc(n_new) if n_new > 0 else []
+        except BaseException:
+            self._kv.uncharge_chunk(adm, charge_now)
+            raise
+        row_pages = st["row_pages"] + new_pages
+        tail = st["first"] and adm.tail_src is not None
+        bucket = max(page, 1 << (c - 1).bit_length())   # pow2 ladder
+        bt_row = np.zeros(self._pages_cap, np.int32)
+        bt_row[:len(row_pages)] = row_pages
+        # scatter targets for the window [off, off+bucket): positions
+        # past this chunk's end route to trash page 0 — their pages may
+        # not exist yet (they are a LATER chunk's)
+        pos = off + np.arange(bucket)
+        phys = np.where(pos < end,
+                        bt_row[np.minimum(pos // page,
+                                          self._pages_cap - 1)],
+                        0).astype(np.int32)
+        slots = (pos % page).astype(np.int32)
+        toks = np.zeros((1, bucket), np.int32)
+        toks[0, :c] = req.prompt_ids[off:end]
+        ops = (jnp.asarray(toks), jnp.asarray(c, jnp.int32),
+               jnp.asarray(off, jnp.int32), jnp.asarray(bt_row),
+               jnp.asarray(phys), jnp.asarray(slots),
+               jnp.asarray(new_pages[0] if tail else 0, jnp.int32),
+               jnp.asarray(adm.tail_src if tail else 0, jnp.int32))
+        return {"i": i, "c": c, "end": end, "final": final,
+                "bucket": bucket, "new_pages": new_pages,
+                "charged": charge_now, "ops": ops}
+
+    def _chunk_dispatched(self, cargs: dict, clast):
+        """Post-dispatch chunk bookkeeping (host side, overlapping the
+        device): advance the chunk cursor; on the FINAL chunk run the
+        ``_finish_prefill`` epilogue — the slot flips to an ordinary
+        decode row with the chunk-accumulated page chain. Runs AFTER
+        the pass's in-flight record is cut, so the epilogue's scatters
+        pin into the NEXT fence (or the depth-1 barrier here), never
+        the already-sealed record's."""
+        i = cargs["i"]
+        st = self._chunk_state[i]
+        req, adm = st["req"], st["adm"]
+        st["row_pages"].extend(cargs["new_pages"])
+        st["own"].extend(cargs["new_pages"])
+        st["off"] = cargs["end"]
+        if st["first"]:
+            st["first"] = False
+            # the fork copy consumed the tail source in dispatch order
+            # (the _prefill_ragged argument, unchanged)
+            self._kv.release_transient(adm)
+        c = cargs["c"]
+        self.prefill_tokens_total += c
+        self.prefill_chunks_total += 1
+        ins = self._instruments()
+        if ins is not None:
+            ins["prefill_tokens"].inc(c)
+        if not cargs["final"]:
+            if self.pipeline_depth == 1:
+                # synchronous cadence per chunk: pool writes resolve
+                # before their consumed buffers drop (the
+                # _finish_prefill contract)
+                _sync_barrier(self._k_pages, self._v_pages)
+                self._pending_release.clear()
+            return
+        # -- final chunk: the SHARED _finish_prefill epilogue (one copy
+        # — a fix to the pin set or barrier cadence cannot drift
+        # between the whole-prompt paths and this one). The tail ref
+        # was already dropped at the first chunk, so adm stays None.
+        self._chunk_state[i] = None
+        self._finish_prefill(i, req, st["row_pages"], st["own"], clast,
+                             ())
+        req.decode_started_at = time.time()
+        if ins is not None:
+            # admission→prompt-complete wall (decode passes interleave
+            # by design, so this is CHUNKED-prefill latency, not pure
+            # dispatch time — documented in docs/PERFORMANCE.md)
+            ins["prefill_seconds"].observe(
+                time.perf_counter() - st["t0"])
+            self._record_kv_gauges(ins)
+
+    def _rollback_chunk(self, i: int, msg: Optional[str]):
+        """Mid-prompt shed/abort/fault (ISSUE 14): free the partial
+        chain's pages and every ledger charge taken so far, drop the
+        adoption refs, fail the request retriably (``msg`` None =
+        already-dead handle, nothing to report). Pages a still-in-
+        flight chunk or mixed step reads are released at the newest
+        in-flight fence (the PR 4 pin invariant extended to chunk
+        chains); with nothing in flight, a barrier bounds any pending
+        bookkeeping first."""
+        st = self._chunk_state[i]
+        req, adm = st["req"], st["adm"]
+        self._kv.release_transient(adm)
+        entry = (adm.charge + adm.fetch_reserved, list(st["own"]),
+                 list(adm.shared_pages))
+        adm.charge = 0
+        adm.fetch_reserved = 0
+        adm.shared_pages = []
+        if self._pending_release:
+            # bookkeeping or a SOLO chunk dispatched AFTER the newest
+            # in-flight record may still read this chain's pages, and
+            # no record's fence bounds it — barrier on the current
+            # arrays (they data-depend on everything enqueued) before
+            # the pages go back. Rollback is rare; the stall is not.
+            try:
+                _sync_barrier(self._k_pages, self._v_pages,
+                              self._bt_dev, self._lens_dev,
+                              self._last)
+            except Exception:
+                pass
+            self._pending_release.clear()
+            self._kv.release_slot(*entry)
+        elif self._inflight:
+            # every dispatch touching the chain is inside the window:
+            # the newest fence bounds them all (in-order stream)
+            self._inflight[-1].setdefault("kv_release", []).append(
+                entry)
+        else:
+            self._kv.release_slot(*entry)
+        self._chunk_state[i] = None
+        self._slots[i] = None
+        self._remaining[i] = 0
+        self._slot_adm[i] = None
+        if msg is not None and not req.done.is_set():
+            req.error = msg
+            req.done.set()
+        ins = self._instruments()
+        if ins is not None:
+            ins["requests"].labels(
+                reason="cancelled" if msg is None else "error").inc()
+
+    def _build_mixed_step(self):
+        """Compile the family's unified mixed step for ONE chunk-suffix
+        bucket (the chunk operand shapes fix it): the decode leg is the
+        family sampled step VERBATIM, the chunk leg the family ragged
+        prefill VERBATIM — see ``kvcache.prefill.make_mixed_step``.
+        Offsets, block tables and scatter targets are runtime data, so
+        the mixed grid adds O(suffix-buckets) programs total (guarded
+        by the compile-recorder test in tests/test_mixed_dispatch.py)."""
+        cfg, page = self.cfg, self._page
+        fam = self._fam_mixed_step
+        do_sample, top_k = self._do_sample, self.top_k
+
+        def step(params, k_pages, v_pages, bt, lens, last, active,
+                 temp, key, ctoks, clen, coff, cbt_row, cphys, cslots,
+                 fork_dst, fork_src):
+            return fam(params, cfg, k_pages, v_pages, bt, lens, last,
+                       active, temp, key, ctoks, clen, coff, cbt_row,
+                       cphys, cslots, fork_dst, fork_src, page=page,
+                       do_sample=do_sample, top_k=top_k)
+
+        return obs.compiled(step, name="llm/step_mixed",
+                            donate_argnums=(1, 2))
+
+    def _mixed_instruments(self):
+        """Unified-dispatch pass metrics — None unless the mixed gate
+        is live AND observability records. ``bigdl.llm.mixed.enabled``
+        off must leave no ``bigdl_llm_pass_rows_total`` /
+        ``bigdl_llm_prefill_chunks_total`` / ``bigdl_llm_pass_mix``
+        series (the disabled-mode absence contract)."""
+        if not (self._mixed_active and obs.enabled()):
+            return None
+        if self._mixed_ins is None:
+            self._mixed_ins = {
+                "pass_rows": obs.counter(
+                    "bigdl_llm_pass_rows_total",
+                    "Rows served by unified engine passes, by kind",
+                    labelnames=("kind",)),
+                "chunks": obs.counter(
+                    "bigdl_llm_prefill_chunks_total",
+                    "Prefill chunks dispatched by the unified engine"),
+                "mix": obs.gauge(
+                    "bigdl_llm_pass_mix",
+                    "Decode-row fraction of the last unified pass "
+                    "(1.0 = pure decode, 0.0 = chunk-only)"),
+            }
+        return self._mixed_ins
+
+    def _record_mixed_pass(self, n_decode: int, cargs: dict,
+                           t_step: float):
+        """Per-pass batch-mix attribution (ISSUE 14 observability)."""
+        if n_decode:
+            self.mixed_passes += 1
+        ins = self._mixed_instruments()
+        if ins is None:
+            return
+        wall = time.perf_counter() - t_step
+        ins["pass_rows"].labels(kind="prefill_chunk").inc()
+        if n_decode:
+            ins["pass_rows"].labels(kind="decode").inc(n_decode)
+        ins["chunks"].inc()
+        ins["mix"].set(n_decode / (n_decode + 1))
+        obs.add_complete(
+            "llm/mixed_step", time.time() - wall, wall,
+            decode_rows=n_decode, chunk_tokens=cargs["c"],
+            offset=cargs["end"] - cargs["c"], final=cargs["final"],
+            slot=cargs["i"])
+
+    def _restore_chunk_pass(self, cargs: dict):
+        """A pass failed AFTER _prepare_chunk allocated/charged but
+        before (or at) the dispatch: restore the chunk's pages and
+        ledger exactly so the engine loop's pass retry re-prepares the
+        same chunk from a clean state (nothing in ``st`` advanced —
+        row_pages/own only extend in ``_chunk_dispatched``)."""
+        self._kv.free_owned(cargs["new_pages"])
+        self._kv.uncharge_chunk(self._chunk_state[cargs["i"]]["adm"],
+                                cargs["charged"])
+
+    def _dispatch_chunk_solo(self, cargs: dict, t_step: float):
+        """A chunk with no live decode rows to fuse with: dispatch it
+        through the per-bucket ragged-prefill program (identical chunk
+        math to the mixed program's chunk leg — the parity matrix
+        covers both routes) with prefill-style pinning/barriers."""
+        key = self._step_cache_key() + ("prefill_ragged",
+                                        cargs["bucket"])
+        fn = _PAGED_STEP_CACHE.get(key)
+        if fn is None:
+            fn = _PAGED_STEP_CACHE[key] = \
+                self._build_ragged_prefill(cargs["bucket"])
+        try:
+            self._k_pages, self._v_pages, clast = fn(
+                self.model.params, self._k_pages, self._v_pages,
+                *cargs["ops"])
+        except BaseException:
+            # dispatch failed before any state advanced: restore the
+            # chunk's ledger/pages exactly — the engine loop retries
+            # the whole pass, chunk included
+            self._restore_chunk_pass(cargs)
+            raise
+        self._pin(*cargs["ops"])
+        self._chunk_dispatched(cargs, clast)
+        self._record_mixed_pass(0, cargs, t_step)
+
+    def _dispatch_mixed(self, disp, active, cargs: dict,
+                        t_step: float) -> bool:
+        """One UNIFIED pass (the ISSUE 14 tentpole): every active
+        decode row plus one prefill chunk in a single compiled program
+        — the chunk no longer stalls the decode stream, and the
+        drain/fence machinery treats the pass exactly like a decode
+        pass (the chunk row emitted no token, so it drains an empty
+        slot)."""
+        key = self._step_cache_key() + ("mixed", cargs["bucket"],
+                                        self._do_sample, self.top_k)
+        pmixed = _PAGED_STEP_CACHE.get(key)
+        if pmixed is None:
+            pmixed = _PAGED_STEP_CACHE[key] = self._build_mixed_step()
+        bt_in, lens_in = self._bt_dev, self._lens_dev
+        last_in, key_in = self._last, self._sample_key
+        try:
+            out, logits, self._k_pages, self._v_pages, \
+                self._lens_dev, self._sample_key, clast = pmixed(
+                    self.model.params, self._k_pages, self._v_pages,
+                    bt_in, lens_in, last_in, active, self._temp,
+                    key_in, *cargs["ops"])
+        except BaseException:
+            self._restore_chunk_pass(cargs)
+            raise
+        self._last = logits
+        for i in disp:
+            self._lens[i] += 1
+            self._remaining[i] -= 1
+        rec = {"out": out,
+               "pairs": [(i, self._slots[i]) for i in disp],
+               "refs": (bt_in, lens_in, last_in, active, key_in)
+               + cargs["ops"],
+               "pinned": self._pending_release}
+        self._pending_release = []
+        # chunk bookkeeping AFTER the record is cut: the finalize
+        # epilogue's scatters dispatch behind this step, so their pins
+        # must ride the NEXT fence (or the depth-1 barrier inside
+        # _chunk_dispatched), never this record's
+        self._chunk_dispatched(cargs, clast)
+        self._record_mixed_pass(len(disp), cargs, t_step)
+        return self._after_dispatch(rec, t_step)
+
     def _build_paged_decode(self):
         """One pipelined decode step over the page pool — the family's
         ``paged_decode_step_sampled`` jitted with donated pools:
@@ -1680,6 +2198,8 @@ class LLMServer:
                 continue
             tok = int(vals[i])
             req.tokens.append(tok)
+            if self._slo is not None:
+                req.t_tokens.append(now)
             if len(req.tokens) == 1:
                 req.t_first_token = time.perf_counter()  # TTFT stamp
                 if self._slo is not None:
@@ -1763,31 +2283,57 @@ class LLMServer:
             self._pos_dev = self._pos_dev.at[i].set(0)
 
     def _step_paged(self) -> bool:
+        ci = self._chunk_slot()
         disp = self._dispatchable()
-        if not disp:
+        if not disp and ci is None:
             if self._inflight:   # nothing new to dispatch: keep draining
                 self._drain_next()
                 return True
             return False
         t_step = time.perf_counter()
+        cargs = None
+        if ci is not None:
+            # unified dispatch (ISSUE 14): this pass carries one
+            # prefill chunk — fused with the decode rows when any are
+            # live, solo through the ragged-prefill program otherwise.
+            # None = the chunk faulted (request already failed) or is
+            # budget-stalled (decode continues; the chunk retries)
+            cargs = self._prepare_chunk(ci)
+        if cargs is None and not disp:
+            if self._inflight:
+                self._drain_next()
+                return True
+            return False
+        if cargs is not None and not disp:
+            self._dispatch_chunk_solo(cargs, t_step)
+            return True
         page = self._page
         # the page for position lens[i] must exist before the step; the
         # grant is an incremental scatter into the device-resident block
         # table, not a re-upload (ISSUE 4). Under the prefix cache the
         # free list may be held by warm chains — pre-evict for ALL the
         # grants this step needs BEFORE mutating any table, so an
-        # injected kvcache.evict raise is cleanly retryable
-        boundary = sum(1 for i in disp if int(self._lens[i]) % page == 0)
-        if boundary:
-            self._kv.ensure_free(boundary)
-        allocs = []
-        for i in disp:
-            pos = int(self._lens[i])
-            if pos % page == 0:
-                pid = self._kv.take_free()  # guaranteed by the reserve
-                self._bt[i, pos // page] = pid
-                self._slot_pages[i].append(pid)
-                allocs.append((i, pos // page, pid))
+        # injected kvcache.evict raise is cleanly retryable. With a
+        # chunk prepared, a raise here must also restore the chunk's
+        # alloc/charge, or the retried pass re-prepares on top of
+        # orphaned pages.
+        try:
+            boundary = sum(1 for i in disp
+                           if int(self._lens[i]) % page == 0)
+            if boundary:
+                self._kv.ensure_free(boundary)
+            allocs = []
+            for i in disp:
+                pos = int(self._lens[i])
+                if pos % page == 0:
+                    pid = self._kv.take_free()  # guaranteed by reserve
+                    self._bt[i, pos // page] = pid
+                    self._slot_pages[i].append(pid)
+                    allocs.append((i, pos // page, pid))
+        except BaseException:
+            if cargs is not None:
+                self._restore_chunk_pass(cargs)
+            raise
         if allocs:
             rows, cols, vals = (np.asarray(v, np.int32)
                                 for v in zip(*allocs))
@@ -1797,6 +2343,15 @@ class LLMServer:
         mask = np.zeros(self.max_batch, bool)
         mask[disp] = True
         active = jnp.asarray(mask)
+        if cargs is not None:
+            return self._dispatch_mixed(disp, active, cargs, t_step)
+        if self._mixed_active:
+            # pure-decode pass on a unified server: the batch-mix
+            # series still tell the whole story
+            mins = self._mixed_instruments()
+            if mins is not None:
+                mins["pass_rows"].labels(kind="decode").inc(len(disp))
+                mins["mix"].set(1.0)
         key = self._step_cache_key() + ("decode", self._do_sample,
                                         self.top_k)
         pdecode = _PAGED_STEP_CACHE.get(key)

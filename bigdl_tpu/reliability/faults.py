@@ -47,6 +47,8 @@ SITES = (
     "serving.frontend.request",    # HTTP /predict admission
     "llm.submit",                  # LLMServer request admission
     "llm.step",                    # LLM engine decode step
+    "llm.chunk",                   # between chunks of one chunked
+                                   # admission (ISSUE 14)
     "kvcache.evict",               # prefix-cache LRU eviction (ISSUE 5)
     "kvtier.spill",                # HBM->host page spill (ISSUE 6)
     "kvtier.fetch",                # host->HBM page fetch (ISSUE 6)

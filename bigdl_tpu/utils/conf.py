@@ -75,6 +75,15 @@ _DEFAULTS: Dict[str, str] = {
     # force a path on any backend. false = the dense-staging prefill
     # paths exactly
     "bigdl.llm.prefill.ragged": "auto",
+    # unified mixed prefill+decode dispatch (ISSUE 14): one compiled
+    # engine step serves decode rows AND one page-aligned prefill
+    # chunk, so a long admission never stalls in-flight decodes for a
+    # whole pass. Requires the ragged in-place prefill (inert under
+    # the dense escape hatch). false = the split engine exactly
+    "bigdl.llm.mixed.enabled": "false",
+    "bigdl.llm.prefill.chunk_tokens": "0",    # 0 = auto (4 pages)
+    "bigdl.llm.prefill.chunk.wait": "30.0",   # budget-starved chunk ->
+                                              # shed + clean rollback
     # tiered KV cache (ISSUE 6): evicted chains spill to a pinned
     # host-RAM arena with async HBM<->host migration. Requires the
     # prefix cache; false = structurally absent (PR 5 engine exactly)

@@ -70,6 +70,11 @@ def pytest_configure(config):
         "markers",
         "slo: fleet telemetry plane tests (quantile sketches, metric "
         "federation, per-request SLO accounting; select with -m slo)")
+    config.addinivalue_line(
+        "markers",
+        "mixed: unified mixed prefill+decode dispatch tests (chunked "
+        "admission parity, ledger rollback, compile grid; select with "
+        "-m mixed)")
 
 
 @pytest.fixture(scope="session")

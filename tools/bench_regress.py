@@ -192,6 +192,22 @@ def _flatten_full(rec: dict) -> Dict[str, float]:
         val = sa.get(field)
         if isinstance(val, (int, float)) and not isinstance(val, bool):
             flat[f"analysis.{field}"] = float(val)
+    # ISSUE 14: the mixed-load microbench — the decode stream's ITL
+    # p99 and the long admission's TTFT, split vs unified dispatch.
+    # The headline keys (mixed.itl_p99_ms / mixed.ttft_ms) carry the
+    # ON mode — the number serving actually pays once the gate ships —
+    # and the off/on pairs keep the delta visible round over round
+    xb = (((rec.get("extra") or {}).get("telemetry") or {})
+          .get("mixed_dispatch") or {})
+    for mode in ("mixed_off", "mixed_on"):
+        for field in ("itl_p99_ms", "ttft_ms"):
+            val = _extra_field(xb.get(mode), field)
+            if val is not None:
+                flat[f"{mode}.{field}"] = val
+    for field in ("itl_p99_ms", "ttft_ms"):
+        val = _extra_field(xb.get("mixed_on"), field)
+        if val is not None:
+            flat[f"mixed.{field}"] = val
     # ISSUE 12: the fleet telemetry plane's merged sketch percentiles —
     # client-visible tail latency through the federated router. A
     # regression in p99 TTFT or inter-token latency between rounds is

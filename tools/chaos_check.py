@@ -270,6 +270,113 @@ def run_kvtier_chaos(seed: int = 0, n_groups: int = 4,
     return out
 
 
+def run_mixed_chaos(seed: int = 0, raises: int = 2) -> dict:
+    """ISSUE 14 satellite: drive chunked admissions through the unified
+    mixed engine with seeded ``llm.chunk`` faults armed — delays on
+    every chunk boundary to widen the interleaving windows, plus raises
+    that kill an admission MID-CHAIN. The contract under failure: the
+    partial chain's pages and ledger charges roll back completely (the
+    idle budget equals the clean run's), the request fails RETRIABLY,
+    and a resubmission produces greedy output token-identical to the
+    clean run."""
+    import numpy as np
+
+    from bigdl_tpu import reliability as rel
+    from bigdl_tpu.llm.models.llama import LlamaConfig, LlamaForCausalLM
+    from bigdl_tpu.llm.serving import LLMServer
+
+    model = LlamaForCausalLM.from_config(LlamaConfig.tiny(), seed=0,
+                                         max_cache_len=128)
+    rs = np.random.RandomState(seed)
+    shared = rs.randint(0, 250, 16).astype(np.int32)
+    prompts = [np.concatenate([shared,
+                               rs.randint(0, 250, 16 + 8 * (j % 2))
+                               .astype(np.int32)])
+               for j in range(3)]                      # 32/40-token, chunked
+    prompts.append(rs.randint(0, 250, 6).astype(np.int32))   # short
+
+    num_pages = 32
+
+    def serve_all(resubmit: bool):
+        srv = LLMServer(model, max_batch=2, max_seq_len=64, page_size=8,
+                        num_pages=num_pages, kvcache=True, mixed=True,
+                        chunk_tokens=8, ragged_prefill=True).start()
+        failed = 0
+        try:
+            reqs = [srv.submit(p, max_new_tokens=4) for p in prompts]
+            outs = []
+            for j, r in enumerate(reqs):
+                try:
+                    outs.append(list(map(int, r.get(timeout=300))))
+                except RuntimeError as e:
+                    if "retriable" not in str(e):
+                        raise
+                    failed += 1
+                    if not resubmit:
+                        raise
+                    r2 = srv.submit(prompts[j], max_new_tokens=4)
+                    outs.append(list(map(int, r2.get(timeout=300))))
+        finally:
+            srv.stop()
+        # read AFTER stop: the drain resolved every deferred fence
+        # release, so a nonzero delta is a real ledger leak
+        return (outs, failed, srv.prefill_chunks_total,
+                srv._budget_avail)
+
+    was_enabled = rel.enabled()
+    if not was_enabled:
+        rel.enable()
+    try:
+        clean, _, clean_chunks, clean_budget = serve_all(resubmit=False)
+        plan = rel.FaultPlan(seed=seed)
+        # first-match-wins: bounded raises kill admissions mid-chain,
+        # the unbounded delays stretch every other chunk boundary
+        plan.add("llm.chunk", "raise", times=raises, after=1)
+        plan.add("llm.chunk", "delay", times=None, delay=0.002)
+        rel.set_plan(plan)
+        try:
+            injected, failed, inj_chunks, inj_budget = \
+                serve_all(resubmit=True)
+        finally:
+            rel.set_plan(None)
+    finally:
+        if not was_enabled:
+            rel.disable()
+
+    match = injected == clean
+    out = {
+        "seed": seed,
+        "requests": len(prompts),
+        "clean_chunks": clean_chunks,
+        "injected_chunks": inj_chunks,
+        "failed_retriably": failed,
+        "clean_idle_budget": clean_budget,
+        "injected_idle_budget": inj_budget,
+        "events_fired": [f"{s}:{a}" for s, a in plan.fired],
+        "match": match,
+    }
+    if clean_chunks == 0:
+        raise AssertionError(
+            "mixed chaos: the clean run never chunked — prompts are "
+            "shorter than chunk_tokens; lengthen them")
+    if not any(s == "llm.chunk" for s, _ in plan.fired):
+        raise AssertionError(
+            "mixed chaos armed but no llm.chunk fault fired")
+    if failed == 0:
+        raise AssertionError(
+            "mixed chaos: no admission failed mid-chain — the raise "
+            "rule never landed between chunks")
+    if inj_budget != clean_budget or inj_budget != num_pages - 1:
+        raise AssertionError(
+            f"mixed chaos ledger leak: idle budget {inj_budget} vs "
+            f"clean {clean_budget} (pool {num_pages - 1})")
+    if not match:
+        raise AssertionError(
+            f"mixed chaos divergence under chunk faults "
+            f"(fired: {out['events_fired']}): {clean} vs {injected}")
+    return out
+
+
 def run_failover_chaos(seed: int = 0, n_requests: int = 4,
                        kills: int = 2, stalls: int = 1,
                        new_tokens: int = 5,
@@ -805,6 +912,7 @@ def run_all_chaos(seed: int = 0) -> dict:
                                                      smoke=True)),
                          ("kvcache", lambda: run_kvcache_chaos(seed=seed)),
                          ("kvtier", lambda: run_kvtier_chaos(seed=seed)),
+                         ("mixed", lambda: run_mixed_chaos(seed=seed)),
                          ("failover", lambda: run_failover_chaos(
                              seed=seed, smoke=True)),
                          ("elastic", lambda: run_elastic_chaos(
@@ -845,6 +953,13 @@ def main():
                     help="run the host-tier migration-fault pass: "
                          "delayed/failed spills and fetches must keep "
                          "greedy outputs identical (ISSUE 6)")
+    ap.add_argument("--mixed", action="store_true",
+                    help="run the chunked-admission fault pass: a "
+                         "seeded llm.chunk raise mid-chain must free "
+                         "the partial chain's pages/budget, fail the "
+                         "request retriably, and a resubmission must "
+                         "be greedy-identical to the clean run "
+                         "(ISSUE 14)")
     ap.add_argument("--failover", action="store_true",
                     help="run the router kill-storm pass: mid-stream "
                          "decode-worker kills and watchdog-tripping "
@@ -858,8 +973,9 @@ def main():
                          "run (ISSUE 10)")
     ap.add_argument("--all", action="store_true",
                     help="run every chaos suite (train, kvcache, "
-                         "kvtier, failover) and report one record per "
-                         "pass (the bench.py chaos_all block)")
+                         "kvtier, mixed, failover, elastic) and report "
+                         "one record per pass (the bench.py chaos_all "
+                         "block)")
     ap.add_argument("--cpu", action="store_true",
                     help="force the CPU backend (sitecustomize pins the "
                          "axon TPU platform; env vars are ineffective)")
@@ -875,6 +991,8 @@ def main():
         return
     if args.elastic:
         out = run_elastic_chaos(seed=args.seed)
+    elif args.mixed:
+        out = run_mixed_chaos(seed=args.seed)
     elif args.failover:
         out = run_failover_chaos(seed=args.seed)
     elif args.kvtier:

@@ -66,10 +66,15 @@ def run_microbench(depths: Iterable[int] = (1, 2, 4), batch: int = 4,
     out: Dict = {"batch": batch, "tokens": tokens,
                  "prompt_len": prompt_len, "paged": paged,
                  "model": model_size}
+    from bigdl_tpu.observability.sketch import QuantileSketch
     for depth in depths:
+        # slo=True makes the engine stamp every token's drain-fence
+        # arrival on the request handle (Request.t_tokens) — the exact
+        # gaps the bigdl_llm_itl_seconds sketch would observe, read
+        # here without touching the global registry
         srv = LLMServer(model, max_batch=batch, max_seq_len=max_seq,
                         paged=paged, page_size=page_size,
-                        pipeline_depth=depth).start()
+                        pipeline_depth=depth, slo=True).start()
         try:
             # warmup: compile prefill buckets + the decode step
             for r in [srv.submit(p, max_new_tokens=warmup_tokens)
@@ -83,6 +88,14 @@ def run_microbench(depths: Iterable[int] = (1, 2, 4), batch: int = 4,
             got = [r.get(timeout=600) for r in reqs]
             wall = time.perf_counter() - t0
             steps = srv.steps - steps0
+            # per-request inter-token gaps (ISSUE 14 satellite): the
+            # tail is the number mixed-dispatch work is judged on —
+            # a mean step_ms hides exactly the spikes that matter
+            sk = QuantileSketch()
+            for r in reqs:
+                for a, b in zip(r.t_tokens, r.t_tokens[1:]):
+                    sk.observe(b - a)
+            p50, p99 = sk.quantile(0.5), sk.quantile(0.99)
             out[f"depth{depth}"] = {
                 "step_ms": round(wall / max(steps, 1) * 1e3, 3),
                 "steps": steps,
@@ -93,6 +106,10 @@ def run_microbench(depths: Iterable[int] = (1, 2, 4), batch: int = 4,
                 "stall_ms_per_step": round(
                     (srv.stall_seconds - stall0) / max(steps, 1) * 1e3,
                     3),
+                "itl_p50_ms": (round(p50 * 1e3, 3)
+                               if p50 is not None else None),
+                "itl_p99_ms": (round(p99 * 1e3, 3)
+                               if p99 is not None else None),
             }
         finally:
             srv.stop()
@@ -132,6 +149,7 @@ def main(argv) -> int:
         print(f"  {k:<7} step={d['step_ms']:>8.3f} ms  "
               f"host={d['host_ms_per_step']:>7.3f} ms  "
               f"stall={d['stall_ms_per_step']:>7.3f} ms  "
+              f"itl_p99={d['itl_p99_ms']} ms  "
               f"({d['tokens_per_s']:.1f} tok/s)")
     if "speedup_vs_depth1" in out:
         print(f"  speedup vs depth {min(depths)}: "
