@@ -1020,6 +1020,18 @@ def _telemetry_block() -> dict:
         out["fleet"] = run_fleet_micro()
     except Exception as e:
         out["fleet"] = {"error": repr(e)}
+    try:
+        # ISSUE 15: the elastic-fleet soak — spike -> autoscaler
+        # scale-out -> graceful drain-and-scale-in, fault-free. The
+        # numbers the fleet is judged on land in every round: p99
+        # TTFT/ITL under soak (SLO sketch windows), requests lost
+        # (must stay 0) and the scale-event counts (bench_regress
+        # diffs fleet_elastic.*; the killing variant runs inside
+        # chaos_all above)
+        from tools.loadgen import run_fleet_soak
+        out["fleet_elastic"] = run_fleet_soak()
+    except Exception as e:
+        out["fleet_elastic"] = {"error": repr(e)}
     return out
 
 

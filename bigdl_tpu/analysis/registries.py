@@ -99,6 +99,24 @@ CONF_KEYS.update({
         "duplicate a slow call to a second backend; first success wins",
     "bigdl.llm.hedge.min.delay.ms":
         "floor under the p95 rule",
+    "bigdl.llm.fleet.enabled":
+        "elastic serving fleet: autoscaler + graceful drain with KV handoff; false = absent",
+    "bigdl.llm.fleet.min":
+        "autoscaler floor on decode-pool size",
+    "bigdl.llm.fleet.max":
+        "autoscaler ceiling on decode-pool size",
+    "bigdl.llm.fleet.interval":
+        "autoscaler control-loop tick (seconds)",
+    "bigdl.llm.fleet.cooldown":
+        "seconds after any scale action before the next (flap damping)",
+    "bigdl.llm.fleet.sustain":
+        "consecutive pressured/idle ticks before the autoscaler acts",
+    "bigdl.llm.fleet.queue.high":
+        "per-worker queue depth above which the pool is under pressure",
+    "bigdl.llm.fleet.idle.low":
+        "total queued+active work at or below which the pool is idle",
+    "bigdl.llm.fleet.drain.timeout":
+        "seconds a graceful drain may take before it is abandoned",
     "bigdl.llm.kvcache.enabled":
         "radix-indexed KV page reuse with refcounts + COW; false = off",
     "bigdl.llm.kvtier.enabled":
@@ -222,6 +240,14 @@ METRICS.update({
         "Member snapshot scrapes by outcome",
     "bigdl_federation_stale_instances":
         "Members whose last /metrics/snapshot scrape failed (serving last-known state)",
+    "bigdl_fleet_chains_migrated_total":
+        "Warm KV chains migrated to survivors during drains",
+    "bigdl_fleet_drains_total":
+        "Graceful worker drains by outcome",
+    "bigdl_fleet_scale_events_total":
+        "Autoscaler pool changes by direction",
+    "bigdl_fleet_workers":
+        "Decode-pool size the autoscaler currently maintains",
     "bigdl_kvcache_evictions_total":
         "Pages evicted from the prefix index under pool pressure",
     "bigdl_kvcache_hits_total":
@@ -272,6 +298,8 @@ METRICS.update({
         "Decode-row fraction of the last unified engine pass (1.0 = pure decode, 0.0 = chunk-only)",
     "bigdl_llm_pass_rows_total":
         "Rows served by unified engine passes, by kind (decode | prefill_chunk)",
+    "bigdl_llm_queue_depth":
+        "Requests accepted and waiting for an engine slot (the fleet autoscaler's primary pressure signal)",
     "bigdl_llm_pipeline_inflight":
         "Decode steps dispatched but not yet drained (bounded by bigdl.llm.pipeline_depth)",
     "bigdl_llm_prefill_chunks_total":
@@ -373,6 +401,10 @@ SPAN_NAMES.update({
         "durable snapshot flush (elastic training, process 0)",
     "federation/scrape":
         "completion: one fleet-collector sweep over the members",
+    "fleet/scale":
+        "completion: one autoscaler scale action (out or in)",
+    "worker/drain":
+        "completion: one graceful worker drain (finish + migrate)",
     "elastic/restart":
         "completion: a generation restart round-trip",
     "elastic/rollback":
@@ -438,6 +470,10 @@ FAULT_SITES.update({
         "elastic-guarded train step (ISSUE 10)",
     "federation.scrape":
         "fleet collector member scrape (ISSUE 12)",
+    "fleet.scale":
+        "autoscaler scale action (ISSUE 15)",
+    "worker.drain":
+        "per-chain drain migration (ISSUE 15)",
     "kvcache.evict":
         "prefix-cache LRU eviction (ISSUE 5)",
     "kvtier.fetch":
@@ -481,6 +517,10 @@ FEATURE_GATES.update({
     "bigdl.llm.hedge.enabled": {
         "package": "bigdl_tpu/llm/failover.py",
         "desc": "hedged dispatch (shares the failover module)"},
+    "bigdl.llm.fleet.enabled": {
+        "package": "bigdl_tpu/llm/fleet.py",
+        "desc": "elastic serving fleet: autoscaler + graceful drain "
+                "with KV handoff"},
     "bigdl.llm.kvcache.enabled": {
         "package": "bigdl_tpu/llm/kvcache",
         "desc": "radix prefix index + refcounted page pool"},
@@ -530,6 +570,9 @@ HTTP_ENDPOINTS.update({
     "/elastic/status": {
         "methods": ("GET",),
         "desc": "supervisor membership/state/commit-floor view"},
+    "/fleet/autoscaler": {
+        "methods": ("GET",), "gate": "bigdl.llm.fleet.enabled",
+        "desc": "autoscaler state: bounds, signals, recent scale events"},
     "/fleet/status": {
         "methods": ("GET",), "gate": "bigdl.observability.federation",
         "desc": "fleet collector member/staleness status"},
@@ -548,6 +591,9 @@ HTTP_ENDPOINTS.update({
     "/predict": {
         "methods": ("POST",),
         "desc": "ServingFrontend inference request"},
+    "/worker_drain": {
+        "methods": ("GET", "POST"), "gate": "bigdl.llm.fleet.enabled",
+        "desc": "graceful drain control (begin/cancel) + status poll"},
     "/worker_generate": {
         "methods": ("POST",),
         "desc": "blocking generate on worker and router"},
@@ -574,6 +620,8 @@ PYTEST_MARKERS.update({
         "elastic multi-host training tests",
     "failover":
         "request-level failover / hedging / watchdog tests",
+    "fleet":
+        "elastic serving fleet tests (autoscaler, drain, KV migration)",
     "kernels":
         "Pallas/Mosaic kernel family tests",
     "kvcache":

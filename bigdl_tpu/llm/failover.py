@@ -166,6 +166,12 @@ class HealthProber:
         self._on_probe = on_probe
         self._lock = threading.Lock()
         self._status: Dict[Any, bool] = {}
+        # last observed healthz verdict string (ISSUE 15 satellite):
+        # DRAINING is not DEAD — a draining backend finishes its
+        # in-flight streams and must never trip a breaker or trigger
+        # failover; it just takes no new work. "dead" = the probe
+        # itself failed (connection refused / timeout).
+        self._states: Dict[Any, str] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.probes = 0
@@ -216,10 +222,13 @@ class HealthProber:
                 return
             try:
                 healthy, body = self._probe_one(addr)
+                state = str(body.get("status") or
+                            ("ok" if healthy else "unhealthy"))
             except Exception:   # noqa: BLE001 — dead = unhealthy
-                healthy, body = False, {}
+                healthy, body, state = False, {}, "dead"
             with self._lock:
                 self._status[addr] = healthy
+                self._states[addr] = state
             self.probes += 1
             if self._on_probe is not None:
                 try:
@@ -233,13 +242,44 @@ class HealthProber:
         with self._lock:
             return self._status.get(addr, True)
 
+    def state(self, addr) -> str:
+        """Last observed verdict: ``"ok"`` / ``"draining"`` /
+        ``"stalled"`` / ``"unhealthy"`` / ``"dead"`` (unprobed backends
+        are ``"ok"`` — same default as :meth:`healthy`). The router's
+        drain handling branches on this: DRAINING backends finish
+        their in-flight work and are simply not picked; only the other
+        non-ok states mean failover-now."""
+        with self._lock:
+            return self._states.get(
+                addr, "ok" if self._status.get(addr, True)
+                else "unhealthy")
+
+    def mark(self, addr, state: str):
+        """Out-of-band verdict between sweeps (ISSUE 15): the router
+        marks a backend ``"draining"`` the moment it sees the drain
+        503 (or initiates the drain itself) instead of waiting an
+        interval for the next probe; ``"ok"`` puts an
+        abandoned-drain backend straight back into rotation. The next
+        real probe overwrites either."""
+        with self._lock:
+            self._states[addr] = state
+            self._status[addr] = state == "ok"
+
     def forget(self, addr):
         with self._lock:
             self._status.pop(addr, None)
+            self._states.pop(addr, None)
 
     def status(self) -> Dict[str, bool]:
         with self._lock:
             return {f"{a[0]}:{a[1]}": h for a, h in self._status.items()}
+
+    def states(self) -> Dict[str, str]:
+        """Per-backend verdict strings (the ``/healthz`` prober block's
+        drain-aware view)."""
+        with self._lock:
+            return {f"{a[0]}:{a[1]}": self._states.get(a, "ok")
+                    for a in set(self._status) | set(self._states)}
 
 
 # ---------------------------------------------------------------------------

@@ -173,6 +173,14 @@ class RadixIndex:
             out.extend(chunk)
         return tuple(out)
 
+    def leaf_paths(self) -> List[Tuple[int, ...]]:
+        """Every leaf's full token path — the maximal warm chains this
+        index holds (interior nodes are prefixes of some leaf by
+        construction). The drain-time KV migration walk (ISSUE 15)
+        exports exactly these."""
+        return [self.token_path(n) for n in self._nodes
+                if not n.children]
+
     # -- eviction ------------------------------------------------------------
     def evict_lru(self, n_pages: int, spill=None) -> List[int]:
         """Drop least-recently-used evictable leaves until ``n_pages``

@@ -226,6 +226,13 @@ class HostArena:
                 return None
             return self._k[slot].copy(), self._v[slot].copy()
 
+    def keys(self) -> List[Tuple[int, ...]]:
+        """READY entry keys (full token prefixes) — the host-resident
+        warm chains the drain-time migration (ISSUE 15) exports."""
+        with self._lock:
+            return [meta["key"] for meta in self._slots.values()
+                    if meta["ready"]]
+
     # -- introspection -------------------------------------------------------
     def used(self) -> int:
         with self._lock:

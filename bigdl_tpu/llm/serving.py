@@ -93,6 +93,10 @@ def _llm_instruments():
             "Requests finished by the engine", labelnames=("reason",)),
         "active": obs.gauge(
             "bigdl_llm_active_slots", "Slots currently decoding"),
+        "queue": obs.gauge(
+            "bigdl_llm_queue_depth",
+            "Requests accepted and waiting for an engine slot (the "
+            "fleet autoscaler's primary pressure signal)"),
         "kv_pages": obs.gauge(
             "bigdl_llm_kv_pages_in_use",
             "Physical KV pages owned by live requests"),
@@ -752,8 +756,13 @@ class LLMServer:
                     "never be admitted")
         if self._draining.is_set():
             reliability.count_shed("llm_server")
-            raise reliability.OverloadError(
+            err = reliability.OverloadError(
                 "server is draining: not accepting new requests")
+            # structured marker (ISSUE 15): the worker's 503 body
+            # carries {"draining": true} so the router's drain bounce
+            # keys on a field, not on the message wording
+            err.draining = True
+            raise err
         if self.watchdog_enabled and self.watchdog_tripped \
                 and time.monotonic() - self._hb > self.watchdog_timeout:
             # the engine is wedged mid-pass RIGHT NOW (tripped flag AND
@@ -858,6 +867,64 @@ class LLMServer:
             n += 1
         self._tier.count_handoff("import", len(blob))
         return n
+
+    # -- graceful drain (ISSUE 15) -------------------------------------------
+    def begin_drain(self):
+        """Flip to DRAINING without stopping: new submits shed with 503
+        ``"server is draining"`` (the router's drain bounce re-routes
+        them), ``/healthz`` reports ``"draining"``, and the engine keeps
+        decoding every already-accepted request to completion. The
+        fleet drain coordinator calls this, waits for
+        :meth:`engine_idle`, migrates :meth:`warm_chains`, then the
+        worker exits — see bigdl_tpu/llm/fleet.py."""
+        self._draining.set()
+
+    def cancel_drain(self):
+        """Abandon a drain (scale-in cancelled): the engine accepts
+        work again. A no-op on a server that was never draining."""
+        self._draining.clear()
+
+    @property
+    def draining(self) -> bool:
+        return self._draining.is_set()
+
+    def engine_idle(self) -> bool:
+        """True when no accepted request remains anywhere: queue,
+        held head, fetch-parked, or in a slot (chunked admissions hold
+        their slot, so they are covered). The drain coordinator polls
+        this; ``stop(drain=True)`` uses the same condition inline."""
+        with self._lock:
+            return (self._queue.empty()
+                    and getattr(self, "_pending_head", None) is None
+                    and not self._fetch_wait
+                    and not self._fetch_ready
+                    and all(r is None for r in self._slots))
+
+    def warm_chains(self) -> List[List[int]]:
+        """Token chains currently warm in this engine's caches — the
+        radix index's leaf paths (truncated to full pages: tails
+        re-prefill by the handoff contract) plus host-arena entries —
+        deduplicated so only maximal chains remain (exporting a chain
+        ships every prefix page with it). The drain coordinator
+        migrates exactly these via :meth:`export_chain`. Empty when the
+        prefix cache is off (nothing is warm by construction)."""
+        if not self.paged or self._kv is None or not self._kv.enabled:
+            return []
+        page = self._page
+        chains: Dict[tuple, None] = {}
+        with self._lock:
+            for path in self._kv.index.leaf_paths():
+                full = (len(path) // page) * page
+                if full:
+                    chains[tuple(path[:full])] = None
+            if self._tier is not None:
+                for key in self._tier.arena.keys():
+                    chains[tuple(key)] = None
+        keep: List[tuple] = []
+        for c in sorted(chains, key=len, reverse=True):
+            if not any(k[:len(c)] == c for k in keep):
+                keep.append(c)
+        return [list(c) for c in keep]
 
     def abort(self, req: Request, reason: str = "aborted by caller"):
         """Cooperatively cancel an accepted request (ISSUE 7): the
@@ -1312,6 +1379,7 @@ class LLMServer:
         return self._ins
 
     def _record_kv_gauges(self, ins):
+        ins["queue"].set(self._queue.qsize())
         if self.paged:
             ins["kv_pages"].set(self.pages_in_use)
             # page 0 is the reserved trash page, never allocatable

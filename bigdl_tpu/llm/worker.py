@@ -126,7 +126,8 @@ class LLMWorker:
                  host: str = "127.0.0.1", port: int = 0,
                  request_timeout: float = 600.0,
                  role: Optional[str] = None,
-                 federation: Optional[bool] = None):
+                 federation: Optional[bool] = None,
+                 fleet: Optional[bool] = None):
         from bigdl_tpu.utils.conf import conf
         self.server = server
         self.model_name = model_name
@@ -140,6 +141,15 @@ class LLMWorker:
         # exists only when the federation plane is on — a disabled
         # worker keeps the endpoint structurally absent (404)
         self.federation = federation_enabled(federation)
+        # graceful drain (ISSUE 15): the coordinator exists only when
+        # bigdl.llm.fleet.enabled — disabled mode has no drain state
+        # and /worker_drain answers 404 (structural absence)
+        fleet_on = (fleet if fleet is not None else
+                    conf.get_bool("bigdl.llm.fleet.enabled", False))
+        self._drain = None
+        if fleet_on:
+            from bigdl_tpu.llm.fleet import DrainCoordinator
+            self._drain = DrainCoordinator(server)
         self._t0 = time.time()
         self._tokens_out = 0
         worker = self
@@ -178,6 +188,10 @@ class LLMWorker:
                         val = getattr(e, key, None)
                         if val is not None:
                             body[key] = int(val)
+                    if getattr(e, "draining", False):
+                        # drain shed (ISSUE 15): a structured field the
+                        # router's bounce keys on — never the wording
+                        body["draining"] = True
                     # Retry-After derived from observed queue depth
                     # (ISSUE 7 satellite) — a deep backlog tells
                     # clients to back off longer, jitter decorrelates
@@ -218,6 +232,13 @@ class LLMWorker:
                         self._json(404, {"error": "kvcache disabled"})
                     else:
                         self._json(200, kv.debug_stats())
+                elif self.path == "/worker_drain":
+                    # drain status poll (ISSUE 15): 404 when the fleet
+                    # plane is off — structurally absent, not idle
+                    if worker._drain is None:
+                        self._json(404, {"error": "fleet disabled"})
+                    else:
+                        self._json(200, worker._drain.status())
                 elif self.path == "/worker_get_status":
                     dt = max(time.time() - worker._t0, 1e-9)
                     self._json(200, {
@@ -318,6 +339,43 @@ class LLMWorker:
                         self.path == "/worker_import_chain":
                     self._json(403, {"error": "prefill-role worker "
                                      "does not import chains"})
+                    return
+                if self.path == "/worker_drain":
+                    # graceful drain control (ISSUE 15): begin flips
+                    # the engine to DRAINING and starts the finish-
+                    # then-migrate thread; cancel resumes admission.
+                    # 404 when the fleet plane is off.
+                    if worker._drain is None:
+                        self._json(404, {"error": "fleet disabled"})
+                        return
+                    try:
+                        n = int(self.headers.get("Content-Length", 0))
+                        body = json.loads(self.rfile.read(n)) if n \
+                            else {}
+                        action = body.get("action", "begin")
+                        if action not in ("begin", "cancel"):
+                            raise ValueError(
+                                "action must be begin|cancel")
+                        # coerce peers/timeout HERE: malformed values
+                        # are the client's 400, not a torn connection
+                        peers = [(str(p[0]), int(p[1]))
+                                 for p in body.get("peers", [])]
+                        drain_timeout = float(body.get("timeout", 60.0))
+                    except Exception as e:  # noqa: BLE001
+                        self._json(400, {"error": f"bad request: {e}"})
+                        return
+                    if action == "cancel":
+                        worker._drain.cancel()
+                        self._json(200, worker._drain.status())
+                        return
+                    started = worker._drain.begin(
+                        peers, timeout=drain_timeout)
+                    if not started:
+                        self._json(409, {
+                            "error": "drain already active",
+                            **worker._drain.status()})
+                        return
+                    self._json(200, worker._drain.status())
                     return
                 if self.path == "/worker_prefill":
                     # run the prompt once (one decoded token pins the
@@ -555,6 +613,12 @@ class LLMWorker:
         return self
 
     def stop(self):
+        # shutdown during an active drain (ISSUE 15 satellite): cancel
+        # and JOIN the drain thread first — after this there are no
+        # orphaned migration posts and no drain-held state; resume=False
+        # keeps admission closed (the engine is about to stop for good)
+        if self._drain is not None:
+            self._drain.cancel(resume=False)
         if self._thread is not None:
             # shutdown() handshakes with serve_forever — calling it on
             # a never-started server would wait forever
@@ -599,6 +663,18 @@ class _BackendShed(Exception):
         super().__init__(parsed.get("error", "backend shedding"))
         self.parsed = parsed
         self.retry_after = retry_after
+
+
+class _BackendDraining(Exception):
+    """503 whose body says the worker is DRAINING (ISSUE 15): alive,
+    finishing its in-flight streams, taking no new work. NOT a breaker
+    failure and NOT client-visible backpressure — the router marks the
+    backend draining at the prober and re-routes the request to another
+    backend instead of relaying the shed."""
+
+    def __init__(self, parsed):
+        super().__init__(parsed.get("error", "backend draining"))
+        self.parsed = parsed
 
 
 class _BackendFatal(Exception):
@@ -682,7 +758,11 @@ class LLMRouter:
                  prober_interval: Optional[float] = None,
                  start_prober: bool = True,
                  slo: Optional[bool] = None,
-                 federation: Optional[bool] = None):
+                 federation: Optional[bool] = None,
+                 fleet: Optional[bool] = None,
+                 provider=None,
+                 fleet_opts: Optional[dict] = None,
+                 start_fleet: bool = True):
         from bigdl_tpu.utils.conf import conf
         if not decode_workers:
             raise ValueError("the router needs at least one "
@@ -758,6 +838,23 @@ class LLMRouter:
                 FederationCollector)
             self._collector = FederationCollector(
                 self._federation_targets, include_self="router")
+        # elastic fleet autoscaler (ISSUE 15): constructed ONLY when
+        # bigdl.llm.fleet.enabled — disabled mode has no controller
+        # thread, no bigdl_fleet_* series, and /fleet/autoscaler 404s
+        fleet_on = (fleet if fleet is not None else
+                    conf.get_bool("bigdl.llm.fleet.enabled", False))
+        self._fleet = None
+        self._start_fleet = False
+        if fleet_on:
+            if not self.failover_enabled:
+                raise ValueError(
+                    "bigdl.llm.fleet needs bigdl.llm.failover.enabled: "
+                    "the autoscaler drives the prober and the live "
+                    "POST /backends membership")
+            from bigdl_tpu.llm.fleet import FleetController
+            self._fleet = FleetController(self, provider=provider,
+                                          **(fleet_opts or {}))
+            self._start_fleet = start_fleet
         self._ins = None
         router = self
 
@@ -799,6 +896,13 @@ class LLMRouter:
                                    {"error": "federation disabled"})
                     else:
                         self._json(200, router._collector.status())
+                elif self.path == "/fleet/autoscaler":
+                    # autoscaler state (ISSUE 15): 404 when the fleet
+                    # plane is off — structurally absent, not idle
+                    if router._fleet is None:
+                        self._json(404, {"error": "fleet disabled"})
+                    else:
+                        self._json(200, router._fleet.status())
                 elif self.path == "/worker_get_status":
                     self._json(200, router._status_body())
                 else:
@@ -966,6 +1070,13 @@ class LLMRouter:
             body["hedges_issued"] = self.hedges_issued
         if self._prober is not None:
             body["prober"] = self._prober.status()
+            # drain-aware verdicts (ISSUE 15): "draining" is visibly
+            # distinct from "dead"/"stalled" in the fleet view
+            body["backend_states"] = self._prober.states()
+        if self._fleet is not None:
+            body["fleet"] = {"workers": len(self.decode_workers),
+                             "scale_outs": self._fleet.scale_outs,
+                             "scale_ins": self._fleet.scale_ins}
         if self._slo is not None:
             # rolling burn rate (ISSUE 12): one number an autoscaler
             # or alert reads instead of differencing counters
@@ -1236,6 +1347,11 @@ class LLMRouter:
                                   data.decode(errors="replace")[:200]}
                     if resp.status == 503:
                         breaker.record_success()
+                        if parsed.get("draining"):
+                            # drain shed (ISSUE 15): alive, no new
+                            # work — re-route, don't relay, and never
+                            # a breaker failure (regression-tested)
+                            raise _BackendDraining(parsed)
                         raise _BackendShed(
                             parsed, resp.getheader("Retry-After"))
                     if resp.status >= 500:
@@ -1279,7 +1395,7 @@ class LLMRouter:
                         f"{addr[0]}:{addr[1]} timed out mid-generation "
                         f"({len(last.get('output_ids', []))} tokens "
                         "drained)")
-            except (_BackendShed, _BackendFatal):
+            except (_BackendShed, _BackendFatal, _BackendDraining):
                 raise
             except Exception:
                 # same hedge-loser carve-out as _call: a socket we
@@ -1345,7 +1461,8 @@ class LLMRouter:
                 # those must relay, not burn failover attempts
                 reason, outcome = fo.run_hedged(
                     attempt(addr), hedge_fn, delay, on_hedge,
-                    prefer=(_BackendShed, _BackendFatal))
+                    prefer=(_BackendShed, _BackendFatal,
+                            _BackendDraining))
         else:
             reason, outcome = fo.run_hedged(attempt(addr), None, delay)
         self._latency["decode"].record(time.perf_counter() - t0)
@@ -1371,6 +1488,7 @@ class LLMRouter:
                 self.prefill_degraded += 1
             imported = set()
             tried = set()
+            drain_bounces = 0
             while True:
                 if deadline is not None and deadline.expired():
                     handler._json(504, {
@@ -1400,6 +1518,29 @@ class LLMRouter:
                     ent.finish_reason = self._decode_attempt(
                         addr, ent, fwd_headers, tried)
                     break
+                except _BackendDraining:
+                    # drain bounce (ISSUE 15): the backend is healthy
+                    # but winding down — route elsewhere without
+                    # consuming a failover attempt or tripping
+                    # anything. The prober mark makes _pick skip it
+                    # outright from here on (a fully-draining pool then
+                    # sheds through the addr-is-None arm above).
+                    ent.attempts -= 1
+                    tried.add(addr)
+                    if self._prober is not None:
+                        self._prober.mark(addr, "draining")
+                    drain_bounces = drain_bounces + 1
+                    if drain_bounces > 2 * max(
+                            len(self.decode_workers), 1):
+                        reliability.count_shed("llm_router")
+                        handler._json(
+                            503, {"error": "every decode backend is "
+                                  "draining"},
+                            headers=(("Retry-After",
+                                      reliability.retry_after_seconds(
+                                          self._journal.inflight())),))
+                        return
+                    continue
                 except _BackendShed as e:
                     reliability.count_shed("llm_router")
                     ra = e.retry_after or \
@@ -1473,9 +1614,16 @@ class LLMRouter:
             self._prober.start()
         if self._collector is not None:
             self._collector.start()
+        if self._fleet is not None and self._start_fleet:
+            self._fleet.start()
         return self
 
     def stop(self):
+        # the fleet controller stops FIRST (ISSUE 15 satellite): it may
+        # hold an in-progress drain, which must be cancelled before the
+        # prober/membership surfaces it depends on go away
+        if self._fleet is not None:
+            self._fleet.stop()
         if self._collector is not None:
             self._collector.stop()
         if self._prober is not None:

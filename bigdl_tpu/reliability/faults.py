@@ -57,6 +57,8 @@ SITES = (
     "elastic.heartbeat",           # agent->supervisor beat (ISSUE 10)
     "elastic.step",                # elastic-guarded train step (ISSUE 10)
     "federation.scrape",           # fleet collector member scrape (ISSUE 12)
+    "fleet.scale",                 # autoscaler scale action (ISSUE 15)
+    "worker.drain",                # per-chain drain migration (ISSUE 15)
 )
 
 

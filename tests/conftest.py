@@ -75,6 +75,11 @@ def pytest_configure(config):
         "mixed: unified mixed prefill+decode dispatch tests (chunked "
         "admission parity, ledger rollback, compile grid; select with "
         "-m mixed)")
+    config.addinivalue_line(
+        "markers",
+        "fleet: elastic serving fleet tests (autoscaler, graceful "
+        "drain with KV migration, provider lifecycle; select with "
+        "-m fleet)")
 
 
 @pytest.fixture(scope="session")

@@ -1,0 +1,785 @@
+"""Elastic serving fleet (ISSUE 15): graceful drain with KV handoff,
+the drain-aware prober/router path, the autoscaler's control loop, the
+worker-provider lifecycle, shutdown-during-drain hygiene, scale-in
+under pipelining, the load generator, and the disabled-mode
+structural-absence contract for ``bigdl.llm.fleet.enabled``.
+
+The soak with mid-drain kills lives in ``tools/chaos_check.py
+--fleet``; these tests pin each mechanism in isolation."""
+
+import http.client
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from bigdl_tpu import observability as obs
+from bigdl_tpu import reliability as rel
+from bigdl_tpu.llm.failover import HealthProber
+from bigdl_tpu.llm.fleet import (DrainCoordinator, FleetController,
+                                 LocalWorkerProvider, WorkerProvider,
+                                 fleet_enabled)
+from bigdl_tpu.llm.models.llama import LlamaConfig, LlamaForCausalLM
+from bigdl_tpu.llm.serving import LLMServer
+from bigdl_tpu.llm.worker import LLMRouter, LLMWorker
+from bigdl_tpu.utils.conf import conf
+
+pytestmark = pytest.mark.fleet
+
+
+@pytest.fixture(scope="module")
+def model():
+    return LlamaForCausalLM.from_config(LlamaConfig.tiny(), seed=0,
+                                        max_cache_len=128)
+
+
+@pytest.fixture()
+def sync_tier():
+    """Inline (synchronous) host-tier migrations for deterministic
+    spills/fetches; conf restored exactly."""
+    with conf._lock:
+        prev = conf._set_layer.get("bigdl.llm.kvtier.sync")
+    conf.set("bigdl.llm.kvtier.sync", "true")
+    yield
+    if prev is None:
+        conf.unset("bigdl.llm.kvtier.sync")
+    else:
+        conf.set("bigdl.llm.kvtier.sync", prev)
+
+
+@pytest.fixture()
+def faults_armed():
+    was = rel.enabled()
+    if not was:
+        rel.enable()
+    yield
+    rel.set_plan(None)
+    if not was:
+        rel.disable()
+
+
+def _generate(model, p, n):
+    return list(map(int, model.generate(np.asarray(p)[None],
+                                        max_new_tokens=n)[0, len(p):]))
+
+
+def _req(addr, method, path, body=None, timeout=120):
+    conn = http.client.HTTPConnection(*addr, timeout=timeout)
+    try:
+        payload = json.dumps(body) if body is not None else None
+        conn.request(method, path, payload,
+                     {"Content-Type": "application/json"}
+                     if body is not None else {})
+        r = conn.getresponse()
+        return r.status, json.loads(r.read().decode()), \
+            dict(r.getheaders())
+    finally:
+        conn.close()
+
+
+def _mk_server(model, **kw):
+    args = dict(max_batch=2, max_seq_len=64, page_size=8, num_pages=24,
+                kvcache=True, kvtier=True, host_pages=64)
+    args.update(kw)
+    return LLMServer(model, **args)
+
+
+def _wait(cond, timeout=30.0, interval=0.01):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        if cond():
+            return True
+        time.sleep(interval)
+    return False
+
+
+# ---------------------------------------------------------------------------
+# engine drain primitives + warm-chain enumeration
+# ---------------------------------------------------------------------------
+
+class TestEngineDrain:
+    def test_begin_cancel_drain_and_idle(self, model):
+        srv = LLMServer(model, max_batch=2, max_seq_len=64,
+                        page_size=8).start()
+        try:
+            assert not srv.draining and srv.engine_idle()
+            srv.begin_drain()
+            assert srv.draining
+            with pytest.raises(rel.OverloadError, match="draining"):
+                srv.submit(np.arange(6, dtype=np.int32),
+                           max_new_tokens=2)
+            srv.cancel_drain()
+            assert not srv.draining
+            r = srv.submit(np.arange(6, dtype=np.int32),
+                           max_new_tokens=2)
+            assert len(r.get(timeout=300)) == 2
+        finally:
+            srv.stop()
+
+    def test_warm_chains_maximal_and_disabled(self, model, sync_tier):
+        srv = _mk_server(model).start()
+        try:
+            rs = np.random.RandomState(0)
+            shared = rs.randint(0, 250, 16).astype(np.int32)
+            p1 = np.concatenate([shared,
+                                 rs.randint(0, 250, 8).astype(np.int32)])
+            srv.submit(shared, max_new_tokens=2).get(timeout=300)
+            srv.submit(p1, max_new_tokens=2).get(timeout=300)
+            chains = srv.warm_chains()
+            assert chains, "no warm chains after two indexed requests"
+            keys = [tuple(c) for c in chains]
+            # maximal only: no chain is a prefix of another
+            for a in keys:
+                for b in keys:
+                    if a is not b:
+                        assert b[:len(a)] != a, \
+                            f"chain {a} is a prefix of {b}"
+            # every chain is full pages
+            assert all(len(c) % 8 == 0 for c in keys)
+        finally:
+            srv.stop()
+        off = LLMServer(model, max_batch=2, max_seq_len=64, page_size=8)
+        assert off.warm_chains() == []
+
+
+class TestDrainCoordinator:
+    def test_drain_migrates_chains_to_survivor(self, model, sync_tier):
+        a = _mk_server(model).start()
+        b = _mk_server(model).start()
+        wa = LLMWorker(a, role="decode", fleet=True).start()
+        wb = LLMWorker(b, role="decode", fleet=True).start()
+        try:
+            rs = np.random.RandomState(1)
+            p = rs.randint(0, 250, 24).astype(np.int32)
+            golden = _generate(model, p, 2)
+            assert list(map(int, a.submit(p, max_new_tokens=2)
+                            .get(timeout=300))) == golden
+            st, body, _ = _req(wa.address, "POST", "/worker_drain",
+                               {"action": "begin",
+                                "peers": [list(wb.address)],
+                                "timeout": 30.0})
+            assert st == 200, body
+            assert _wait(lambda: wa._drain.status()["state"]
+                         == "drained"), wa._drain.status()
+            stt = wa._drain.status()
+            assert stt["migrated_chains"] >= 1 and \
+                stt["migrated_pages"] >= 1, stt
+            # healthz reports draining (503) once the drain holds
+            st, hz, _ = _req(wa.address, "GET", "/healthz")
+            assert st == 503 and hz["status"] == "draining"
+            # new work sheds with the draining marker
+            st, shed, _ = _req(wa.address, "POST", "/worker_generate",
+                               {"prompt_ids": [int(t) for t in p],
+                                "max_new_tokens": 2})
+            assert st == 503 and shed.get("draining") is True, shed
+            # the survivor's arena holds the chains and serves a
+            # prefix hit for the same prompt
+            assert b._tier.arena.used() >= 1
+            before = b._kv.prefix_tokens_reused
+            assert list(map(int, b.submit(p, max_new_tokens=2)
+                            .get(timeout=300))) == golden
+            assert b._kv.prefix_tokens_reused > before, \
+                "survivor served no prefix hit from migrated chains"
+            # drain GET status endpoint mirrors the coordinator
+            st, got, _ = _req(wa.address, "GET", "/worker_drain")
+            assert st == 200 and got["state"] == "drained"
+        finally:
+            wa.stop()
+            wb.stop()
+            a.stop(drain=False)
+            b.stop()
+
+    def test_drain_finishes_inflight_first(self, model):
+        srv = LLMServer(model, max_batch=2, max_seq_len=64,
+                        page_size=8, kvcache=True).start()
+        w = LLMWorker(srv, role="decode", fleet=True).start()
+        try:
+            p = np.arange(8, dtype=np.int32)
+            golden = _generate(model, p, 12)
+            r = srv.submit(p, max_new_tokens=12)
+            assert w._drain.begin([], timeout=60.0)
+            # the accepted request finishes with the full answer
+            assert list(map(int, r.get(timeout=300))) == golden
+            assert _wait(lambda: w._drain.status()["state"]
+                         == "drained")
+        finally:
+            w.stop()
+            srv.stop(drain=False)
+
+    def test_double_begin_conflicts_and_cancel_resumes(self, model):
+        srv = LLMServer(model, max_batch=2, max_seq_len=64,
+                        page_size=8).start()
+        w = LLMWorker(srv, role="decode", fleet=True).start()
+        try:
+            r = srv.submit(np.arange(6, dtype=np.int32),
+                           max_new_tokens=8)   # keeps phase 1 waiting
+            assert w._drain.begin([], timeout=60.0)
+            st, body, _ = _req(w.address, "POST", "/worker_drain",
+                               {"action": "begin"})
+            assert st == 409, body
+            st, body, _ = _req(w.address, "POST", "/worker_drain",
+                               {"action": "cancel"})
+            assert st == 200
+            assert not srv.draining, \
+                "cancel must resume admission"
+            r.get(timeout=300)
+            srv.submit(np.arange(6, dtype=np.int32),
+                       max_new_tokens=1).get(timeout=300)
+        finally:
+            w.stop()
+            srv.stop()
+
+    def test_worker_stop_during_active_drain(self, model, sync_tier,
+                                             faults_armed):
+        """Shutdown mid-drain (satellite): the drain thread is joined,
+        no migration posts are orphaned, no arena slots stay pinned on
+        either side."""
+        a = _mk_server(model).start()
+        b = _mk_server(model).start()
+        wa = LLMWorker(a, role="decode", fleet=True).start()
+        wb = LLMWorker(b, role="decode", fleet=True).start()
+        try:
+            rs = np.random.RandomState(2)
+            for j in range(3):
+                a.submit(rs.randint(0, 250, 16 + 8 * j)
+                         .astype(np.int32),
+                         max_new_tokens=2).get(timeout=300)
+            plan = rel.FaultPlan(seed=0)
+            plan.add("worker.drain", "delay", times=None, delay=0.1)
+            rel.set_plan(plan)
+            assert wa._drain.begin([list(wb.address)], timeout=60.0)
+            assert _wait(lambda: wa._drain.status()["state"]
+                         in ("migrating", "drained"), timeout=10.0)
+            wa.stop()       # mid-migration shutdown
+            assert not wa._drain.active(), \
+                "stop() left the drain thread running"
+            assert not [t for t in threading.enumerate()
+                        if t.name == "bigdl-fleet-drain"]
+            assert a._tier.arena.pinned() == 0
+            assert b._tier.arena.pinned() == 0
+            # shutdown path keeps admission closed
+            assert a.draining
+        finally:
+            rel.set_plan(None)
+            wb.stop()
+            a.stop(drain=False)
+            b.stop()
+
+
+# ---------------------------------------------------------------------------
+# prober + router drain awareness (satellite: DRAINING != dead)
+# ---------------------------------------------------------------------------
+
+class TestDrainAwareRouting:
+    def test_prober_state_distinguishes_draining_dead(self, model):
+        srv = LLMServer(model, max_batch=2, max_seq_len=64,
+                        page_size=8).start()
+        w = LLMWorker(srv, role="decode", fleet=True).start()
+        dead_addr = ("127.0.0.1", 1)      # nothing listens there
+        prober = HealthProber(
+            lambda: [(tuple(w.address), "decode"),
+                     (dead_addr, "decode")], interval=60.0)
+        assert prober.state(tuple(w.address)) == "ok"   # unprobed
+        prober.probe_now()
+        assert prober.state(tuple(w.address)) == "ok"
+        assert prober.state(dead_addr) == "dead"
+        assert not prober.healthy(dead_addr)
+        srv.begin_drain()
+        prober.probe_now()
+        assert prober.state(tuple(w.address)) == "draining"
+        assert not prober.healthy(tuple(w.address))
+        # out-of-band marks (the router's bounce / abandoned drain)
+        prober.mark(tuple(w.address), "ok")
+        assert prober.healthy(tuple(w.address))
+        states = prober.states()
+        assert states[f"{dead_addr[0]}:{dead_addr[1]}"] == "dead"
+        w.stop()
+        srv.stop()
+
+    def test_drain_bounces_without_tripping_breaker(self, model):
+        """Regression (satellite): a draining backend must NEVER trip
+        the circuit breaker or count as a failover — the request
+        re-routes to a live backend and succeeds."""
+        s1 = LLMServer(model, max_batch=2, max_seq_len=64,
+                       page_size=8, kvcache=True).start()
+        s2 = LLMServer(model, max_batch=2, max_seq_len=64,
+                       page_size=8, kvcache=True).start()
+        w1 = LLMWorker(s1, role="decode", fleet=True).start()
+        w2 = LLMWorker(s2, role="decode", fleet=True).start()
+        router = LLMRouter([], [w1.address, w2.address], failover=True,
+                           start_prober=False).start()
+        try:
+            p = np.arange(10, dtype=np.int32)
+            golden = _generate(model, p, 3)
+            # round-robin starts at w1, which is draining: the dispatch
+            # bounces and must land on w2
+            s1.begin_drain()
+            st, body, _ = _req(router.address, "POST",
+                               "/worker_generate",
+                               {"prompt_ids": [int(t) for t in p],
+                                "max_new_tokens": 3})
+            assert st == 200, body
+            assert body["output_ids"] == golden
+            b1 = router._breakers[tuple(w1.address)]
+            assert b1.state == "closed", \
+                "a drain shed tripped the circuit breaker"
+            assert router.failovers == 0, \
+                "a drain bounce was counted as a failover"
+            assert router._prober.state(tuple(w1.address)) == "draining"
+            # in-flight work on the draining backend still completes:
+            # the engine keeps decoding what it accepted
+            r = None
+            s1.cancel_drain()
+            r = s1.submit(p, max_new_tokens=3)
+            s1.begin_drain()
+            assert list(map(int, r.get(timeout=300))) == golden
+        finally:
+            router.stop()
+            w1.stop()
+            w2.stop()
+            s1.stop(drain=False)
+            s2.stop()
+
+    def test_all_draining_sheds_with_retry_after(self, model):
+        srv = LLMServer(model, max_batch=2, max_seq_len=64,
+                        page_size=8).start()
+        w = LLMWorker(srv, role="decode", fleet=True).start()
+        router = LLMRouter([], [w.address], failover=True,
+                           start_prober=False).start()
+        try:
+            srv.begin_drain()
+            st, body, hdrs = _req(
+                router.address, "POST", "/worker_generate",
+                {"prompt_ids": list(range(6)), "max_new_tokens": 1})
+            assert st == 503
+            assert "Retry-After" in hdrs
+        finally:
+            router.stop()
+            w.stop()
+            srv.stop(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# scale-in under pipelining (satellite)
+# ---------------------------------------------------------------------------
+
+class TestScaleInUnderPipelining:
+    def test_drain_with_inflight_fences_and_parked_fetch(
+            self, model, faults_armed):
+        """Drain a depth-4 worker holding multiple in-flight fences AND
+        a parked (delayed) kvtier fetch: everything finishes, outputs
+        are bit-identical, and the page/budget ledger returns to
+        idle."""
+        num_pages = 24
+        a = _mk_server(model, pipeline_depth=4,
+                       num_pages=num_pages).start()
+        b = _mk_server(model).start()
+        wa = LLMWorker(a, role="decode", fleet=True).start()
+        wb = LLMWorker(b, role="decode", fleet=True).start()
+        try:
+            rs = np.random.RandomState(3)
+            warm = rs.randint(0, 250, 24).astype(np.int32)
+            others = [rs.randint(0, 250, 10 + 2 * j).astype(np.int32)
+                      for j in range(2)]
+            goldens = {tuple(map(int, p)): _generate(model, p, 4)
+                       for p in [warm] + others}
+            # plant warm's chain in A's ARENA (import via handoff from
+            # B, so the next admission on A must FETCH it)
+            b.submit(warm, max_new_tokens=1).get(timeout=300)
+            blob = b.export_chain(warm)
+            assert a.import_chain(blob) >= 1
+            # park the fetch: every kvtier.fetch is delayed, so the
+            # warm admission waits while decode requests pipeline
+            plan = rel.FaultPlan(seed=0)
+            plan.add("kvtier.fetch", "delay", times=None, delay=0.3)
+            rel.set_plan(plan)
+            reqs = [a.submit(p, max_new_tokens=4) for p in others]
+            rwarm = a.submit(warm, max_new_tokens=4)
+            assert wa._drain.begin([list(wb.address)], timeout=60.0)
+            for p, r in zip(others + [warm], reqs + [rwarm]):
+                assert list(map(int, r.get(timeout=300))) == \
+                    goldens[tuple(map(int, p))]
+            assert _wait(lambda: wa._drain.status()["state"]
+                         == "drained"), wa._drain.status()
+            # ledger idle: every charge returned, nothing pinned
+            assert a.engine_idle()
+            assert a._budget_avail == num_pages - 1
+            assert a._tier.arena.pinned() == 0
+            assert not a._inflight
+        finally:
+            rel.set_plan(None)
+            wa.stop()
+            wb.stop()
+            a.stop(drain=False)
+            b.stop()
+
+    def test_kill_pipelined_worker_resumes_bit_identical(
+            self, model, faults_armed):
+        """KILL (not drain) a depth-4 worker mid-stream through the
+        failover router: the journal resumes on the survivor with
+        bit-identical greedy output."""
+        s1 = LLMServer(model, max_batch=2, max_seq_len=64, page_size=8,
+                       kvcache=True, pipeline_depth=4).start()
+        s2 = LLMServer(model, max_batch=2, max_seq_len=64, page_size=8,
+                       kvcache=True).start()
+        w1 = LLMWorker(s1, role="decode").start()
+        w2 = LLMWorker(s2, role="decode").start()
+        router = LLMRouter([], [w1.address, w2.address], failover=True,
+                           failover_attempts=6,
+                           start_prober=False).start()
+        try:
+            p = np.arange(12, dtype=np.int32)
+            golden = _generate(model, p, 6)
+            # warm both engines on every shape the resume will hit
+            for srv in (s1, s2):
+                srv.submit(p, max_new_tokens=1).get(timeout=300)
+                srv.submit(p, max_new_tokens=1).get(timeout=300)
+            plan = rel.FaultPlan(seed=0)
+            plan.add("llm.step", "delay", times=None, delay=0.02)
+            rel.set_plan(plan)
+            holder = {}
+
+            def call():
+                holder["resp"] = _req(
+                    router.address, "POST", "/worker_generate",
+                    {"prompt_ids": [int(t) for t in p],
+                     "max_new_tokens": 6})
+            t = threading.Thread(target=call, daemon=True)
+            t.start()
+            # kill w1 once the stream is live (w1 is the round-robin
+            # first pick)
+            assert _wait(lambda: any(r is not None
+                                     for r in s1._slots)
+                         or holder.get("resp"), timeout=30.0)
+            w1.stop()
+            s1.stop(drain=False)
+            t.join(timeout=600)
+            st, body, _ = holder["resp"]
+            assert st == 200, body
+            assert body["output_ids"] == golden
+        finally:
+            rel.set_plan(None)
+            router.stop()
+            w2.stop()
+            s2.stop()
+
+
+# ---------------------------------------------------------------------------
+# the autoscaler control loop
+# ---------------------------------------------------------------------------
+
+class _FakeRouter:
+    def __init__(self, addrs):
+        self._pool_lock = threading.RLock()
+        self.decode_workers = list(addrs)
+        self._journal = None
+        self._prober = None
+        self._collector = None
+        self.removed = []
+
+    def _admin_backends(self, body):
+        addr = (body["host"], int(body["port"]))
+        if body["action"] == "add":
+            self.decode_workers.append(addr)
+        else:
+            if len(self.decode_workers) == 1:
+                raise ValueError("refusing to remove the last backend")
+            self.decode_workers.remove(addr)
+            self.removed.append(addr)
+        return 200, {}
+
+
+class _FakeProvider(WorkerProvider):
+    def __init__(self):
+        self.launched = []
+        self.terminated = []
+        self._n = 0
+
+    def launch(self):
+        self._n += 1
+        addr = ("127.0.0.1", 40000 + self._n)
+        self.launched.append(addr)
+        return addr
+
+    def terminate(self, addr):
+        self.terminated.append(tuple(addr))
+
+
+class TestFleetController:
+    def _controller(self, router, provider, **kw):
+        args = dict(min_workers=1, max_workers=3, interval=60.0,
+                    cooldown=0.0, sustain=2, queue_high=1.0,
+                    idle_low=0.0, drain_timeout=5.0)
+        args.update(kw)
+        return FleetController(router, provider=provider, **args)
+
+    def test_scale_out_needs_sustained_pressure(self):
+        router = _FakeRouter([("127.0.0.1", 39000)])
+        provider = _FakeProvider()
+        fc = self._controller(router, provider, sustain=3)
+        fc.signals = lambda: {"workers": len(router.decode_workers),
+                              "queue": 10.0, "active": 2.0,
+                              "inflight": 0, "sheds": 0.0,
+                              "occupancy_max": 0.0,
+                              "source": "fake"}
+        fc.tick()
+        fc.tick()
+        assert not provider.launched, \
+            "scaled out before the sustain threshold"
+        fc.tick()
+        assert len(provider.launched) == 1
+        assert len(router.decode_workers) == 2
+        assert fc.scale_outs == 1
+
+    def test_cooldown_and_max_bound(self):
+        router = _FakeRouter([("127.0.0.1", 39000)])
+        provider = _FakeProvider()
+        fc = self._controller(router, provider, sustain=1,
+                              cooldown=3600.0, max_workers=2)
+        fc.signals = lambda: {"workers": len(router.decode_workers),
+                              "queue": 10.0, "active": 0.0,
+                              "inflight": 0, "sheds": 0.0,
+                              "occupancy_max": 0.0, "source": "fake"}
+        fc.tick()
+        assert len(provider.launched) == 1
+        for _ in range(5):
+            fc.tick()
+        assert len(provider.launched) == 1, \
+            "cooldown did not damp repeated scale-outs"
+        fc.cooldown = 0.0
+        for _ in range(5):
+            fc.tick()
+        assert len(router.decode_workers) == 2, \
+            "max bound was exceeded"
+
+    def test_shed_delta_counts_as_pressure(self):
+        router = _FakeRouter([("127.0.0.1", 39000)])
+        provider = _FakeProvider()
+        fc = self._controller(router, provider, sustain=1)
+        sheds = {"v": 100.0}
+        fc.signals = lambda: {"workers": len(router.decode_workers),
+                              "queue": 0.0, "active": 1.0,
+                              "inflight": 0, "sheds": sheds["v"],
+                              "occupancy_max": 0.0, "source": "fake"}
+        fc.tick()      # establishes the shed baseline, no pressure
+        assert not provider.launched
+        sheds["v"] = 103.0
+        fc.tick()      # sheds grew -> pressure
+        assert len(provider.launched) == 1
+
+    def test_no_provider_records_event_instead_of_acting(self):
+        router = _FakeRouter([("127.0.0.1", 39000)])
+        fc = self._controller(router, None, sustain=1)
+        fc.signals = lambda: {"workers": 1, "queue": 10.0,
+                              "active": 0.0, "inflight": 0,
+                              "sheds": 0.0, "occupancy_max": 0.0,
+                              "source": "fake"}
+        fc.tick()
+        assert [e["action"] for e in fc.events] == ["no_provider"]
+        assert len(router.decode_workers) == 1
+
+    def test_min_bound_blocks_scale_in(self):
+        router = _FakeRouter([("127.0.0.1", 39000)])
+        provider = _FakeProvider()
+        fc = self._controller(router, provider, sustain=1)
+        fc.signals = lambda: {"workers": 1, "queue": 0.0,
+                              "active": 0.0, "inflight": 0,
+                              "sheds": 0.0, "occupancy_max": 0.0,
+                              "source": "fake"}
+        for _ in range(4):
+            fc.tick()
+        assert fc._draining is None and not router.removed
+
+    def test_autoscaler_end_to_end(self, model):
+        """Integration: spike -> scale-out -> idle -> graceful drain ->
+        remove + terminate -> converged pool, against live workers."""
+        provider = LocalWorkerProvider(
+            model, server_kwargs=dict(max_batch=2, max_seq_len=64,
+                                      page_size=8, kvcache=True,
+                                      max_queue=8))
+        router = None
+        try:
+            seed_addr = provider.launch()
+            srv = provider.servers()[seed_addr]
+            p = np.arange(10, dtype=np.int32)
+            golden = _generate(model, p, 2)
+            srv.submit(p, max_new_tokens=2).get(timeout=300)
+            router = LLMRouter(
+                [], [seed_addr], failover=True, start_prober=False,
+                fleet=True, provider=provider, start_fleet=False,
+                fleet_opts=dict(min_workers=1, max_workers=2,
+                                interval=0.05, cooldown=0.0, sustain=1,
+                                queue_high=0.5, idle_low=0.0,
+                                drain_timeout=20.0)).start()
+            fleet = router._fleet
+            results = []
+
+            def call():
+                results.append(_req(
+                    router.address, "POST", "/worker_generate",
+                    {"prompt_ids": [int(t) for t in p],
+                     "max_new_tokens": 2}))
+            threads = [threading.Thread(target=call, daemon=True)
+                       for _ in range(6)]
+            for t in threads:
+                t.start()
+            assert _wait(lambda: (fleet.tick() or
+                                  len(router.decode_workers) >= 2),
+                         timeout=30.0), fleet.signals()
+            for t in threads:
+                t.join(timeout=600)
+            assert all(st == 200 and body["output_ids"] == golden
+                       for st, body, _ in results), results
+            assert fleet.scale_outs >= 1
+            # idle -> drain -> converge
+            assert _wait(lambda: (fleet.tick() or
+                                  (fleet.scale_ins >= 1 and
+                                   len(router.decode_workers) == 1)),
+                         timeout=60.0), fleet.status()
+            assert provider.terminations >= 1
+            st, status, _ = _req(router.address, "GET",
+                                 "/fleet/autoscaler")
+            assert st == 200
+            assert status["scale_outs"] >= 1
+            assert status["scale_ins"] >= 1
+            assert any(e["action"] == "scale_in"
+                       for e in status["events"])
+        finally:
+            if router is not None:
+                router.stop()
+            provider.stop_all()
+
+    def test_router_stop_cancels_inflight_scale_in(self, model):
+        """Satellite: router shutdown during an active drain cancels
+        it — the victim resumes admission, no drain thread leaks."""
+        provider = LocalWorkerProvider(
+            model, server_kwargs=dict(max_batch=2, max_seq_len=64,
+                                      page_size=8))
+        router = None
+        try:
+            a1 = provider.launch()
+            a2 = provider.launch()
+            router = LLMRouter(
+                [], [a1, a2], failover=True, start_prober=False,
+                fleet=True, provider=provider, start_fleet=False,
+                fleet_opts=dict(min_workers=1, max_workers=2,
+                                interval=0.05, cooldown=0.0, sustain=1,
+                                drain_timeout=30.0)).start()
+            fleet = router._fleet
+            # a request keeps the victim's phase-1 wait alive so the
+            # drain is guaranteed still active at stop()
+            victim_srv = provider.servers()[a2]
+            r = victim_srv.submit(np.arange(6, dtype=np.int32),
+                                  max_new_tokens=10)
+            fleet._begin_scale_in(fleet.signals())
+            assert fleet._draining is not None
+            router.stop()
+            router = None
+            assert fleet._draining is None
+            r.get(timeout=300)
+            assert _wait(lambda: not victim_srv.draining, timeout=10.0), \
+                "cancelled drain left the victim refusing work"
+            assert not [t for t in threading.enumerate()
+                        if t.name == "bigdl-fleet-drain"]
+            victim_srv.submit(np.arange(6, dtype=np.int32),
+                              max_new_tokens=1).get(timeout=300)
+        finally:
+            if router is not None:
+                router.stop()
+            provider.stop_all()
+
+
+# ---------------------------------------------------------------------------
+# load generator
+# ---------------------------------------------------------------------------
+
+class TestLoadgen:
+    def test_run_load_zero_lost_and_parity(self, model):
+        from tools.loadgen import gen_prompts, run_load
+        srv = LLMServer(model, max_batch=2, max_seq_len=64,
+                        page_size=8, max_queue=8).start()
+        w = LLMWorker(srv, role="decode").start()
+        try:
+            prompts = gen_prompts(4, seed=0)
+            goldens = [_generate(model, p, 3) for p in prompts]
+            res = run_load(w.address, prompts, max_new_tokens=3,
+                           qps=50.0, concurrency=2)
+            assert res["lost"] == 0, res["errors"]
+            assert res["ok"] == 4
+            assert res["outputs"] == goldens
+            assert res["latency_p99_ms"] is not None
+        finally:
+            w.stop()
+            srv.stop()
+
+    def test_sketch_window_isolates_the_soak(self):
+        from bigdl_tpu.observability.sketch import QuantileSketch
+        from tools.loadgen import sketch_window
+        sk = QuantileSketch()
+        for v in (1.0, 1.0, 1.0):
+            sk.observe(v)
+        before = sk.to_snapshot()
+        for v in (100.0, 100.0, 100.0):
+            sk.observe(v)
+        win = sketch_window(before, sk.to_snapshot(), qs=(0.5,))
+        assert win[0.5] == pytest.approx(100.0, rel=0.05), \
+            "the window leaked pre-soak samples"
+        assert sketch_window(before, before, qs=(0.5,))[0.5] is None
+        assert sketch_window(None, None, qs=(0.5,))[0.5] is None
+
+
+# ---------------------------------------------------------------------------
+# disabled mode: bigdl.llm.fleet.enabled=false is structurally absent
+# ---------------------------------------------------------------------------
+
+class TestFleetDisabled:
+    def test_structural_absence(self, model):
+        # the gate defaults OFF
+        assert conf.get_bool("bigdl.llm.fleet.enabled", False) is False
+        assert fleet_enabled() is False
+        srv = LLMServer(model, max_batch=2, max_seq_len=64, page_size=8)
+        srv.start()
+        w = LLMWorker(srv, role="decode").start()
+        before = set(obs.render().splitlines()) if obs.enabled() \
+            else set()
+        router = LLMRouter([], [w.address], failover=True,
+                           start_prober=False).start()
+        try:
+            assert w._drain is None, \
+                "bigdl.llm.fleet.enabled=false built a drain"
+            assert router._fleet is None, \
+                "bigdl.llm.fleet.enabled=false built a controller"
+            st, _, _ = _req(w.address, "GET", "/worker_drain")
+            assert st == 404
+            st, _, _ = _req(w.address, "POST", "/worker_drain",
+                            {"action": "begin"})
+            assert st == 404
+            st, _, _ = _req(router.address, "GET", "/fleet/autoscaler")
+            assert st == 404
+            # serving a request mints no fleet series
+            st, body, _ = _req(router.address, "POST",
+                               "/worker_generate",
+                               {"prompt_ids": list(range(6)),
+                                "max_new_tokens": 2})
+            assert st == 200, body
+            if obs.enabled():
+                grown = "\n".join(
+                    set(obs.render().splitlines()) - before)
+                assert "bigdl_fleet_" not in grown, grown
+            assert not [t for t in threading.enumerate()
+                        if t.name.startswith("bigdl-fleet")], \
+                "disabled fleet started a thread"
+        finally:
+            router.stop()
+            w.stop()
+            srv.stop()
+
+    def test_fleet_router_requires_failover(self, model):
+        srv = LLMServer(model, max_batch=2, max_seq_len=64, page_size=8)
+        w = LLMWorker(srv, role="decode")
+        with pytest.raises(ValueError, match="failover"):
+            LLMRouter([], [w.address], failover=False, fleet=True)
+        w.stop()
+        srv.stop(drain=False)

@@ -220,6 +220,17 @@ def _flatten_full(rec: dict) -> Dict[str, float]:
         val = fb.get(field)
         if isinstance(val, (int, float)) and not isinstance(val, bool):
             flat[f"fleet.{field}"] = float(val)
+    # ISSUE 15: the elastic-fleet soak — tail latency paid WHILE the
+    # pool scales, plus the robustness invariants (requests_lost must
+    # pin at 0; scale-event counts drifting to 0 means the autoscaler
+    # stopped reacting)
+    fe = (((rec.get("extra") or {}).get("telemetry") or {})
+          .get("fleet_elastic") or {})
+    for field in ("ttft_p99_ms", "itl_p99_ms", "latency_p99_ms",
+                  "requests_lost", "scale_outs", "scale_ins"):
+        val = fe.get(field)
+        if isinstance(val, (int, float)) and not isinstance(val, bool):
+            flat[f"fleet_elastic.{field}"] = float(val)
     return flat
 
 

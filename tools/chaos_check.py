@@ -12,7 +12,7 @@ harness exists to catch.
 
 Usage:
     python tools/chaos_check.py [--seed N] [--events K] [--full]
-        [--kvcache | --kvtier | --failover | --all]
+        [--kvcache | --kvtier | --failover | --fleet | --all]
 
 Wired into ``bench.py``'s telemetry block as a smoke invocation and into
 pytest as ``-m chaos`` (kept out of tier-1 by the ``slow`` marker).
@@ -625,6 +625,291 @@ def run_failover_chaos(seed: int = 0, n_requests: int = 4,
         s2.stop()
 
 
+def run_fleet_chaos(seed: int = 0, smoke: bool = False) -> dict:
+    """ISSUE 15 acceptance: the elastic-fleet soak. A fleet-enabled
+    router (autoscaler + graceful drain) over a
+    :class:`LocalWorkerProvider` pool is driven by the closed-loop
+    load generator (tools/loadgen.py) through spike → scale-out →
+    worker KILLED mid-drain → scale-in cycles, with a seeded mid-stream
+    ``router.dispatch`` kill and ``worker.drain`` delays widening the
+    drain windows. The contract:
+
+    - **zero lost requests** across every phase (sheds retry, failures
+      fail over, drains bounce — none of it reaches the client);
+    - greedy outputs **bit-identical** to ``model.generate`` goldens;
+    - a gracefully drained worker's warm KV chains land on the
+      survivor and serve **prefix hits** there (asserted via a chain
+      only the drained worker held);
+    - the pool **converges** back to ``min`` workers;
+    - ``bigdl.llm.fleet.enabled=false`` is structurally absent: no
+      drain coordinator, no controller thread, no ``bigdl_fleet_*``
+      series, ``/worker_drain`` and ``/fleet/autoscaler`` answer 404.
+
+    ``smoke=True`` shrinks the request counts (same phases, same
+    assertions) for the bench telemetry block."""
+    import threading
+    import time as _time
+
+    import numpy as np
+
+    from bigdl_tpu import observability as obs
+    from bigdl_tpu import reliability as rel
+    from bigdl_tpu.llm.models.llama import LlamaConfig, LlamaForCausalLM
+    from bigdl_tpu.llm.serving import LLMServer
+    from bigdl_tpu.llm.worker import LLMRouter, LLMWorker
+    from bigdl_tpu.utils.conf import conf
+    from tools.loadgen import gen_prompts, run_load
+
+    n_requests = 6 if smoke else 8
+    model = LlamaForCausalLM.from_config(LlamaConfig.tiny(), seed=0,
+                                         max_cache_len=128)
+    prompts = gen_prompts(n_requests, seed=seed, shared_prefix=16)
+    budgets = [2 + 2 * (j % 2) for j in range(n_requests)]
+    want = [list(map(int,
+                     model.generate(p[None], max_new_tokens=b)
+                     [0, len(p):]))
+            for p, b in zip(prompts, budgets)]
+
+    def get(addr, path):
+        import http.client
+        import json as _json
+        conn = http.client.HTTPConnection(*addr, timeout=5)
+        try:
+            conn.request("GET", path)
+            r = conn.getresponse()
+            return r.status, _json.loads(r.read().decode())
+        finally:
+            conn.close()
+
+    # --- disabled-mode structural absence (bigdl.llm.fleet.enabled
+    # off, the default): no drain coordinator, endpoints 404, no
+    # controller thread, no bigdl_fleet_* series
+    s0 = LLMServer(model, max_batch=2, max_seq_len=64, page_size=8)
+    w0 = LLMWorker(s0, role="decode").start()
+    before = set(obs.render().splitlines()) if obs.enabled() else set()
+    r0 = LLMRouter([], [w0.address], failover=True,
+                   start_prober=False).start()
+    try:
+        assert w0._drain is None, "fleet-off worker built a drain"
+        assert r0._fleet is None, "fleet-off router built a controller"
+        st, _ = get(w0.address, "/worker_drain")
+        assert st == 404, f"/worker_drain answered {st} with fleet off"
+        st, _ = get(r0.address, "/fleet/autoscaler")
+        assert st == 404, f"/fleet/autoscaler answered {st} fleet-off"
+        if obs.enabled():
+            grown = "\n".join(set(obs.render().splitlines()) - before)
+            assert "bigdl_fleet_" not in grown, \
+                f"fleet-off mode grew fleet series:\n{grown}"
+        assert not [t for t in threading.enumerate()
+                    if t.name.startswith(("bigdl-fleet",))], \
+            "fleet-off mode started a fleet thread"
+    finally:
+        r0.stop()
+        w0.stop()
+        s0.stop(drain=False)
+
+    # --- the soak
+    from bigdl_tpu.llm.fleet import LocalWorkerProvider
+    with conf._lock:
+        prev_sync = conf._set_layer.get("bigdl.llm.kvtier.sync")
+    conf.set("bigdl.llm.kvtier.sync", "true")   # inline migrations:
+    was_enabled = rel.enabled()                 # deterministic spills
+    if not was_enabled:
+        rel.enable()
+    provider = LocalWorkerProvider(
+        model, server_kwargs=dict(
+            max_batch=2, max_seq_len=64, page_size=8, num_pages=24,
+            kvcache=True, kvtier=True, host_pages=64, max_queue=8))
+    router = None
+    plan = rel.FaultPlan(seed=seed)
+    try:
+        seed_addr = provider.launch()
+        seed_srv = provider.servers()[seed_addr]
+        # warm every served shape (full prefill buckets + the partial
+        # suffix shapes resumes and prefix hits use); the compiled-step
+        # cache is shared across engines, so scaled-out workers reuse
+        # these programs
+        for p, b in zip(prompts, budgets):
+            seed_srv.submit(p, max_new_tokens=b).get(timeout=600)
+            seed_srv.submit(p, max_new_tokens=b).get(timeout=600)
+        router = LLMRouter(
+            [], [seed_addr], failover=True, failover_attempts=8,
+            start_prober=False, fleet=True, provider=provider,
+            start_fleet=False, fleet_opts=dict(
+                min_workers=1, max_workers=3, interval=0.05,
+                cooldown=0.0, sustain=1, queue_high=1.0, idle_low=0.0,
+                drain_timeout=20.0)).start()
+        fleet = router._fleet
+
+        def tick_until(cond, timeout):
+            t0 = _time.time()
+            while _time.time() - t0 < timeout:
+                fleet.tick()
+                if cond():
+                    return True
+                _time.sleep(0.02)
+            return False
+
+        def pool_size():
+            with router._pool_lock:
+                return len(router.decode_workers)
+
+        # one mid-stream connection kill (the journal-resume path) +
+        # per-chain drain delays (widens the mid-drain kill window)
+        plan.add("router.dispatch", "raise", times=1, after=6)
+        plan.add("worker.drain", "delay", times=None, delay=0.05)
+        rel.set_plan(plan)
+
+        lost = 0
+        results = {}
+
+        def load_phase(name, qps):
+            out = {}
+
+            def run():
+                out["res"] = run_load(router.address, prompts,
+                                      max_new_tokens=budgets, qps=qps,
+                                      concurrency=4)
+            t = threading.Thread(target=run, daemon=True)
+            t.start()
+            return t, out
+
+        # phase A: spike against one worker -> sustained queue
+        # pressure -> scale-out; a seeded mid-stream kill fails over
+        t, holder = load_phase("spike", qps=200.0)
+        scaled = tick_until(lambda: pool_size() >= 2, timeout=30.0)
+        t.join(timeout=600)
+        res_a = holder["res"]
+        results["spike"] = {k: res_a[k] for k in
+                            ("sent", "ok", "lost", "retries_503")}
+        lost += res_a["lost"]
+        if not scaled:
+            raise AssertionError(
+                "fleet soak: the load spike never scaled the pool out "
+                f"(signals: {fleet.signals()})")
+        if res_a["outputs"] != want:
+            raise AssertionError(
+                f"fleet soak divergence in the spike phase: "
+                f"{res_a['outputs']} vs {want}")
+
+        # phase B: idle -> scale-in begins -> KILL the victim
+        # mid-drain; the controller must remove the corpse, losing
+        # nothing (its in-flight was already drained, its chains
+        # re-prefill)
+        if not tick_until(lambda: fleet._draining is not None,
+                          timeout=30.0):
+            raise AssertionError(
+                "fleet soak: idle pool never began a scale-in drain")
+        victim = tuple(fleet._draining["addr"])
+        deadline = _time.time() + 10.0
+        while _time.time() < deadline:
+            try:
+                _st, body = get(victim, "/worker_drain")
+            except Exception:   # noqa: BLE001
+                break
+            if body.get("state") in ("migrating", "drained"):
+                break
+            _time.sleep(0.01)
+        provider.kill(victim)
+        if not tick_until(lambda: fleet._draining is None, timeout=30.0):
+            raise AssertionError(
+                "fleet soak: the controller never resolved the "
+                "killed-mid-drain worker")
+        if fleet.drains_lost < 1:
+            raise AssertionError(
+                "fleet soak: the mid-drain kill was not observed as a "
+                f"lost drain (events: {fleet.events[-8:]})")
+
+        # phase C: spike again -> scale out; plant a chain ONLY the
+        # new worker holds; idle -> GRACEFUL drain must migrate it to
+        # the survivor, where it serves a prefix hit
+        t, holder = load_phase("respike", qps=200.0)
+        scaled = tick_until(lambda: pool_size() >= 2, timeout=30.0)
+        t.join(timeout=600)
+        res_c = holder["res"]
+        results["respike"] = {k: res_c[k] for k in
+                              ("sent", "ok", "lost", "retries_503")}
+        lost += res_c["lost"]
+        if not scaled:
+            raise AssertionError(
+                "fleet soak: the second spike never scaled out")
+        if res_c["outputs"] != want:
+            raise AssertionError(
+                f"fleet soak divergence in the respike phase: "
+                f"{res_c['outputs']} vs {want}")
+        with router._pool_lock:
+            newbie = tuple(router.decode_workers[-1])
+        if newbie == seed_addr:
+            raise AssertionError("fleet soak: LIFO victim selection "
+                                 "would drain the seed worker")
+        rs = np.random.RandomState(seed + 1234)
+        unique = rs.randint(0, 250, 24).astype(np.int32)
+        new_srv = provider.servers()[newbie]
+        new_srv.submit(unique, max_new_tokens=2).get(timeout=600)
+        reused_before = seed_srv._kv.prefix_tokens_reused
+        if not tick_until(
+                lambda: fleet.scale_ins >= 1 and pool_size() == 1,
+                timeout=60.0):
+            raise AssertionError(
+                "fleet soak: the graceful scale-in never converged "
+                f"(events: {fleet.events[-8:]})")
+        graceful = [e for e in fleet.events
+                    if e["action"] == "scale_in"
+                    and e.get("outcome") == "drained"]
+        if not graceful or not any(e.get("chains", 0) > 0
+                                   for e in graceful):
+            raise AssertionError(
+                "fleet soak: the graceful drain migrated no warm KV "
+                f"chains (events: {fleet.events[-8:]})")
+        # the migrated chain serves a prefix hit on the survivor
+        seed_srv.submit(unique, max_new_tokens=2).get(timeout=600)
+        reused_after = seed_srv._kv.prefix_tokens_reused
+        if reused_after <= reused_before:
+            raise AssertionError(
+                "fleet soak: the survivor served no prefix hit from "
+                "the drained worker's migrated chains "
+                f"(reused {reused_before} -> {reused_after})")
+
+        if not any(s == "router.dispatch" for s, _ in plan.fired):
+            raise AssertionError(
+                "fleet soak armed but the mid-stream router.dispatch "
+                "kill never fired — widen the kill window")
+        if lost:
+            raise AssertionError(
+                f"fleet soak lost {lost} request(s): {results}")
+        # the engine ledger is back to idle on the survivor (every
+        # page charge returned across all the churn)
+        idle_budget = seed_srv._budget_avail
+        out = {
+            "seed": seed,
+            "requests_per_phase": n_requests,
+            "phases": results,
+            "events_fired": [f"{s}:{a}" for s, a in plan.fired],
+            "scale_outs": fleet.scale_outs,
+            "scale_ins": fleet.scale_ins,
+            "drains_lost": fleet.drains_lost,
+            "chains_migrated": sum(e.get("chains", 0)
+                                   for e in graceful),
+            "failovers": router.failovers,
+            "converged_workers": pool_size(),
+            "survivor_idle_budget": idle_budget,
+            "lost_requests": lost,
+            "match": True,
+        }
+        return out
+    finally:
+        rel.set_plan(None)
+        if not was_enabled:
+            rel.disable()
+        if router is not None:
+            router.stop()
+        provider.stop_all()
+        if prev_sync is None:
+            conf.unset("bigdl.llm.kvtier.sync")
+        else:
+            conf.set("bigdl.llm.kvtier.sync", prev_sync)
+
+
 class ElasticUnsupported(RuntimeError):
     """This jax build cannot do loopback multi-process distributed
     init — the elastic pass is skipped, mirroring the graceful skip in
@@ -915,6 +1200,8 @@ def run_all_chaos(seed: int = 0) -> dict:
                          ("mixed", lambda: run_mixed_chaos(seed=seed)),
                          ("failover", lambda: run_failover_chaos(
                              seed=seed, smoke=True)),
+                         ("fleet", lambda: run_fleet_chaos(
+                             seed=seed, smoke=True)),
                          ("elastic", lambda: run_elastic_chaos(
                              seed=seed, smoke=True))):
             try:
@@ -965,6 +1252,13 @@ def main():
                          "decode-worker kills and watchdog-tripping "
                          "engine stalls must lose zero requests with "
                          "greedy outputs bit-identical (ISSUE 7)")
+    ap.add_argument("--fleet", action="store_true",
+                    help="run the elastic-fleet soak: load spike -> "
+                         "scale-out -> worker killed mid-drain -> "
+                         "scale-in, with zero lost requests, greedy "
+                         "outputs bit-identical to a clean run, and "
+                         "drained workers' warm KV chains serving "
+                         "prefix hits on survivors (ISSUE 15)")
     ap.add_argument("--elastic", action="store_true",
                     help="run the elastic-training pass: a seeded kill "
                          "of 1-of-2 DistriOptimizer processes mid-"
@@ -973,9 +1267,9 @@ def main():
                          "run (ISSUE 10)")
     ap.add_argument("--all", action="store_true",
                     help="run every chaos suite (train, kvcache, "
-                         "kvtier, mixed, failover, elastic) and report "
-                         "one record per pass (the bench.py chaos_all "
-                         "block)")
+                         "kvtier, mixed, failover, fleet, elastic) and "
+                         "report one record per pass (the bench.py "
+                         "chaos_all block)")
     ap.add_argument("--cpu", action="store_true",
                     help="force the CPU backend (sitecustomize pins the "
                          "axon TPU platform; env vars are ineffective)")
@@ -991,6 +1285,8 @@ def main():
         return
     if args.elastic:
         out = run_elastic_chaos(seed=args.seed)
+    elif args.fleet:
+        out = run_fleet_chaos(seed=args.seed)
     elif args.mixed:
         out = run_mixed_chaos(seed=args.seed)
     elif args.failover:

@@ -1,0 +1,378 @@
+"""Closed-loop load generator for the serving stack (ISSUE 15).
+
+Drives mixed prompt/output-length traffic at a controlled arrival rate
+through the full router → prefill → decode path (``POST
+/worker_generate`` on any router or worker address) and reports what a
+client actually saw: per-request completion-latency percentiles (its
+own per-run :class:`~bigdl_tpu.observability.sketch.QuantileSketch` —
+independent of the process-global registry), 503-shed retries, and the
+number the fleet soak is judged on — **requests lost** (a request is
+lost only when it exhausts its retries or fails non-retriably; a shed
+that later succeeds is latency, not loss).
+
+The generator is closed-loop with scheduled arrivals: request *i* is
+due at ``t0 + i/qps``; a bounded pool of client threads picks up due
+requests (falling behind under overload instead of stacking unbounded
+connections — the closed-loop part), and each 503 backs off by the
+server's own ``Retry-After`` (capped) before retrying.
+
+Outputs are collected **per prompt index**, so callers can assert greedy
+bit-parity against a clean run — ``tools/chaos_check.py --fleet`` does
+exactly that while killing workers mid-drain.
+
+Router-scope TTFT/ITL under soak (``bigdl_router_ttft_seconds`` /
+``bigdl_router_itl_seconds`` sketches, ``bigdl.slo.enabled``) are
+cumulative in the process registry; :func:`sketch_window` subtracts a
+before-snapshot from an after-snapshot bucket-wise (sketch buckets are
+plain counts, so the difference is itself a valid sketch of exactly the
+in-between samples) — that is how ``bench.py``'s ``fleet_elastic``
+block reports honest per-soak p99s from a shared registry.
+
+Usage:
+    python tools/loadgen.py --url 127.0.0.1:8000 --requests 64 \
+        --qps 20 [--max-new 8] [--seed 0]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+#: mixed prompt-length ladder (tokens) the seeded generator cycles
+#: through — short chat turns to page-spanning contexts
+PROMPT_LENS = (6, 10, 16, 24, 40)
+#: mixed output budgets paired with them
+OUTPUT_LENS = (2, 4, 6, 8)
+
+
+def gen_prompts(n: int, seed: int = 0, vocab: int = 250,
+                shared_prefix: int = 0) -> List[Any]:
+    """``n`` seeded int32 prompts over the length ladder; an optional
+    shared prefix makes the workload prefix-cache-friendly (the drain
+    migration's warm chains come from exactly such sharing)."""
+    import numpy as np
+    rs = np.random.RandomState(seed)
+    prefix = rs.randint(0, vocab, shared_prefix).astype(np.int32) \
+        if shared_prefix else None
+    out = []
+    for j in range(n):
+        body = rs.randint(0, vocab,
+                          PROMPT_LENS[j % len(PROMPT_LENS)]) \
+            .astype(np.int32)
+        out.append(body if prefix is None
+                   else np.concatenate([prefix, body]))
+    return out
+
+
+def _post(addr: Tuple[str, int], body: dict, timeout: float):
+    import http.client
+    conn = http.client.HTTPConnection(addr[0], addr[1], timeout=timeout)
+    try:
+        conn.request("POST", "/worker_generate", json.dumps(body),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        data = resp.read()
+        try:
+            parsed = json.loads(data.decode())
+        except ValueError:
+            parsed = {"error": data.decode(errors="replace")[:200]}
+        return resp.status, parsed, resp.msg
+    finally:
+        conn.close()
+
+
+def run_load(addr: Tuple[str, int], prompts: Sequence[Any],
+             max_new_tokens: Any = 4, qps: float = 20.0,
+             concurrency: int = 4,
+             max_retries: int = 20, retry_cap_s: float = 0.25,
+             request_timeout: float = 120.0) -> Dict[str, Any]:
+    """Drive ``prompts`` through ``addr`` at ``qps`` scheduled arrivals.
+    ``max_new_tokens`` may be one int or a per-prompt sequence of the
+    same length (the mixed-output part of the soak). Returns the result
+    record described in the module docstring; ``outputs[i]`` is request
+    ``i``'s token list (None when lost — the zero-lost assertion is
+    ``lost == 0``)."""
+    from bigdl_tpu.observability.sketch import QuantileSketch
+    n = len(prompts)
+    if isinstance(max_new_tokens, (list, tuple)):
+        if len(max_new_tokens) != n:
+            raise ValueError(
+                f"max_new_tokens has {len(max_new_tokens)} entries "
+                f"for {n} prompts")
+        budgets = [int(v) for v in max_new_tokens]
+    else:
+        budgets = [int(max_new_tokens)] * n
+    outputs: List[Optional[List[int]]] = [None] * n
+    errors: List[dict] = []
+    sketch = QuantileSketch()
+    lock = threading.Lock()
+    counters = {"ok": 0, "lost": 0, "retries_503": 0}
+    next_idx = [0]
+    t0 = time.perf_counter()
+
+    def take() -> Optional[int]:
+        with lock:
+            if next_idx[0] >= n:
+                return None
+            i = next_idx[0]
+            next_idx[0] += 1
+        due = t0 + i / max(qps, 1e-9)
+        delay = due - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        return i
+
+    def client():
+        while True:
+            i = take()
+            if i is None:
+                return
+            body = {"prompt_ids": [int(t) for t in prompts[i]],
+                    "max_new_tokens": budgets[i]}
+            t_req = time.perf_counter()
+            last_err = "retries exhausted"
+            done = False
+            for _attempt in range(max_retries + 1):
+                try:
+                    status, parsed, hdrs = _post(addr, body,
+                                                 request_timeout)
+                except Exception as e:  # noqa: BLE001 — retriable
+                    last_err = f"transport: {e}"
+                    time.sleep(min(0.05, retry_cap_s))
+                    continue
+                if status == 200:
+                    with lock:
+                        outputs[i] = [int(t)
+                                      for t in parsed["output_ids"]]
+                        counters["ok"] += 1
+                        sketch.observe(time.perf_counter() - t_req)
+                    done = True
+                    break
+                if status == 503:
+                    # backpressure: honor the server's Retry-After
+                    # (capped — the soak must finish), then retry.
+                    # Shed-then-served is latency, never loss.
+                    with lock:
+                        counters["retries_503"] += 1
+                    try:
+                        ra = float(hdrs.get("Retry-After") or 0.05)
+                    except (TypeError, ValueError):
+                        ra = 0.05
+                    time.sleep(min(max(ra, 0.01), retry_cap_s))
+                    last_err = f"503: {parsed.get('error', '')}"
+                    continue
+                last_err = f"{status}: {parsed.get('error', '')}"
+                break
+            if not done:
+                with lock:
+                    counters["lost"] += 1
+                    errors.append({"request": i, "error": last_err})
+
+    threads = [threading.Thread(target=client,
+                                name=f"bigdl-loadgen-{k}", daemon=True)
+               for k in range(max(1, concurrency))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    qs = sketch.quantiles((0.5, 0.95, 0.99))
+    return {
+        "sent": n,
+        "ok": counters["ok"],
+        "lost": counters["lost"],
+        "retries_503": counters["retries_503"],
+        "wall_s": round(wall, 3),
+        "achieved_qps": round(counters["ok"] / max(wall, 1e-9), 2),
+        "latency_p50_ms": _ms(qs.get(0.5)),
+        "latency_p95_ms": _ms(qs.get(0.95)),
+        "latency_p99_ms": _ms(qs.get(0.99)),
+        "outputs": outputs,
+        "errors": errors[:16],
+    }
+
+
+def _ms(v: Optional[float]) -> Optional[float]:
+    return None if v is None else round(v * 1000.0, 3)
+
+
+# ---------------------------------------------------------------------------
+# registry-sketch windows (per-soak TTFT/ITL out of a shared registry)
+# ---------------------------------------------------------------------------
+
+def registry_sketch_snapshot(name: str) -> Optional[dict]:
+    """The unlabeled series' sketch snapshot for metric ``name`` from
+    the process registry (None when absent — e.g. SLO off)."""
+    from bigdl_tpu import observability as obs
+    from bigdl_tpu.observability.metrics import _SketchChild
+    for m in obs.REGISTRY.collect():
+        if m.name != name:
+            continue
+        for _key, child in m.children():
+            if isinstance(child, _SketchChild):
+                return child.to_snapshot()
+    return None
+
+
+def sketch_window(before: Optional[dict], after: Optional[dict],
+                  qs=(0.5, 0.95, 0.99)) -> Dict[float, Optional[float]]:
+    """Quantiles of the samples observed BETWEEN two snapshots of one
+    cumulative sketch. Bucket counts only grow, so the bucket-wise
+    difference is itself a valid sketch of exactly the window's
+    samples."""
+    from bigdl_tpu.observability.sketch import QuantileSketch
+    if after is None:
+        return {q: None for q in qs}
+    if before is None:
+        return QuantileSketch.from_snapshot(after).quantiles(qs)
+    delta = {
+        "alpha": after["alpha"],
+        "gamma": after["gamma"],
+        "zero": int(after.get("zero", 0)) - int(before.get("zero", 0)),
+        "count": int(after.get("count", 0))
+        - int(before.get("count", 0)),
+        "sum": float(after.get("sum", 0.0))
+        - float(before.get("sum", 0.0)),
+        # min/max cannot be windowed; the after-run envelope is the
+        # honest conservative stand-in (quantiles read buckets only)
+        "min": after.get("min"),
+        "max": after.get("max"),
+        "buckets": {},
+    }
+    bb = before.get("buckets", {})
+    for k, c in after.get("buckets", {}).items():
+        d = int(c) - int(bb.get(k, 0))
+        if d > 0:
+            delta["buckets"][k] = d
+    if delta["count"] <= 0:
+        return {q: None for q in qs}
+    return QuantileSketch.from_snapshot(delta).quantiles(qs)
+
+
+def run_fleet_soak(n_requests: int = 8, qps: float = 100.0,
+                   seed: int = 0) -> Dict[str, Any]:
+    """The ``fleet_elastic`` bench telemetry block (ISSUE 15): a
+    fault-free soak of the elastic fleet — spike against one worker,
+    autoscaler scale-out, graceful drain-and-scale-in back to the
+    floor — reporting client-visible p99 TTFT / engine p99 ITL for
+    exactly this soak's requests (SLO sketch windows), requests lost
+    (must be 0), and the scale-event counts. The chaos variant with
+    kills lives in ``tools/chaos_check.py --fleet``."""
+    import time as _time
+
+    from bigdl_tpu.llm.fleet import LocalWorkerProvider
+    from bigdl_tpu.llm.models.llama import LlamaConfig, LlamaForCausalLM
+    from bigdl_tpu.llm.worker import LLMRouter
+    from bigdl_tpu.utils.conf import conf
+
+    model = LlamaForCausalLM.from_config(LlamaConfig.tiny(), seed=0,
+                                         max_cache_len=128)
+    prompts = gen_prompts(n_requests, seed=seed, shared_prefix=16)
+    with conf._lock:
+        prev_sync = conf._set_layer.get("bigdl.llm.kvtier.sync")
+    conf.set("bigdl.llm.kvtier.sync", "true")
+    provider = LocalWorkerProvider(
+        model, server_kwargs=dict(
+            max_batch=2, max_seq_len=64, page_size=8, num_pages=24,
+            kvcache=True, kvtier=True, host_pages=64, max_queue=8,
+            slo=True))
+    router = None
+    ttft_before = registry_sketch_snapshot("bigdl_router_ttft_seconds")
+    itl_before = registry_sketch_snapshot("bigdl_llm_itl_seconds")
+    try:
+        seed_addr = provider.launch()
+        srv = provider.servers()[seed_addr]
+        for p in prompts:       # warm the shared compiled-step cache
+            srv.submit(p, max_new_tokens=1).get(timeout=600)
+            srv.submit(p, max_new_tokens=1).get(timeout=600)
+        router = LLMRouter(
+            [], [seed_addr], failover=True, failover_attempts=8,
+            start_prober=False, slo=True, fleet=True,
+            provider=provider, start_fleet=False,
+            fleet_opts=dict(min_workers=1, max_workers=3,
+                            interval=0.05, cooldown=0.0, sustain=1,
+                            queue_high=1.0, idle_low=0.0,
+                            drain_timeout=20.0)).start()
+        fleet = router._fleet
+        import threading as _threading
+        holder: Dict[str, Any] = {}
+
+        def _run():
+            holder["res"] = run_load(router.address, prompts,
+                                     max_new_tokens=4, qps=qps,
+                                     concurrency=4)
+        t = _threading.Thread(target=_run, daemon=True)
+        t.start()
+        deadline = _time.time() + 60.0
+        while _time.time() < deadline:
+            fleet.tick()
+            if not t.is_alive() and fleet.scale_ins >= 1 and \
+                    len(router.decode_workers) == 1:
+                break
+            _time.sleep(0.02)
+        t.join(timeout=600)
+        res = holder.get("res") or {}
+        ttft = sketch_window(
+            ttft_before,
+            registry_sketch_snapshot("bigdl_router_ttft_seconds"))
+        itl = sketch_window(
+            itl_before,
+            registry_sketch_snapshot("bigdl_llm_itl_seconds"))
+        return {
+            "requests": n_requests,
+            "qps_target": qps,
+            "requests_lost": int(res.get("lost", 0)),
+            "retries_503": int(res.get("retries_503", 0)),
+            "scale_outs": fleet.scale_outs,
+            "scale_ins": fleet.scale_ins,
+            "converged_workers": len(router.decode_workers),
+            "latency_p99_ms": res.get("latency_p99_ms"),
+            "ttft_p50_ms": _ms(ttft.get(0.5)),
+            "ttft_p99_ms": _ms(ttft.get(0.99)),
+            "itl_p99_ms": _ms(itl.get(0.99)),
+        }
+    finally:
+        if router is not None:
+            router.stop()
+        provider.stop_all()
+        if prev_sync is None:
+            conf.unset("bigdl.llm.kvtier.sync")
+        else:
+            conf.set("bigdl.llm.kvtier.sync", prev_sync)
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--url", required=True,
+                    help="router or worker address, host:port")
+    ap.add_argument("--requests", type=int, default=64)
+    ap.add_argument("--qps", type=float, default=20.0)
+    ap.add_argument("--max-new", type=int, default=4)
+    ap.add_argument("--concurrency", type=int, default=4)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--shared-prefix", type=int, default=0,
+                    help="tokens of seeded shared prefix across all "
+                         "prompts (exercises the prefix cache)")
+    args = ap.parse_args()
+    host, port = args.url.rsplit(":", 1)
+    prompts = gen_prompts(args.requests, seed=args.seed,
+                          shared_prefix=args.shared_prefix)
+    out = run_load((host, int(port)), prompts,
+                   max_new_tokens=args.max_new, qps=args.qps,
+                   concurrency=args.concurrency)
+    out.pop("outputs")          # token lists are for parity asserts,
+    print(json.dumps(out, indent=1))   # not for the CLI report
+    if out["lost"]:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()
