@@ -947,6 +947,15 @@ def _telemetry_block() -> dict:
         # — the MFU numbers above are attributed to these executables
         "compiles": obs.compile_stats(),
     }
+    # ISSUE 16: arm the decision-event flight recorder for the
+    # serving-driven microbenches below — the same gate enables the
+    # per-dispatch wall-time sampler whose join with the obs.compiled
+    # cost analyses yields the live roofline block captured at the end.
+    # Restored before return so the gate stays default-off elsewhere.
+    from bigdl_tpu.observability import utilization
+    from bigdl_tpu.utils.conf import conf as _conf
+    _flight_prior = _conf.get("bigdl.observability.flight.enabled")
+    _conf.set("bigdl.observability.flight.enabled", "true")
     try:
         # ISSUE 7 satellite: every chaos suite in one block — train
         # recovery, kvcache eviction races, kvtier migration faults,
@@ -1032,6 +1041,22 @@ def _telemetry_block() -> dict:
         out["fleet_elastic"] = run_fleet_soak()
     except Exception as e:
         out["fleet_elastic"] = {"error": repr(e)}
+    try:
+        # ISSUE 16: the live roofline — per-dispatch wall time sampled
+        # while the serving microbenches above ran, joined with the
+        # XLA cost analyses into achieved GB/s, MFU and bandwidth
+        # utilization plus the per-program table (bench_regress lifts
+        # util.mfu / util.hbm_bw_gbps; on real TPU the headline
+        # hbm_bw_gbps should land near the decode bench's
+        # implied_hbm_gbs weight-stream lower bound)
+        out["utilization"] = utilization.snapshot()
+    except Exception as e:
+        out["utilization"] = {"error": repr(e)}
+    finally:
+        if _flight_prior is None:
+            _conf.unset("bigdl.observability.flight.enabled")
+        else:
+            _conf.set("bigdl.observability.flight.enabled", _flight_prior)
     return out
 
 

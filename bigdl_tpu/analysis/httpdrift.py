@@ -47,9 +47,13 @@ from . import registries
 from .core import Finding, ModuleInfo, ProjectIndex
 from .registrydrift import load_docs
 
-#: routes the shared tracing.debug_endpoint helper serves (with its own
-#: observability-gate 404 inside the helper)
-DEBUG_HELPER_ROUTES = ("/debug/traces", "/debug/trace/*")
+#: routes the shared debug_endpoint helpers serve (each with its own
+#: gate 404 inside the helper): tracing.debug_endpoint for the trace
+#: pair, flight.debug_endpoint for the flight/explain pair — a handler
+#: calling either helper serves all four (unowned paths return None and
+#: fall through to the next helper / elif chain)
+DEBUG_HELPER_ROUTES = ("/debug/traces", "/debug/trace/*",
+                       "/debug/flight", "/debug/explain/*")
 
 #: client callables whose string args are request paths
 _CLIENT_FUNCS = frozenset({"request", "_call", "post", "_post", "_get",

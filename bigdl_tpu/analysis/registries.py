@@ -151,6 +151,10 @@ CONF_KEYS.update({
         "worker role: '' unified, 'prefill' or 'decode' side of the KV handoff",
     "bigdl.llm.watchdog.step_timeout":
         "engine watchdog: a stalled step flips /healthz and fails retriably; 0 = off",
+    "bigdl.device.peak.gbps":
+        "peak HBM GB/s for the roofline gauges; 0 = auto from device_kind",
+    "bigdl.device.peak.tflops":
+        "peak dense bf16 TFLOP/s for the roofline gauges; 0 = auto",
     "bigdl.mesh.axes":
         "comma-separated axis names",
     "bigdl.mesh.shape":
@@ -165,6 +169,10 @@ CONF_KEYS.update({
         "fleet collector + /metrics/snapshot + /fleet/status; false = absent",
     "bigdl.observability.federation.interval":
         "member scrape cadence (seconds)",
+    "bigdl.observability.flight.capacity":
+        "flight-recorder ring entries (oldest decision events dropped)",
+    "bigdl.observability.flight.enabled":
+        "flight recorder + explain endpoints + roofline gauges; false = absent",
     "bigdl.observability.sketch.alpha":
         "quantile-sketch relative-error bound (merge requires equal alpha)",
     "bigdl.observability.trace.capacity":
@@ -210,6 +218,12 @@ METRICS.update({
         "Collective call sites traced",
     "bigdl_collective_traced_bytes_total":
         "Input payload bytes per compiled collective call site (trace-time accounting: multiply by executions, and by the op's wire amplification — e.g. ~(n-1) recv copies for all_gather, ~2(n-1)/n for ring all_reduce — for actual traffic)",
+    "bigdl_device_bw_util":
+        "Achieved HBM bandwidth as a fraction of the platform peak — the live decode-is-bandwidth-bound alarm",
+    "bigdl_device_hbm_bw_gbps":
+        "Achieved HBM traffic (cost-analysis bytes accessed per wall second) over the recent sampled-dispatch window",
+    "bigdl_device_mfu":
+        "Achieved flops / peak dense bf16 flops over the recent sampled-dispatch window",
     "bigdl_elastic_committed_step":
         "Newest snapshot step every live peer has taken",
     "bigdl_elastic_flushes_total":
@@ -248,6 +262,8 @@ METRICS.update({
         "Autoscaler pool changes by direction",
     "bigdl_fleet_workers":
         "Decode-pool size the autoscaler currently maintains",
+    "bigdl_flight_events_total":
+        "Flight-recorder decision events by kind",
     "bigdl_kvcache_evictions_total":
         "Pages evicted from the prefix index under pool pressure",
     "bigdl_kvcache_hits_total":
@@ -541,6 +557,10 @@ FEATURE_GATES.update({
     "bigdl.observability.federation": {
         "package": "bigdl_tpu/observability/federation.py",
         "desc": "fleet collector + snapshot endpoints"},
+    "bigdl.observability.flight.enabled": {
+        "package": "bigdl_tpu/observability/flight.py",
+        "desc": "decision-event ring + explain endpoints + live "
+                "roofline gauges (utilization.py shares the gate)"},
     "bigdl.reliability.enabled": {
         "package": None,            # pervasive: runtime-gated via _state
         "desc": "fault sites + retry/deadline/breaker policies"},
@@ -556,6 +576,16 @@ HTTP_ENDPOINTS.update({
     "/debug/kvcache": {
         "methods": ("GET",), "gate": "bigdl.llm.kvcache.enabled",
         "desc": "prefix-cache pool/radix/tier state"},
+    "/debug/explain/*": {
+        "methods": ("GET",),
+        "gate": "bigdl.observability.flight.enabled",
+        "gate404": "helper",
+        "desc": "causal decision timeline + verdict for one request id"},
+    "/debug/flight": {
+        "methods": ("GET",),
+        "gate": "bigdl.observability.flight.enabled",
+        "gate404": "helper",
+        "desc": "recent flight-recorder ring (?kind=/?request=/?limit=)"},
     "/debug/trace/*": {
         "methods": ("GET",), "gate": "bigdl.observability.enabled",
         "gate404": "helper",

@@ -122,6 +122,13 @@ class RequestJournal:
         with self._lock:
             self.failovers += 1
             self.tokens_resumed += len(ent.tokens)
+        # same site as the ledger: the flight cross-check asserts
+        # failover events reconcile exactly with the router counter
+        # (trace id picked up from the routing thread's ambient context)
+        from bigdl_tpu.observability import flight
+        flight.record("failover", entry=ent.id,
+                      tokens_resumed=len(ent.tokens),
+                      attempt=ent.attempts)
 
     def complete(self, ent: JournalEntry):
         with self._lock:

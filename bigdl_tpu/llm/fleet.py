@@ -59,6 +59,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from bigdl_tpu import observability as obs
 from bigdl_tpu import reliability
+from bigdl_tpu.observability import flight
 
 
 def fleet_enabled(override: Optional[bool] = None) -> bool:
@@ -277,6 +278,8 @@ class DrainCoordinator:
                 with self._lock:
                     self.migrated_chains += 1
                     self.migrated_pages += landed
+                flight.record("drain_migrate", pages=landed,
+                              peer=f"{peer[0]}:{peer[1]}")
                 if ins is not None:
                     ins["chains"].inc()
             else:
@@ -784,6 +787,10 @@ class FleetController:
         with self._lock:
             self.events.append(ev)
             del self.events[:-64]
+        if action in ("scale_out", "scale_in"):
+            flight.record(action, backend=ev["backend"],
+                          **{k: v for k, v in ev.items()
+                               if k in ("signals", "outcome", "chains")})
 
     def _instruments(self):
         if not obs.enabled():

@@ -511,6 +511,12 @@ class KVCacheManager:
                 else None)
             self.evictions += len(freed)
             self._count("evictions", len(freed))
+            if freed:
+                # same site as the counter: the flight cross-check
+                # asserts Σ(evict event pages) == evictions_total
+                from bigdl_tpu.observability import flight
+                flight.record("evict", pages=len(freed),
+                              requested=short)
             self.record_gauges()
             if len(freed) < short:
                 raise PagePoolError(

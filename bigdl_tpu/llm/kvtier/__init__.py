@@ -109,6 +109,8 @@ class KVTier:
 
     def count_spill(self, n: int = 1):
         self.spills += n
+        from bigdl_tpu.observability import flight
+        flight.record("spill", pages=n)
         ins = self._instruments()
         if ins is not None:
             ins["spills"].inc(n)

@@ -47,7 +47,16 @@ from bigdl_tpu import observability as obs
 from bigdl_tpu import reliability
 from bigdl_tpu.llm.kernels.sampling import make_sampled_step
 from bigdl_tpu.llm.kvcache import KVCacheManager
+from bigdl_tpu.observability import flight
 from bigdl_tpu.observability import request_context as rc
+from bigdl_tpu.observability import utilization
+
+
+def _trace_of(req) -> Optional[str]:
+    """The trace id riding a Request handle, if the submitter had one
+    (flight events must stitch into the PR-3 trace model)."""
+    t = getattr(req, "trace", None)
+    return t.get("trace_id") if t else None
 
 
 def _llm_instruments():
@@ -755,7 +764,9 @@ class LLMServer:
                     f"the pool holds {self._num_pages - 1}; it could "
                     "never be admitted")
         if self._draining.is_set():
-            reliability.count_shed("llm_server")
+            reliability.count_shed("llm_server", request_id=req.id,
+                                   trace_id=_trace_of(req),
+                                   reason="draining")
             err = reliability.OverloadError(
                 "server is draining: not accepting new requests")
             # structured marker (ISSUE 15): the worker's 503 body
@@ -781,11 +792,19 @@ class LLMServer:
             # the 503 carries the page accounting (post-lookup suffix
             # cost vs budget actually free) so clients and the shed
             # counter can tell queue pressure from page pressure
+            shed_detail = dict(
+                request_id=req.id, trace_id=_trace_of(req),
+                queue_depth=self._queue.qsize(),
+                pages_needed=pages["pages_needed"] if pages else None,
+                pages_free=pages["pages_free"] if pages else None)
             if pages is not None and \
                     pages["pages_needed"] > pages["pages_free"]:
-                reliability.count_shed("llm_server_pages")
+                reliability.count_shed("llm_server_pages",
+                                       reason="page_pressure",
+                                       **shed_detail)
             else:
-                reliability.count_shed("llm_server")
+                reliability.count_shed("llm_server",
+                                       reason="queue_full", **shed_detail)
             msg = (f"request queue full ({self.max_queue} waiting); "
                    "retry later")
             if pages is not None:
@@ -797,6 +816,14 @@ class LLMServer:
                 err.pages_needed = pages["pages_needed"]
                 err.pages_free = pages["pages_free"]
             raise err from None
+        if flight.enabled:
+            flight.record(
+                "queue", request_id=req.id, trace_id=_trace_of(req),
+                prompt_tokens=len(req.prompt_ids),
+                max_new_tokens=req.max_new_tokens,
+                queue_depth=self._queue.qsize(),
+                pages_needed=pages["pages_needed"] if pages else None,
+                pages_free=pages["pages_free"] if pages else None)
         return req
 
     def export_chain(self, tokens) -> bytes:
@@ -1160,13 +1187,20 @@ class LLMServer:
             if not done and time.perf_counter() - ent["t0"] <= timeout:
                 k += 1
                 continue
-            if done and job is not None and job.ok \
-                    and not job.cancelled:
+            landed = (done and job is not None and job.ok
+                      and not job.cancelled)
+            if landed:
                 self._kv.materialize(adm, job.k_dev, job.v_dev)
             else:
                 self._kv.degrade(adm)   # failure/timeout → plain miss
             del self._fetch_wait[k]
             wait_s = time.perf_counter() - ent["t0"]
+            if flight.enabled:
+                flight.record(
+                    "fetch", request_id=req.id, trace_id=_trace_of(req),
+                    pages=len(adm.shared_pages),
+                    wait_ms=round(wait_s * 1000.0, 3),
+                    status="landed" if landed else "degraded")
             if req.trace:
                 obs.add_complete(
                     "kvtier/fetch_wait", time.time() - wait_s, wait_s,
@@ -1314,9 +1348,28 @@ class LLMServer:
                         "kvcache/lookup", time.time() - wall, wall,
                         request=req.id, matched_tokens=adm.matched_len,
                         prompt_tokens=len(req.prompt_ids))
+                    if flight.enabled:
+                        flight.record(
+                            "radix_hit" if adm.matched_len else
+                            "radix_miss", request_id=req.id,
+                            trace_id=_trace_of(req),
+                            matched_tokens=adm.matched_len,
+                            device_matched=adm.device_matched,
+                            prompt_tokens=len(req.prompt_ids))
+                        if adm.tail_src is not None:
+                            flight.record(
+                                "cow_fork", request_id=req.id,
+                                trace_id=_trace_of(req),
+                                src_page=adm.tail_src,
+                                tail_tokens=adm.tail_len)
                 if adm.fetch:
                     # host-tier hit: park until the upload lands; keep
                     # filling this slot from the queue meanwhile
+                    if flight.enabled:
+                        flight.record(
+                            "park", request_id=req.id,
+                            trace_id=_trace_of(req),
+                            pages=len(adm.fetch))
                     self._fetch_wait.append(
                         {"req": req, "adm": adm,
                          "t0": time.perf_counter()})
@@ -1341,6 +1394,12 @@ class LLMServer:
                 "llm/queue_wait", req.submitted_at,
                 time.time() - req.submitted_at, trace=ctx.trace_id,
                 stage="queue", request=req.id, **args)
+        if flight.enabled:
+            flight.record(
+                "admit", request_id=req.id, trace_id=_trace_of(req),
+                slot=i, chunked=chunked, prepaid=prepaid,
+                matched_tokens=adm.matched_len if adm else 0,
+                prompt_tokens=len(req.prompt_ids))
         if chunked:
             self._begin_chunked(i, req, adm, prepaid)
             return
@@ -1884,6 +1943,12 @@ class LLMServer:
                jnp.asarray(phys), jnp.asarray(slots),
                jnp.asarray(new_pages[0] if tail else 0, jnp.int32),
                jnp.asarray(adm.tail_src if tail else 0, jnp.int32))
+        if flight.enabled:
+            flight.record(
+                "chunk_charge", request_id=req.id,
+                trace_id=_trace_of(req), chunk_tokens=c, off=off,
+                end=end, final=final, charged_pages=charge_now,
+                new_pages=len(new_pages))
         return {"i": i, "c": c, "end": end, "final": final,
                 "bucket": bucket, "new_pages": new_pages,
                 "charged": charge_now, "ops": ops}
@@ -1979,6 +2044,11 @@ class LLMServer:
         self._slots[i] = None
         self._remaining[i] = 0
         self._slot_adm[i] = None
+        if flight.enabled:
+            flight.record(
+                "rollback", request_id=req.id, trace_id=_trace_of(req),
+                reason="cancelled" if msg is None else "starved",
+                released_pages=len(entry[1]) + len(entry[2]))
         if msg is not None and not req.done.is_set():
             req.error = msg
             req.done.set()
@@ -2117,7 +2187,7 @@ class LLMServer:
         for i in disp:
             self._lens[i] += 1
             self._remaining[i] -= 1
-        rec = {"out": out,
+        rec = {"out": out, "fn": "llm/step_mixed",
                "pairs": [(i, self._slots[i]) for i in disp],
                "refs": (bt_in, lens_in, last_in, active, key_in)
                + cargs["ops"],
@@ -2153,7 +2223,7 @@ class LLMServer:
 
     def _record_decode(self, n_active: int, applied: int, host_s: float,
                        stall_s: float, finished: int,
-                       cancelled: int = 0):
+                       cancelled: int = 0, fn: Optional[str] = None):
         """Per-step attribution (ISSUE 4 satellite): the old single wall
         number silently included the sync barrier and overstated device
         cost; host scheduling and the device-fence stall are now
@@ -2162,6 +2232,11 @@ class LLMServer:
         ``applied`` counts only DELIVERED tokens — speculative rows
         (finished requests) decoded but discarded don't inflate the
         token counter."""
+        if fn is not None:
+            # live roofline attribution (ISSUE 16): the drain-fence
+            # wall of this dispatch, no new device syncs — gated on
+            # the flight switch inside observe()
+            utilization.observe(fn, host_s + stall_s)
         ins = self._instruments()
         if ins is None:
             return
@@ -2304,10 +2379,18 @@ class LLMServer:
             ins["inflight"].set(len(self._inflight))
         self._record_decode(len(rec["pairs"]), applied,
                             rec.get("host_s", 0.0), stall, finished,
-                            cancelled)
+                            cancelled, fn=rec.get("fn"))
 
     def _finish_slot(self, i: int, req: Request):
         self._emit_decode_span(req)
+        if flight.enabled:
+            flight.record(
+                "finish", request_id=req.id, trace_id=_trace_of(req),
+                tokens=len(req.tokens),
+                cancelled=req.cancel_requested or None,
+                ttft_ms=(round((req.t_first_token - req.t_submit)
+                               * 1000.0, 3)
+                         if req.t_first_token else None))
         req.done.set()
         self._slots[i] = None
         self._remaining[i] = 0
@@ -2435,7 +2518,7 @@ class LLMServer:
         for i in disp:
             self._lens[i] += 1
             self._remaining[i] -= 1
-        rec = {"out": out,
+        rec = {"out": out, "fn": "llm/decode_paged",
                "pairs": [(i, self._slots[i]) for i in disp],
                "refs": (bt_in, lens_in, last_in, active, key_in),
                "pinned": self._pending_release}
@@ -2472,7 +2555,7 @@ class LLMServer:
             self._remaining[i] -= 1
         # the old cache is NOT donated on this legacy path: it is an
         # input of the in-flight step and must be pinned until its fence
-        rec = {"out": out,
+        rec = {"out": out, "fn": "llm/decode_slotted",
                "pairs": [(i, self._slots[i]) for i in disp],
                "refs": (k_in, v_in, pos_in, last_in, active, key_in),
                "pinned": self._pending_release}

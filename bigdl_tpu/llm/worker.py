@@ -79,6 +79,7 @@ import numpy as np
 
 from bigdl_tpu import observability as obs
 from bigdl_tpu import reliability
+from bigdl_tpu.observability import flight
 from bigdl_tpu.observability import request_context as rc
 from bigdl_tpu.observability import tracing
 from bigdl_tpu.observability.federation import (
@@ -220,6 +221,10 @@ class LLMWorker:
             def do_GET(self):
                 self._trace = None
                 debug = tracing.debug_endpoint(self.path)
+                if debug is None:
+                    # flight recorder + per-request explain (ISSUE 16):
+                    # same shared-helper idiom, 404 arms included
+                    debug = flight.debug_endpoint(self.path)
                 if debug is not None:
                     self._json(*debug)
                 elif self.path == "/debug/kvcache":
@@ -870,6 +875,11 @@ class LLMRouter:
             def do_GET(self):
                 self._trace = None
                 debug = tracing.debug_endpoint(self.path)
+                if debug is None:
+                    # router surface of the flight recorder (ISSUE 16):
+                    # the journal's failover/hedge/shed events live in
+                    # this process, so explain works here too
+                    debug = flight.debug_endpoint(self.path)
                 if debug is not None:
                     self._json(*debug)
                 elif self.path == "/healthz":
@@ -1296,6 +1306,8 @@ class LLMRouter:
 
         def on_hedge():
             self._hedge.note_hedge()
+            flight.record("hedge", stage="prefill",
+                          backend=f"{hedge_addr[0]}:{hedge_addr[1]}")
             ins = self._instruments()
             if ins is not None and "hedges" in ins:
                 ins["hedges"].labels(stage="prefill",
@@ -1445,6 +1457,8 @@ class LLMRouter:
         def on_hedge():
             self._hedge.note_hedge()
             ent.hedges += 1
+            flight.record("hedge", stage="decode", entry=ent.id,
+                          backend=f"{hedge_addr[0]}:{hedge_addr[1]}")
             if tried is not None:
                 tried.add(hedge_addr)
             ins = self._instruments()

@@ -41,6 +41,8 @@ from bigdl_tpu.observability.request_context import (
 from bigdl_tpu.observability import compile_recorder
 from bigdl_tpu.observability.compile_recorder import (
     compile_stats, compiled)
+from bigdl_tpu.observability import flight
+from bigdl_tpu.observability import utilization
 
 #: The process-global registry every built-in hook writes to.
 REGISTRY = MetricRegistry()
@@ -129,6 +131,8 @@ def reset():
     TRACE.clear()
     EXEMPLARS.clear()
     compile_recorder.reset()
+    flight.reset()
+    utilization.reset()
 
 
 __all__ = [
@@ -139,7 +143,7 @@ __all__ = [
     "DEFAULT_BUCKETS", "FAST_BUCKETS", "add_complete", "assemble_trace",
     "compile_recorder", "compile_stats", "compiled", "configure",
     "counter", "disable", "enable", "enabled", "export_chrome_trace",
-    "gauge", "histogram", "parse_prometheus", "render",
+    "flight", "gauge", "histogram", "parse_prometheus", "render",
     "render_prometheus", "request_context", "reset", "sketch", "span",
-    "tracing",
+    "tracing", "utilization",
 ]

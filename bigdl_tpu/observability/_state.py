@@ -49,3 +49,13 @@ def refresh(key: str):
         if tracing is not None:
             tracing.EXEMPLARS.capacity = conf.get_int(
                 "bigdl.observability.exemplars", 8)
+    elif key == "bigdl.observability.flight.enabled":
+        flight = sys.modules.get("bigdl_tpu.observability.flight")
+        if flight is not None:
+            flight.enabled = conf.get_bool(
+                "bigdl.observability.flight.enabled", False)
+    elif key == "bigdl.observability.flight.capacity":
+        flight = sys.modules.get("bigdl_tpu.observability.flight")
+        if flight is not None:
+            flight.set_capacity(conf.get_int(
+                "bigdl.observability.flight.capacity", 4096))

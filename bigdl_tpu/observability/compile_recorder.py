@@ -343,6 +343,23 @@ def reset():
         _stats.clear()
 
 
+def latest_costs() -> Dict[str, Tuple[float, float]]:
+    """``{fn: (flops, bytes_accessed)}`` of the most recent compile of
+    each entry point that carried cost analysis — the cheap join key
+    :mod:`~bigdl_tpu.observability.utilization` multiplies by measured
+    dispatch wall times for live roofline attribution (a full
+    :func:`compile_stats` copy per decode step would be wasteful)."""
+    out: Dict[str, Tuple[float, float]] = {}
+    with _stats_lock:
+        for name, rec in _stats.items():
+            for entry in reversed(rec["history"]):
+                if "flops" in entry or "bytes_accessed" in entry:
+                    out[name] = (float(entry.get("flops", 0.0)),
+                                 float(entry.get("bytes_accessed", 0.0)))
+                    break
+    return out
+
+
 def compile_stats() -> List[Dict[str, Any]]:
     """The process-wide compile ledger, per fn name — the ``compiles``
     block bench.py embeds, and the raw material for a recompile

@@ -95,7 +95,16 @@ def registry_snapshot(registry=None, instance: str = "") -> dict:
         metrics.append({"name": m.name, "kind": m.kind, "help": m.help,
                         "labelnames": list(m.labelnames),
                         "series": series})
-    return {"instance": instance, "ts": time.time(), "metrics": metrics}
+    doc = {"instance": instance, "ts": time.time(), "metrics": metrics}
+    from bigdl_tpu.observability import flight, utilization
+    if flight.enabled:
+        # live roofline attribution (ISSUE 16): the per-program table
+        # rides the snapshot; merge_snapshots only reads "metrics", so
+        # fleet merging tolerates the extra key
+        roof = utilization.snapshot()
+        if roof["programs"]:
+            doc["roofline"] = roof
+    return doc
 
 
 # ---------------------------------------------------------------------------

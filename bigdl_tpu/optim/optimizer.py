@@ -33,6 +33,7 @@ import numpy as np
 
 from bigdl_tpu import observability as obs
 from bigdl_tpu import reliability
+from bigdl_tpu.observability import utilization
 from bigdl_tpu.feature.dataset import (
     AbstractDataSet, LocalDataSet, MiniBatch, SampleToMiniBatch)
 from bigdl_tpu.nn.module import Criterion, Module
@@ -620,6 +621,13 @@ class BaseOptimizer:
                                 params, states, opt_state, x, t, lr, sub)
                             t_compute = time.perf_counter() - t0
                             self.metrics.add("compute", t_compute)
+                            # live roofline attribution (ISSUE 16):
+                            # same clock the compute metric reads —
+                            # no new device syncs
+                            utilization.observe(
+                                getattr(step, "name",
+                                        "optimizer/train_step"),
+                                t_compute)
                             # loss is materialized one step late so the
                             # host can dispatch iteration N+1 while the
                             # device still runs N

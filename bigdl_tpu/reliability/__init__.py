@@ -54,12 +54,19 @@ def disable():
     _state.plan = None
 
 
-def count_shed(component: str):
-    """Record one load-shedding rejection (503 + Retry-After)."""
+def count_shed(component: str, request_id=None, trace_id=None, **detail):
+    """Record one load-shedding rejection (503 + Retry-After). Every
+    increment also lands one flight-recorder ``shed`` event (when that
+    recorder is enabled) carrying the caller's ledger snapshot — the
+    chaos cross-check asserts events reconcile EXACTLY with this
+    counter, so the two must share a call site."""
     from bigdl_tpu.reliability.policies import _count
     _count("bigdl_reliability_shed_total",
            "Requests rejected by admission control",
            component=component)
+    from bigdl_tpu.observability import flight
+    flight.record("shed", request_id=request_id, trace_id=trace_id,
+                  component=component, **detail)
 
 
 __all__ = [

@@ -49,6 +49,16 @@ _DEFAULTS: Dict[str, str] = {
     # view. false = no collector thread, endpoints 404
     "bigdl.observability.federation": "false",
     "bigdl.observability.federation.interval": "2.0",  # scrape cadence (s)
+    # engine flight recorder + live roofline (ISSUE 16): typed
+    # decision-event ring behind /debug/flight + /debug/explain/<id>,
+    # and bigdl_device_* utilization gauges. false = no ring, no
+    # series, endpoints 404
+    "bigdl.observability.flight.enabled": "false",
+    "bigdl.observability.flight.capacity": "4096",  # ring events
+    # per-platform peak specs for the roofline gauges; 0 = auto-detect
+    # from the PJRT device_kind (see observability/utilization.py)
+    "bigdl.device.peak.tflops": "0",          # dense bf16 TFLOP/s
+    "bigdl.device.peak.gbps": "0",            # HBM GB/s
     # per-request SLO accounting (ISSUE 12): TTFT/ITL sketches +
     # threshold classification + rolling burn rate. false = no sketch
     # series, no bigdl_slo_* series

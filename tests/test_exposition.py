@@ -1,0 +1,366 @@
+"""ISSUE 16 satellite: Prometheus text-exposition compliance. A strict
+pure-python parser (written against the text-format 0.0.4 grammar, no
+external client library) is run over the live ``/metrics`` surfaces —
+LLM worker, plain router, and the federation router's merged fleet view
+— and over a direct registry render. Every line must parse, ``# HELP``
+/ ``# TYPE`` must precede their family's samples and appear at most
+once, sample names must belong to a declared family (histogram/summary
+suffix rules), label names must be legal and label sets consistent
+within a sample name, histogram bucket series must carry ``+Inf``, and
+no duplicate (name, labelset) sample may appear."""
+
+import http.client
+import math
+import re
+
+import numpy as np
+import pytest
+
+from bigdl_tpu import observability as obs
+
+METRIC_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+LABEL_NAME_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+EXPOSITION_TYPES = {"counter", "gauge", "histogram", "summary",
+                    "untyped"}
+
+
+def _parse_labels(body: str, where: str):
+    """``k="v",k2="v2"`` → list of (name, value); strict on quoting and
+    the \\\\ \\" \\n escape set."""
+    labels = []
+    i, n = 0, len(body)
+    while i < n:
+        j = i
+        while j < n and body[j] not in "=":
+            j += 1
+        assert j < n, f"{where}: label without '=' in {body!r}"
+        name = body[i:j]
+        assert LABEL_NAME_RE.match(name), \
+            f"{where}: bad label name {name!r}"
+        assert j + 1 < n and body[j + 1] == '"', \
+            f"{where}: unquoted label value for {name!r}"
+        k = j + 2
+        val = []
+        while k < n and body[k] != '"':
+            if body[k] == "\\":
+                assert k + 1 < n and body[k + 1] in ('\\', '"', 'n'), \
+                    f"{where}: bad escape in label {name!r}"
+                val.append({"\\": "\\", '"': '"',
+                            "n": "\n"}[body[k + 1]])
+                k += 2
+            else:
+                val.append(body[k])
+                k += 1
+        assert k < n, f"{where}: unterminated label value for {name!r}"
+        labels.append((name, "".join(val)))
+        k += 1
+        if k < n:
+            assert body[k] == ",", \
+                f"{where}: expected ',' between labels, got {body[k]!r}"
+            k += 1
+            assert k < n, f"{where}: trailing ',' in label set"
+        i = k
+    return labels
+
+
+def _parse_value(tok: str, where: str) -> float:
+    assert re.match(r"^[+-]?(\d|\.\d|Inf|NaN)", tok), \
+        f"{where}: unparseable value {tok!r}"
+    try:
+        return float(tok)
+    except ValueError:
+        raise AssertionError(f"{where}: unparseable value {tok!r}")
+
+
+def parse_exposition(text: str) -> dict:
+    """Strict parse of one exposition document. Returns
+    ``{family: {"help", "type", "samples": [(name, labels, value)]}}``
+    and raises AssertionError (with the line) on any grammar or
+    ordering violation."""
+    assert text.endswith("\n"), "exposition must end with a newline"
+    families = {}
+    seen_keys = set()
+
+    def family_of(name: str, where: str) -> dict:
+        fam = families.get(name)
+        if fam is not None:
+            return fam
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix):
+                base = families.get(name[: -len(suffix)])
+                if base is not None and \
+                        base["type"] in ("histogram", "summary"):
+                    if suffix == "_bucket":
+                        assert base["type"] == "histogram", \
+                            f"{where}: _bucket on a {base['type']}"
+                    return base
+        raise AssertionError(
+            f"{where}: sample {name!r} has no declared family "
+            "(HELP/TYPE must precede samples)")
+
+    for lineno, line in enumerate(text.split("\n")[:-1], 1):
+        where = f"line {lineno}"
+        assert line == line.strip("\r"), f"{where}: CR in exposition"
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) >= 2 and parts[1] in ("HELP", "TYPE"):
+                assert len(parts) >= 3, f"{where}: bare # {parts[1]}"
+                name = parts[2]
+                assert METRIC_NAME_RE.match(name), \
+                    f"{where}: bad metric name {name!r}"
+                fam = families.setdefault(
+                    name, {"help": None, "type": None, "samples": []})
+                assert not fam["samples"], \
+                    f"{where}: # {parts[1]} {name} after its samples"
+                if parts[1] == "HELP":
+                    assert fam["help"] is None, \
+                        f"{where}: second HELP for {name}"
+                    fam["help"] = parts[3] if len(parts) > 3 else ""
+                else:
+                    assert fam["type"] is None, \
+                        f"{where}: second TYPE for {name}"
+                    assert len(parts) == 4 and \
+                        parts[3] in EXPOSITION_TYPES, \
+                        f"{where}: bad TYPE line {line!r}"
+                    fam["type"] = parts[3]
+            continue                      # other comments are legal
+        # sample line: name[{labels}] value [timestamp]
+        m = re.match(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)", line)
+        assert m, f"{where}: unparseable sample {line!r}"
+        name = m.group(1)
+        rest = line[m.end():]
+        labels = []
+        if rest.startswith("{"):
+            depth_end = None
+            i, in_q, esc = 1, False, False
+            while i < len(rest):
+                c = rest[i]
+                if esc:
+                    esc = False
+                elif c == "\\":
+                    esc = True
+                elif c == '"':
+                    in_q = not in_q
+                elif c == "}" and not in_q:
+                    depth_end = i
+                    break
+                i += 1
+            assert depth_end is not None, f"{where}: unclosed label set"
+            labels = _parse_labels(rest[1:depth_end], where)
+            rest = rest[depth_end + 1:]
+        toks = rest.split()
+        assert len(toks) in (1, 2), f"{where}: bad sample tail {rest!r}"
+        value = _parse_value(toks[0], where)
+        if len(toks) == 2:
+            assert re.match(r"^-?\d+$", toks[1]), \
+                f"{where}: bad timestamp {toks[1]!r}"
+        fam = family_of(name, where)
+        assert fam["help"] is not None and fam["type"] is not None, \
+            f"{where}: sample {name!r} before HELP+TYPE"
+        key = (name, tuple(sorted(labels)))
+        assert key not in seen_keys, f"{where}: duplicate sample {key}"
+        seen_keys.add(key)
+        fam["samples"].append((name, labels, value))
+    return families
+
+
+def check_compliance(text: str):
+    """The full satellite contract over one document."""
+    families = parse_exposition(text)
+    assert families, "empty exposition"
+    # label-name sets consistent within each sample name
+    keysets = {}
+    for fam in families.values():
+        for name, labels, _v in fam["samples"]:
+            ks = frozenset(k for k, _ in labels)
+            prev = keysets.setdefault(name, ks)
+            assert prev == ks, \
+                f"inconsistent label set for {name}: " \
+                f"{sorted(prev)} vs {sorted(ks)}"
+    # histogram invariants: per bucket group, cumulative counts are
+    # non-decreasing and an le="+Inf" bucket exists
+    for base, fam in families.items():
+        if fam["type"] != "histogram":
+            continue
+        groups = {}
+        for name, labels, v in fam["samples"]:
+            if name != base + "_bucket":
+                continue
+            other = tuple(sorted((k, val) for k, val in labels
+                                 if k != "le"))
+            le = dict(labels)["le"]
+            groups.setdefault(other, []).append((le, v))
+        for other, buckets in groups.items():
+            les = [b for b, _ in buckets]
+            assert "+Inf" in les, f"{base}{dict(other)}: no +Inf bucket"
+            ordered = sorted(
+                (math.inf if b == "+Inf" else float(b), v)
+                for b, v in buckets)
+            counts = [v for _b, v in ordered]
+            assert counts == sorted(counts), \
+                f"{base}{dict(other)}: bucket counts not cumulative"
+    return families
+
+
+def _get(addr, path):
+    conn = http.client.HTTPConnection(*addr, timeout=120)
+    try:
+        conn.request("GET", path)
+        r = conn.getresponse()
+        return r.status, r.read().decode()
+    finally:
+        conn.close()
+
+
+def _post_generate(addr, prompt, tokens=3):
+    import json
+    conn = http.client.HTTPConnection(*addr, timeout=300)
+    try:
+        conn.request("POST", "/worker_generate",
+                     json.dumps({"prompt_ids": [int(t) for t in prompt],
+                                 "max_new_tokens": tokens}),
+                     {"Content-Type": "application/json"})
+        r = conn.getresponse()
+        body = json.loads(r.read().decode())
+        assert r.status == 200, body
+        return body
+    finally:
+        conn.close()
+
+
+class TestParserRejectsMalformed:
+    """The parser itself must have teeth, or the compliance pass is
+    vacuous."""
+
+    def test_accepts_minimal_valid(self):
+        doc = ('# HELP x_total things\n# TYPE x_total counter\n'
+               'x_total{a="1"} 3\n')
+        fams = parse_exposition(doc)
+        assert fams["x_total"]["samples"] == \
+            [("x_total", [("a", "1")], 3.0)]
+
+    @pytest.mark.parametrize("doc", [
+        'x_total 1\n',                                    # no HELP/TYPE
+        '# TYPE x_total counter\nx_total 1\n',            # no HELP
+        '# HELP x_total h\n# TYPE x_total banana\nx 1\n',  # bad type
+        '# HELP x h\n# TYPE x gauge\nx{1bad="v"} 1\n',    # label name
+        '# HELP x h\n# TYPE x gauge\nx{a=unquoted} 1\n',  # quoting
+        '# HELP x h\n# TYPE x gauge\nx nope\n',           # value
+        '# HELP x h\n# TYPE x gauge\nx 1\nx 2\n',         # duplicate
+        '# HELP x h\n# TYPE x gauge\nx 1\n# TYPE x gauge\n',  # 2nd TYPE
+        '# HELP x h\n# TYPE x gauge\nx{a="v} 1\n',        # unterminated
+    ])
+    def test_rejects(self, doc):
+        with pytest.raises(AssertionError):
+            parse_exposition(doc)
+
+    def test_rejects_inconsistent_label_sets(self):
+        doc = ('# HELP x h\n# TYPE x gauge\n'
+               'x{a="1"} 1\nx{b="2"} 2\n')
+        with pytest.raises(AssertionError):
+            check_compliance(doc)
+
+
+class TestRegistryRender:
+    def test_direct_render_complies(self):
+        was = obs.enabled()
+        obs.enable()
+        try:
+            # make sure every instrument shape is present: counter,
+            # gauge, histogram (with labels), sketch summary
+            obs.counter("expo_test_total", "c", labelnames=("k",)) \
+                .labels(k="a").inc()
+            obs.gauge("expo_test_gauge", "g").set(1.5)
+            h = obs.histogram("expo_test_seconds", "h",
+                              labelnames=("stage",))
+            for v in (0.001, 0.1, 5.0):
+                h.labels(stage="s").observe(v)
+            sk = obs.sketch("expo_test_sketch_seconds", "q")
+            for v in (0.01, 0.02, 0.3):
+                sk.observe(v)
+            fams = check_compliance(obs.render())
+            assert fams["expo_test_seconds"]["type"] == "histogram"
+            assert fams["expo_test_sketch_seconds"]["type"] == "summary"
+        finally:
+            if not was:
+                obs.disable()
+
+
+class TestLiveSurfaces:
+    @pytest.fixture(scope="class")
+    def fleet(self):
+        """One federation worker + a plain worker behind a federation
+        router — three /metrics surfaces (worker, router fleet view,
+        plain decode traffic driving counters/sketches/histograms)."""
+        from bigdl_tpu.llm.models.llama import (LlamaConfig,
+                                                LlamaForCausalLM)
+        from bigdl_tpu.llm.serving import LLMServer
+        from bigdl_tpu.llm.worker import LLMRouter, LLMWorker
+
+        was = obs.enabled()
+        obs.enable()
+        model = LlamaForCausalLM.from_config(LlamaConfig.tiny(), seed=0,
+                                             max_cache_len=64)
+        s1 = LLMServer(model, max_batch=2, max_seq_len=64, page_size=8,
+                       kvcache=True, slo=True).start()
+        w1 = LLMWorker(s1, role="decode", federation=True).start()
+        router = LLMRouter([], [w1.address], slo=True, federation=True,
+                           start_prober=False).start()
+        rs = np.random.RandomState(0)
+        for j in range(3):
+            _post_generate(router.address,
+                           rs.randint(0, 250, 8 + 2 * j)
+                           .astype(np.int32))
+        yield w1, router
+        router.stop()
+        w1.stop()
+        s1.stop(drain=False)
+        if not was:
+            obs.disable()
+
+    def test_worker_metrics_comply(self, fleet):
+        w1, _router = fleet
+        st, text = _get(w1.address, "/metrics")
+        assert st == 200
+        fams = check_compliance(text)
+        assert "bigdl_llm_decode_tokens_total" in fams
+        assert fams["bigdl_build_info"]["type"] == "gauge"
+
+    def test_router_fleet_view_complies(self, fleet):
+        w1, router = fleet
+        router._collector.collect_now()     # deterministic scrape
+        st, text = _get(router.address, "/metrics")
+        assert st == 200
+        fams = check_compliance(text)
+        # the merged view carries worker series under instance labels
+        assert any("instance" in dict(labels)
+                   for fam in fams.values()
+                   for _n, labels, _v in fam["samples"])
+
+    def test_plain_router_metrics_comply(self):
+        from bigdl_tpu.llm.models.llama import (LlamaConfig,
+                                                LlamaForCausalLM)
+        from bigdl_tpu.llm.serving import LLMServer
+        from bigdl_tpu.llm.worker import LLMRouter, LLMWorker
+
+        was = obs.enabled()
+        obs.enable()
+        model = LlamaForCausalLM.from_config(LlamaConfig.tiny(), seed=0,
+                                             max_cache_len=64)
+        s = LLMServer(model, max_batch=2, max_seq_len=32).start()
+        w = LLMWorker(s, role="decode").start()
+        router = LLMRouter([], [w.address], start_prober=False).start()
+        try:
+            _post_generate(router.address,
+                           np.arange(1, 9, dtype=np.int32))
+            st, text = _get(router.address, "/metrics")
+            assert st == 200
+            check_compliance(text)
+        finally:
+            router.stop()
+            w.stop()
+            s.stop(drain=False)
+            if not was:
+                obs.disable()

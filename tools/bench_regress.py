@@ -231,6 +231,16 @@ def _flatten_full(rec: dict) -> Dict[str, float]:
         val = fe.get(field)
         if isinstance(val, (int, float)) and not isinstance(val, bool):
             flat[f"fleet_elastic.{field}"] = float(val)
+    # ISSUE 16: the live roofline gauges sampled while the serving
+    # microbenches ran — MFU or achieved HBM bandwidth drifting down
+    # between rounds is a dispatch-efficiency regression even when
+    # raw tok/s still sits inside the noise band
+    ub = (((rec.get("extra") or {}).get("telemetry") or {})
+          .get("utilization") or {})
+    for field in ("mfu", "hbm_bw_gbps", "bw_util"):
+        val = ub.get(field)
+        if isinstance(val, (int, float)) and not isinstance(val, bool):
+            flat[f"util.{field}"] = float(val)
     return flat
 
 

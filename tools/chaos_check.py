@@ -12,7 +12,7 @@ harness exists to catch.
 
 Usage:
     python tools/chaos_check.py [--seed N] [--events K] [--full]
-        [--kvcache | --kvtier | --failover | --fleet | --all]
+        [--kvcache | --kvtier | --failover | --flight | --fleet | --all]
 
 Wired into ``bench.py``'s telemetry block as a smoke invocation and into
 pytest as ``-m chaos`` (kept out of tier-1 by the ``slow`` marker).
@@ -625,6 +625,271 @@ def run_failover_chaos(seed: int = 0, n_requests: int = 4,
         s2.stop()
 
 
+def _counter_total(name: str) -> Optional[float]:
+    """Sum of every child of one registry counter, or None when the
+    observability registry is disabled (the flight cross-check then
+    reconciles against the plain-int ledgers instead)."""
+    from bigdl_tpu import observability as obs
+    if not obs.enabled():
+        return None
+    total = 0.0
+    for m in obs.REGISTRY.collect():
+        if m.name == name:
+            for _key, child in m.children():
+                total += child.value
+    return total
+
+
+def _flight_tally() -> dict:
+    """Flight-ring totals the reconciliation diffs: shed/failover event
+    counts, Σ(evict event pages), and the ring's drop counter (a drop
+    between the before/after snapshots would invalidate the diff)."""
+    from bigdl_tpu.observability import flight
+    r = flight.ring()
+    evs = r.events() if r is not None else []
+    return {
+        "shed": sum(1 for e in evs if e["kind"] == "shed"),
+        "failover": sum(1 for e in evs if e["kind"] == "failover"),
+        "evict_pages": sum(e.get("detail", {}).get("pages", 0)
+                           for e in evs if e["kind"] == "evict"),
+        "dropped": r.dropped if r is not None else 0,
+    }
+
+
+def run_flight_chaos(seed: int = 0, new_tokens: int = 4,
+                     smoke: bool = False) -> dict:
+    """ISSUE 16 acceptance: the flight recorder under a failover storm.
+
+    Part 1 — disabled mode is STRUCTURALLY absent. With
+    ``bigdl.observability.flight.enabled`` off, ``flight.record`` is a
+    no-op (the ring does not grow, the ``bigdl_flight_events_total``
+    counter does not move, no new metric series appears in the
+    registry) and both debug endpoints answer 404.
+
+    Part 2 — with the recorder ON, a kill storm + pool-pressure replay
+    + drain sheds, then the reconciliation: flight ``shed`` /
+    ``failover`` events and Σ(``evict`` event pages) must match the
+    ``bigdl_reliability_shed_total`` / ``bigdl_router_failovers_total``
+    / ``bigdl_kvcache_evictions_total`` counter deltas EXACTLY. The
+    events are emitted at the same call sites as the counter
+    increments, so any drift means a forked emission path."""
+    import http.client
+    import json as _json
+
+    import numpy as np
+
+    from bigdl_tpu import observability as obs
+    from bigdl_tpu import reliability as rel
+    from bigdl_tpu.llm.models.llama import LlamaConfig, LlamaForCausalLM
+    from bigdl_tpu.llm.serving import LLMServer
+    from bigdl_tpu.llm.worker import LLMRouter, LLMWorker
+    from bigdl_tpu.observability import flight
+    from bigdl_tpu.utils.conf import conf
+
+    GATE = "bigdl.observability.flight.enabled"
+    with conf._lock:
+        prev = conf._set_layer.get(GATE)
+
+    out = {"seed": seed, "gate": GATE}
+    try:
+        # --- part 1: disabled mode is structurally absent ---------------
+        conf.set(GATE, "false")
+        assert not flight.enabled, f"{GATE}=false left the recorder armed"
+        before = _flight_tally()
+        lines_before = (set(obs.render().splitlines())
+                        if obs.enabled() else set())
+        counter_before = _counter_total("bigdl_flight_events_total")
+        flight.record("shed", request_id="chaos-probe",
+                      component="chaos_probe")
+        flight.record("evict", pages=3)
+        for path in ("/debug/flight", "/debug/explain/chaos-probe"):
+            resp = flight.debug_endpoint(path)
+            assert resp is not None and resp[0] == 404, \
+                f"{path} must 404 while {GATE} is off, got {resp!r}"
+        after = _flight_tally()
+        assert after == before, \
+            f"record() grew the ring while {GATE} was off: {after}"
+        assert _counter_total("bigdl_flight_events_total") \
+            == counter_before, \
+            f"bigdl_flight_events_total moved while {GATE} was off"
+        if obs.enabled():
+            grown = {ln.split("{")[0].split(" ")[0]
+                     for ln in set(obs.render().splitlines())
+                     - lines_before}
+            assert not any("flight" in g for g in grown), \
+                f"disabled mode grew flight series: {grown}"
+        out["disabled_mode"] = "structurally absent"
+
+        # --- part 2: the storm, recorder on -----------------------------
+        conf.set(GATE, "true")
+        assert flight.enabled
+        model = LlamaForCausalLM.from_config(LlamaConfig.tiny(), seed=0,
+                                             max_cache_len=128)
+        rs = np.random.RandomState(seed)
+        storm_prompts = [rs.randint(0, 250, 10 + 2 * j).astype(np.int32)
+                         for j in range(2)]
+        shared = rs.randint(0, 250, 12).astype(np.int32)
+        evict_prompts = [np.concatenate(
+            [shared, rs.randint(0, 250, 2 + j % 5).astype(np.int32)])
+            for j in range(3 if smoke else 6)]
+
+        was_enabled = rel.enabled()
+        if not was_enabled:
+            rel.enable()
+        # small pool (the kvcache pass's sizing) so the shared-prefix
+        # replay genuinely evicts; kills tear the router->worker stream
+        # mid-decode so the journal resume path genuinely fires
+        s1 = LLMServer(model, max_batch=2, max_seq_len=64, page_size=8,
+                       num_pages=7, kvcache=True).start()
+        s2 = LLMServer(model, max_batch=2, max_seq_len=64, page_size=8,
+                       num_pages=7, kvcache=True).start()
+        w1 = LLMWorker(s1, role="decode").start()
+        w2 = LLMWorker(s2, role="decode").start()
+        router = LLMRouter([], [w1.address, w2.address], failover=True,
+                           failover_attempts=8, start_prober=False) \
+            .start()
+        try:
+            # warm the storm shapes on both engines (resume re-prefills
+            # prompt+generated through the partial-prefill shape)
+            for srv in (s1, s2):
+                for p in storm_prompts:
+                    srv.submit(p, max_new_tokens=1).get(timeout=600)
+                    srv.submit(p, max_new_tokens=1).get(timeout=600)
+
+            t_before = _flight_tally()
+            c_before = {
+                "shed": _counter_total("bigdl_reliability_shed_total"),
+                "failover": _counter_total(
+                    "bigdl_router_failovers_total"),
+                "evict": _counter_total(
+                    "bigdl_kvcache_evictions_total"),
+            }
+            fo_before = router.failovers
+            ev_before = s1._kv.evictions + s2._kv.evictions
+
+            plan = rel.FaultPlan(seed=seed)
+            plan.add("router.dispatch", "raise", times=1, after=3)
+            plan.add("llm.step", "delay", times=None, delay=0.02)
+            rel.set_plan(plan)
+            try:
+                for p in storm_prompts:
+                    conn = http.client.HTTPConnection(*router.address,
+                                                      timeout=600)
+                    try:
+                        conn.request(
+                            "POST", "/worker_generate",
+                            _json.dumps({
+                                "prompt_ids": [int(t) for t in p],
+                                "max_new_tokens": new_tokens}),
+                            {"Content-Type": "application/json"})
+                        r = conn.getresponse()
+                        body = _json.loads(r.read().decode())
+                        assert r.status == 200, body
+                    finally:
+                        conn.close()
+            finally:
+                rel.set_plan(None)
+            # pool-pressure replay: shared-prefix chains past the
+            # 7-page pool force radix evictions (flight "evict" events)
+            reqs = [s1.submit(p, max_new_tokens=new_tokens)
+                    for p in evict_prompts]
+            for r in reqs:
+                r.get(timeout=600)
+            # drain sheds: begin_drain flips the admission arm that
+            # emits the shed event + counter at one shared site
+            s1.begin_drain()
+            sheds_forced = 0
+            for p in storm_prompts:
+                try:
+                    s1.submit(p, max_new_tokens=1)
+                except rel.OverloadError:
+                    sheds_forced += 1
+            s1.cancel_drain()
+            assert sheds_forced == len(storm_prompts), \
+                "draining engine accepted a submit"
+
+            # one live HTTP probe: the worker surface serves the ring
+            conn = http.client.HTTPConnection(*w1.address, timeout=60)
+            try:
+                conn.request("GET", "/debug/flight?kind=evict")
+                r = conn.getresponse()
+                ring_doc = _json.loads(r.read().decode())
+                assert r.status == 200, ring_doc
+                assert ring_doc["events"], \
+                    "GET /debug/flight?kind=evict returned no events"
+            finally:
+                conn.close()
+
+            t_after = _flight_tally()
+            c_after = {
+                "shed": _counter_total("bigdl_reliability_shed_total"),
+                "failover": _counter_total(
+                    "bigdl_router_failovers_total"),
+                "evict": _counter_total(
+                    "bigdl_kvcache_evictions_total"),
+            }
+            fo_delta = router.failovers - fo_before
+            ev_delta = s1._kv.evictions + s2._kv.evictions - ev_before
+            assert t_after["dropped"] == t_before["dropped"], \
+                "ring dropped events mid-check; raise " \
+                "bigdl.observability.flight.capacity"
+            deltas = {k: t_after[k] - t_before[k]
+                      for k in ("shed", "failover", "evict_pages")}
+            out.update(events=deltas, failovers=fo_delta,
+                       evicted_pages=ev_delta,
+                       events_fired=[f"{s}:{a}" for s, a in plan.fired])
+            if fo_delta == 0:
+                raise AssertionError(
+                    "flight chaos storm completed without a failover — "
+                    "the kill landed outside the streams")
+            if ev_delta == 0:
+                raise AssertionError(
+                    "flight chaos replay forced no evictions — the "
+                    "pool was not under pressure; shrink it")
+            # the reconciliation: EXACT, no tolerance — shared call
+            # sites mean any drift is a forked emission path
+            if deltas["failover"] != fo_delta:
+                raise AssertionError(
+                    f"{deltas['failover']} flight failover events vs "
+                    f"{fo_delta} journal failovers")
+            if deltas["evict_pages"] != ev_delta:
+                raise AssertionError(
+                    f"flight evict events carry {deltas['evict_pages']} "
+                    f"pages vs {ev_delta} ledger evictions")
+            if deltas["shed"] < sheds_forced:
+                raise AssertionError(
+                    f"{sheds_forced} sheds forced but only "
+                    f"{deltas['shed']} flight shed events recorded")
+            if c_before["shed"] is not None:
+                for key, counter in (("shed", "shed"),
+                                     ("failover", "failover"),
+                                     ("evict_pages", "evict")):
+                    got = c_after[counter] - c_before[counter]
+                    if deltas[key] != got:
+                        raise AssertionError(
+                            f"flight {key} events ({deltas[key]}) != "
+                            f"bigdl_*_total counter delta ({got})")
+                out["counters_reconciled"] = True
+            else:
+                out["counters_reconciled"] = "obs disabled: ledger-only"
+        finally:
+            rel.set_plan(None)
+            if not was_enabled:
+                rel.disable()
+            router.stop()
+            w1.stop()
+            w2.stop()
+            s1.stop()
+            s2.stop()
+    finally:
+        if prev is None:
+            conf.unset(GATE)
+        else:
+            conf.set(GATE, prev)
+    out["match"] = True
+    return out
+
+
 def run_fleet_chaos(seed: int = 0, smoke: bool = False) -> dict:
     """ISSUE 15 acceptance: the elastic-fleet soak. A fleet-enabled
     router (autoscaler + graceful drain) over a
@@ -1200,6 +1465,8 @@ def run_all_chaos(seed: int = 0) -> dict:
                          ("mixed", lambda: run_mixed_chaos(seed=seed)),
                          ("failover", lambda: run_failover_chaos(
                              seed=seed, smoke=True)),
+                         ("flight", lambda: run_flight_chaos(
+                             seed=seed, smoke=True)),
                          ("fleet", lambda: run_fleet_chaos(
                              seed=seed, smoke=True)),
                          ("elastic", lambda: run_elastic_chaos(
@@ -1252,6 +1519,14 @@ def main():
                          "decode-worker kills and watchdog-tripping "
                          "engine stalls must lose zero requests with "
                          "greedy outputs bit-identical (ISSUE 7)")
+    ap.add_argument("--flight", action="store_true",
+                    help="run the flight-recorder reconciliation pass: "
+                         "a kill storm + pool-pressure replay with the "
+                         "recorder on — shed/failover/eviction decision "
+                         "events must reconcile EXACTLY with the "
+                         "bigdl_*_total counters, and disabled mode "
+                         "(bigdl.observability.flight.enabled off) "
+                         "must be structurally absent (ISSUE 16)")
     ap.add_argument("--fleet", action="store_true",
                     help="run the elastic-fleet soak: load spike -> "
                          "scale-out -> worker killed mid-drain -> "
@@ -1285,6 +1560,8 @@ def main():
         return
     if args.elastic:
         out = run_elastic_chaos(seed=args.seed)
+    elif args.flight:
+        out = run_flight_chaos(seed=args.seed)
     elif args.fleet:
         out = run_fleet_chaos(seed=args.seed)
     elif args.mixed:

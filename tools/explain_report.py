@@ -1,0 +1,180 @@
+#!/usr/bin/env python
+"""Explain report — render a request's flight timeline + the live
+roofline table (ISSUE 16 satellite).
+
+Sources (live URL or saved JSON, mix freely):
+    python tools/explain_report.py --url http://127.0.0.1:8300 \\
+        --request <request_id>     # GET /debug/explain/<id> + roofline
+    python tools/explain_report.py --url http://127.0.0.1:8300
+        # GET /debug/flight (recent ring) + GET /metrics/snapshot
+    python tools/explain_report.py explain.json      # saved explain doc
+    python tools/explain_report.py snapshot.json     # saved
+        # /metrics/snapshot doc: renders its "roofline" table
+    python tools/explain_report.py --json ...        # machine output
+
+The timeline prints one row per decision event (relative time, kind,
+request, detail) with the one-line verdict underneath; the roofline
+table prints one row per sampled jit entry point (calls, wall,
+achieved TFLOP/s and GB/s, MFU / bandwidth-utilization fractions and
+the memory/compute-bound verdict). Requires
+``bigdl.observability.flight.enabled`` on the target process — the
+endpoints 404 when the recorder is off.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import urllib.error
+import urllib.request
+from typing import List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from tools.telemetry_report import _print_table  # noqa: E402
+
+
+def _get_json(url: str, timeout: float = 5.0) -> dict:
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read().decode())
+
+
+def fetch_explain(base_url: str, request_id: str) -> dict:
+    """GET /debug/explain/<request_id> from a live worker/router."""
+    return _get_json(f"{base_url.rstrip('/')}/debug/explain/{request_id}")
+
+
+def fetch_flight(base_url: str, kind: Optional[str] = None,
+                 limit: int = 0) -> dict:
+    """GET /debug/flight (the recent ring) from a live surface."""
+    url = f"{base_url.rstrip('/')}/debug/flight"
+    qs = []
+    if kind:
+        qs.append(f"kind={kind}")
+    if limit:
+        qs.append(f"limit={limit}")
+    if qs:
+        url += "?" + "&".join(qs)
+    return _get_json(url)
+
+
+def fetch_roofline(base_url: str) -> Optional[dict]:
+    """The "roofline" block of GET /metrics/snapshot, or None when the
+    snapshot surface or the flight gate is off (best-effort: a timeline
+    must render even when federation is disabled)."""
+    try:
+        doc = _get_json(f"{base_url.rstrip('/')}/metrics/snapshot")
+    except Exception:
+        return None
+    return doc.get("roofline")
+
+
+def timeline_rows(events: List[dict]) -> List[List]:
+    """Table rows for a flight event list: relative-seconds, kind,
+    request, compact detail."""
+    t0 = events[0]["ts"] if events else 0.0
+    rows = []
+    for ev in events:
+        detail = ev.get("detail", {})
+        rows.append([
+            f"+{ev['ts'] - t0:.3f}s", ev["kind"],
+            (ev.get("request") or "")[:13],
+            (ev.get("trace") or "")[:8],
+            " ".join(f"{k}={v}" for k, v in sorted(detail.items()))])
+    return rows
+
+
+def roofline_rows(roof: dict) -> List[List]:
+    return [[r["fn"], r["calls"], r["wall_s"], r["achieved_tflops"],
+             r["achieved_gbps"], r.get("mfu"), r.get("bw_util"),
+             r.get("bound", "-")]
+            for r in roof.get("programs", [])]
+
+
+def render(doc: dict, roof: Optional[dict] = None):
+    """Human rendering of an explain doc, a /debug/flight doc, or a
+    snapshot's roofline block (auto-detected by shape)."""
+    if roof is None and "roofline" in doc:
+        roof = doc["roofline"]
+    events = doc.get("events")
+    if events is not None:
+        title = (f"flight timeline: request {doc['request']}"
+                 if "request" in doc else "flight ring (recent events)")
+        _print_table(title,
+                     ["t", "kind", "request", "trace", "detail"],
+                     timeline_rows(events))
+        if "verdict" in doc:
+            print(f"\nverdict: {doc['verdict']}")
+        if "dropped" in doc and doc["dropped"]:
+            print(f"(ring dropped {doc['dropped']} older events)")
+    if roof:
+        _print_table(
+            f"roofline: {roof.get('device', '?')} "
+            f"(peak {roof.get('peak_tflops') or '?'} TFLOP/s, "
+            f"{roof.get('peak_gbps') or '?'} GB/s)",
+            ["fn", "calls", "wall_s", "tflops", "gbps", "mfu",
+             "bw_util", "bound"],
+            roofline_rows(roof))
+        if roof.get("bw_util") is not None:
+            print(f"\ndevice: mfu={roof.get('mfu')} "
+                  f"hbm_bw_gbps={roof.get('hbm_bw_gbps')} "
+                  f"bw_util={roof.get('bw_util')}")
+
+
+def main(argv: List[str]) -> int:
+    as_json = "--json" in argv
+
+    def _opt(flag: str) -> Optional[str]:
+        if flag in argv:
+            i = argv.index(flag)
+            if i + 1 >= len(argv):
+                print(f"{flag} needs a value", file=sys.stderr)
+                raise SystemExit(2)
+            return argv[i + 1]
+        return None
+
+    url = _opt("--url")
+    request_id = _opt("--request")
+    kind = _opt("--kind")
+    flags_with_val = {"--url", "--request", "--kind"}
+    paths = [a for i, a in enumerate(argv) if not a.startswith("--")
+             and (i == 0 or argv[i - 1] not in flags_with_val)]
+    docs: List[dict] = []
+    roof: Optional[dict] = None
+    if url:
+        try:
+            if request_id:
+                docs.append(fetch_explain(url, request_id))
+            else:
+                docs.append(fetch_flight(url, kind=kind))
+        except urllib.error.HTTPError as e:
+            body = e.read().decode(errors="replace")
+            print(f"{e.code} from {url}: {body}", file=sys.stderr)
+            print("(is bigdl.observability.flight.enabled on?)",
+                  file=sys.stderr)
+            return 1
+        roof = fetch_roofline(url)
+    for p in paths:
+        if not os.path.exists(p):
+            print(f"no such file: {p}", file=sys.stderr)
+            return 1
+        with open(p) as f:
+            docs.append(json.load(f))
+    if not docs:
+        print(__doc__)
+        return 2
+    for doc in docs:
+        if as_json:
+            out = dict(doc)
+            if roof is not None and "roofline" not in out:
+                out["roofline"] = roof
+            print(json.dumps(out))
+        else:
+            render(doc, roof)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -20,6 +20,15 @@ CLI:
         # percentile tables from /metrics/snapshot docs — sketch
         # series resolve to EXACT sketch quantiles (ISSUE 12), not
         # bucket interpolation
+    python tools/telemetry_report.py --explain <request_id> [flight.json]
+        # one request's flight-recorder decision timeline + verdict
+        # (ISSUE 16): from a saved /debug/explain or /debug/flight
+        # JSON, or — with no path — the in-process flight ring
+
+The registry summary (library use) carries the live utilization gauges
+(``bigdl_device_mfu`` / ``bigdl_device_hbm_bw_gbps`` /
+``bigdl_device_bw_util``) whenever the flight recorder has sampled
+dispatches — they are ordinary gauges in the same registry.
 
 Quantile sources (ISSUE 12): where a metric is backed by a quantile
 sketch, every percentile this tool prints is the sketch's own value
@@ -218,6 +227,31 @@ def report(path: str, as_json: bool = False,
     return summary
 
 
+def summarize_explain(request_id: str,
+                      path: Optional[str] = None) -> dict:
+    """One request's flight timeline (ISSUE 16): from a saved
+    ``/debug/explain`` / ``/debug/flight`` JSON document, or from the
+    in-process flight ring when ``path`` is None."""
+    if path is not None:
+        with open(path) as f:
+            doc = json.load(f)
+        if doc.get("request") == request_id and "verdict" in doc:
+            return doc   # already an explain doc for this request
+        # a /debug/flight ring dump: assemble the timeline ourselves
+        from bigdl_tpu.observability.flight import _verdict
+        events = [e for e in doc.get("events", [])
+                  if e.get("request") == request_id]
+        traces = {e["trace"] for e in events if e.get("trace")}
+        events += [e for e in doc.get("events", [])
+                   if e.get("request") != request_id
+                   and e.get("trace") in traces]
+        events.sort(key=lambda e: e.get("seq", 0))
+        return {"request": request_id, "traces": sorted(traces),
+                "verdict": _verdict(events), "events": events}
+    from bigdl_tpu.observability import flight
+    return flight.explain(request_id)
+
+
 def main(argv: List[str]) -> int:
     as_json = "--json" in argv
     trace_id = None
@@ -227,9 +261,28 @@ def main(argv: List[str]) -> int:
             print("--trace needs a trace id", file=sys.stderr)
             return 2
         trace_id = argv[i + 1]
+    explain_id = None
+    if "--explain" in argv:
+        i = argv.index("--explain")
+        if i + 1 >= len(argv):
+            print("--explain needs a request id", file=sys.stderr)
+            return 2
+        explain_id = argv[i + 1]
     paths = [a for i, a in enumerate(argv)
              if not a.startswith("--")
-             and (i == 0 or argv[i - 1] != "--trace")]
+             and (i == 0 or argv[i - 1] not in ("--trace", "--explain"))]
+    if explain_id is not None:
+        path = paths[0] if paths else None
+        if path is not None and not os.path.exists(path):
+            print(f"no such file: {path}", file=sys.stderr)
+            return 1
+        summary = summarize_explain(explain_id, path)
+        if as_json:
+            print(json.dumps(summary))
+        else:
+            from tools.explain_report import render
+            render(summary)
+        return 0
     if "--fleet" in argv:
         if not paths:
             print("--fleet needs /metrics/snapshot JSON files",
