@@ -117,6 +117,8 @@ CONF_KEYS.update({
         "total queued+active work at or below which the pool is idle",
     "bigdl.llm.fleet.drain.timeout":
         "seconds a graceful drain may take before it is abandoned",
+    "bigdl.llm.fleet.pressure.interactive":
+        "autoscaler also treats interactive-class backlog alone as pressure",
     "bigdl.llm.kvcache.enabled":
         "radix-indexed KV page reuse with refcounts + COW; false = off",
     "bigdl.llm.kvtier.enabled":
@@ -137,6 +139,8 @@ CONF_KEYS.update({
         "page-aligned prefill chunk size for the unified dispatch; 0 = auto (4 pages)",
     "bigdl.llm.prefill.ragged":
         "prefill attends cached prefix pages in place; auto = on where Mosaic runs",
+    "bigdl.llm.priority.enabled":
+        "SLO-class priority scheduling with lossless preemption; false = FIFO, structurally absent",
     "bigdl.llm.prober.interval":
         "/healthz poll (seconds)",
     "bigdl.llm.retry_after.base":
@@ -324,6 +328,12 @@ METRICS.update({
         "Host wall of one request prefill (compile excluded after first hit per length bucket). At pipeline_depth 1 this covers execution (the prefill barriers); at depth > 1 it is DISPATCH time — execution overlaps decode by design",
     "bigdl_llm_prefill_tokens_total":
         "Prompt tokens prefilled into the KV cache",
+    "bigdl_llm_preempt_parked":
+        "Preempted requests whose exported KV chain is parked awaiting resume",
+    "bigdl_llm_preemptions_total":
+        "In-flight decodes losslessly preempted for a higher class, by victim class",
+    "bigdl_llm_queue_depth_class":
+        "Requests waiting for an engine slot, by SLO class (priority scheduler only)",
     "bigdl_llm_requests_total":
         "Requests finished by the engine",
     "bigdl_llm_ttft_seconds":
@@ -443,6 +453,8 @@ SPAN_NAMES.update({
         "KV handoff blob landed into pool/arena",
     "llm/mixed_step":
         "one unified mixed prefill+decode pass (decode rows + a chunk)",
+    "llm/preempt":
+        "completion: one lossless preemption of an in-flight decode",
     "llm/prefill":
         "prompt prefill (full/partial/ragged) on the engine",
     "llm/queue_wait":
@@ -498,6 +510,8 @@ FAULT_SITES.update({
         "HBM->host page spill (ISSUE 6)",
     "llm.chunk":
         "between chunks of one chunked admission (ISSUE 14)",
+    "llm.preempt":
+        "before a victim's KV chain is exported (ISSUE 17)",
     "llm.step":
         "LLM engine decode step",
     "llm.submit":
@@ -547,6 +561,10 @@ FEATURE_GATES.update({
         "package": None,            # lives inside the engine hot path:
         "desc": "unified mixed prefill+decode dispatch with chunked "
                 "admission; off = the split engine exactly"},
+    "bigdl.llm.priority.enabled": {
+        "package": None,            # lives inside the engine hot path:
+        "desc": "SLO-class scheduler + lossless preemption of in-flight "
+                "decodes; off = FIFO, structurally absent"},
     "bigdl.llm.prefill.chunk_tokens": {
         "package": None,            # tuning knob of the mixed gate
         "desc": "chunk size for the unified dispatch (0 = 4 pages); "
@@ -662,6 +680,8 @@ PYTEST_MARKERS.update({
         "unified mixed prefill+decode dispatch tests (ISSUE 14)",
     "perf":
         "performance microbenchmarks (advisory on shared hosts)",
+    "priority":
+        "SLO-class priority scheduling / preemption tests (ISSUE 17)",
     "slo":
         "fleet telemetry plane tests (sketches, federation, SLO accounting)",
     "slow":

@@ -50,13 +50,17 @@ class JournalEntry:
 
     __slots__ = ("id", "prompt_ids", "max_new_tokens", "tokens",
                  "attempts", "hedges", "created_at", "finish_reason",
-                 "token_times")
+                 "token_times", "priority")
 
     def __init__(self, entry_id: int, prompt_ids: List[int],
-                 max_new_tokens: int):
+                 max_new_tokens: int, priority: Optional[str] = None):
         self.id = entry_id
         self.prompt_ids = list(prompt_ids)
         self.max_new_tokens = int(max_new_tokens)
+        # SLO class as received on the wire (ISSUE 17); None when the
+        # client sent no X-BigDL-Priority header — the journal never
+        # normalizes, the engine does
+        self.priority = priority
         self.tokens: List[int] = []       # drained so far (all attempts)
         self.attempts = 0                 # decode dispatches issued
         self.hedges = 0
@@ -112,8 +116,10 @@ class RequestJournal:
         self.failovers = 0                # re-dispatches after failure
         self.tokens_resumed = 0           # tokens carried across them
 
-    def add(self, prompt_ids, max_new_tokens: int) -> JournalEntry:
-        ent = JournalEntry(next(self._ids), prompt_ids, max_new_tokens)
+    def add(self, prompt_ids, max_new_tokens: int,
+            priority: Optional[str] = None) -> JournalEntry:
+        ent = JournalEntry(next(self._ids), prompt_ids, max_new_tokens,
+                           priority=priority)
         with self._lock:
             self._entries[ent.id] = ent
         return ent
@@ -141,10 +147,13 @@ class RequestJournal:
 
     def snapshot(self) -> List[dict]:
         with self._lock:
-            return [{"id": e.id, "prompt_tokens": len(e.prompt_ids),
-                     "tokens_drained": len(e.tokens),
-                     "attempts": e.attempts, "hedges": e.hedges,
-                     "age_s": round(time.monotonic() - e.created_at, 3)}
+            return [dict(
+                {"id": e.id, "prompt_tokens": len(e.prompt_ids),
+                 "tokens_drained": len(e.tokens),
+                 "attempts": e.attempts, "hedges": e.hedges,
+                 "age_s": round(time.monotonic() - e.created_at, 3)},
+                **({"priority": e.priority}
+                   if e.priority is not None else {}))
                     for e in self._entries.values()]
 
 

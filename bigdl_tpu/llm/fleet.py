@@ -486,6 +486,13 @@ class FleetController:
         self.drain_timeout = float(
             drain_timeout if drain_timeout is not None
             else conf.get_float("bigdl.llm.fleet.drain.timeout", 30.0))
+        # class-split pressure (ISSUE 17): an interactive backlog above
+        # queue_high on ANY single worker's share is pressure even when
+        # the fleet-wide total looks fine — batch depth must not hide
+        # interactive starvation. Inert unless workers report class
+        # depths (bigdl.llm.priority.enabled on the engines).
+        self.pressure_interactive = conf.get_bool(
+            "bigdl.llm.fleet.pressure.interactive", True)
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -558,6 +565,8 @@ class FleetController:
         queue = active = 0.0
         sheds = 0.0
         occ_max = 0.0
+        q_interactive = 0.0
+        parked_by: Dict[Tuple[str, int], float] = {}
         for addr in pool:
             name = f"{addr[0]}:{addr[1]}"
             vals = per.get(name)
@@ -567,6 +576,8 @@ class FleetController:
             active += vals.get("active", 0.0)
             sheds += vals.get("sheds", 0.0)
             occ_max = max(occ_max, vals.get("occupancy", 0.0))
+            q_interactive += vals.get("queue_interactive", 0.0)
+            parked_by[tuple(addr)] = vals.get("parked", 0.0)
         journal = getattr(self.router, "_journal", None)
         return {
             "workers": len(pool),
@@ -575,6 +586,11 @@ class FleetController:
             "inflight": journal.inflight() if journal else 0,
             "sheds": sheds,
             "occupancy_max": occ_max,
+            # ISSUE 17: zero everywhere unless engines run the
+            # priority scheduler — the class-pressure term and the
+            # scale-in parked filter are then inert
+            "queue_interactive": q_interactive,
+            "parked_by": parked_by,
             "source": source,
         }
 
@@ -597,6 +613,18 @@ class FleetController:
                 for s in m.get("series", []):
                     out["occupancy"] = max(out["occupancy"],
                                            float(s.get("value", 0.0)))
+            elif name == "bigdl_llm_queue_depth_class":
+                # ISSUE 17: series labels are the label-value tuple in
+                # labelnames order — ("class",) here
+                for s in m.get("series", []):
+                    if list(s.get("labels", [])) == ["interactive"]:
+                        out["queue_interactive"] = \
+                            out.get("queue_interactive", 0.0) \
+                            + float(s.get("value", 0.0))
+            elif name == "bigdl_llm_preempt_parked":
+                for s in m.get("series", []):
+                    out["parked"] = out.get("parked", 0.0) \
+                        + float(s.get("value", 0.0))
         return out
 
     @staticmethod
@@ -605,7 +633,14 @@ class FleetController:
             _status, body = _get_json(addr, "/healthz", timeout=2.0)
         except Exception:   # noqa: BLE001 — dead member contributes 0
             return {}
-        return {"queue": float(body.get("queue_length", 0) or 0)}
+        out = {"queue": float(body.get("queue_length", 0) or 0)}
+        by_class = body.get("queue_by_class")
+        if isinstance(by_class, dict):
+            out["queue_interactive"] = \
+                float(by_class.get("interactive", 0) or 0)
+        if "preempt_parked" in body:
+            out["parked"] = float(body.get("preempt_parked", 0) or 0)
+        return out
 
     # -- the control loop ----------------------------------------------------
     def tick(self):
@@ -624,7 +659,10 @@ class FleetController:
         self._last_sheds = sig["sheds"]
         pressure = (sig["queue"] > self.queue_high * max(n, 1)
                     or shed_delta > 0
-                    or (n > 0 and sig["occupancy_max"] > 0.9))
+                    or (n > 0 and sig["occupancy_max"] > 0.9)
+                    or (self.pressure_interactive
+                        and sig.get("queue_interactive", 0.0)
+                        > self.queue_high))
         load = sig["queue"] + sig["active"] + sig["inflight"]
         idle = load <= self.idle_low
         if pressure:
@@ -680,7 +718,19 @@ class FleetController:
         pool = self._pool()
         if len(pool) <= self.min_workers:
             return
-        victim = pool[-1]            # newest first: LIFO scale-in
+        # newest first: LIFO scale-in — but never the worker holding
+        # preempted-parked chains (ISSUE 17 satellite): draining it
+        # would force every parked request through a full re-prefill
+        # on a peer, exactly the latency the preemption tried to save
+        parked_by = sig.get("parked_by", {})
+        victim = None
+        for cand in reversed(pool):
+            if parked_by.get(tuple(cand), 0.0) <= 0:
+                victim = cand
+                break
+        if victim is None:
+            victim = pool[-1]        # every worker holds parked chains:
+            # fall back to plain LIFO rather than wedging scale-in
         peers = [list(a) for a in pool if a != victim]
         try:
             reliability.inject("fleet.scale")

@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import collections
 import functools
+import heapq
 import queue
 import threading
 import time
@@ -112,6 +113,116 @@ def _llm_instruments():
         "kv_occupancy": obs.gauge(
             "bigdl_llm_kv_pool_occupancy",
             "Fraction of the KV page pool in use (0..1)"),
+    }
+
+
+#: SLO classes in strictly descending scheduling priority (ISSUE 17).
+#: The wire form is the case-insensitive ``X-BigDL-Priority`` header
+#: (see llm/worker.py); anything unknown normalizes to "standard" so a
+#: typo degrades to today's behavior instead of a 4xx.
+PRIORITY_CLASSES = ("interactive", "standard", "batch")
+_PRIORITY_RANK = {c: r for r, c in enumerate(PRIORITY_CLASSES)}
+#: Retry-After queue-depth weights per class (ISSUE 17 satellite):
+#: batch clients back off harder than interactive ones under the SAME
+#: backlog — reliability.retry_after_seconds scales linearly in depth,
+#: so weighting the depth weights the backoff.
+CLASS_RETRY_WEIGHTS = {"interactive": 0.5, "standard": 1.0, "batch": 2.0}
+
+
+def normalize_priority(value) -> str:
+    """Map a header/ctor value onto a known SLO class ("standard" for
+    None/unknown — misdeclared priority must degrade, never fail)."""
+    if value is None:
+        return "standard"
+    v = str(value).strip().lower()
+    return v if v in _PRIORITY_RANK else "standard"
+
+
+class _PriorityScheduler:
+    """Class-ordered admission backlog (ISSUE 17 tentpole). A binary
+    heap of ``(rank, seq, req)``: rank orders classes, the monotonic
+    sequence keeps FIFO within a class AND makes entries totally
+    ordered (Request is not comparable). Engine-thread only — the
+    thread-safe boundary stays the intake queue, which `_admit` drains
+    into this heap every pass. Constructed ONLY when
+    ``bigdl.llm.priority.enabled`` — disabled mode has no scheduler
+    object at all (the structural-absence contract)."""
+
+    def __init__(self):
+        self._heap: List[tuple] = []
+        self._seq = 0
+
+    def push(self, req) -> None:
+        self._seq += 1
+        heapq.heappush(self._heap,
+                       (_PRIORITY_RANK[req.priority], self._seq, req))
+
+    def push_entry(self, ent: tuple) -> None:
+        """Re-park a popped entry with its ORIGINAL sequence number —
+        a budget-blocked head must keep its place in line, not move to
+        the back of its class."""
+        heapq.heappush(self._heap, ent)
+
+    def pop_entry(self) -> Optional[tuple]:
+        return heapq.heappop(self._heap) if self._heap else None
+
+    def __len__(self) -> int:
+        return len(self._heap)
+
+    def live(self) -> int:
+        """Entries whose request is still waiting (done handles are
+        lazily dropped at the next pop)."""
+        return sum(1 for _, _, r in list(self._heap)
+                   if not r.done.is_set())
+
+    def best_rank(self) -> Optional[int]:
+        ranks = [e[0] for e in list(self._heap)
+                 if not e[2].done.is_set()]
+        return min(ranks) if ranks else None
+
+    def requests(self) -> List[Any]:
+        return [r for _, _, r in list(self._heap)]
+
+    def drain(self) -> List[tuple]:
+        ents, self._heap = self._heap, []
+        return ents
+
+    def depths(self) -> Dict[str, int]:
+        """Live backlog per class (the queue-depth-by-class gauges)."""
+        out = {c: 0 for c in PRIORITY_CLASSES}
+        for _, _, r in list(self._heap):
+            if not r.done.is_set():
+                out[r.priority] += 1
+        return out
+
+    def parked(self) -> int:
+        """Preempted requests waiting to resume (the fleet's scale-in
+        victim filter reads this through /healthz)."""
+        return sum(1 for _, _, r in list(self._heap)
+                   if r.resume_ids is not None and not r.done.is_set())
+
+
+def _priority_instruments():
+    """Priority-scheduler metrics (ISSUE 17) — declared only when the
+    scheduler exists AND observability records: ``bigdl.llm.priority.
+    enabled`` off must leave no ``bigdl_llm_preemptions_total`` /
+    ``bigdl_llm_queue_depth_class`` / ``bigdl_llm_preempt_parked``
+    series (the disabled-mode absence contract)."""
+    return {
+        "preemptions": obs.counter(
+            "bigdl_llm_preemptions_total",
+            "In-flight decodes losslessly preempted for a higher "
+            "SLO class, by the victim's class",
+            labelnames=("class",)),
+        "queue_class": obs.gauge(
+            "bigdl_llm_queue_depth_class",
+            "Scheduler backlog by SLO class (the fleet autoscaler's "
+            "interactive-starvation signal)",
+            labelnames=("class",)),
+        "parked": obs.gauge(
+            "bigdl_llm_preempt_parked",
+            "Preempted requests parked for resume on this engine "
+            "(scale-in must not drain the worker holding them)"),
     }
 
 
@@ -267,11 +378,24 @@ paged_decode_step_sampled = make_sampled_step(paged_decode_step)
 class Request:
     """Handle returned by :meth:`LLMServer.submit`."""
 
-    def __init__(self, prompt_ids: np.ndarray, max_new_tokens: int):
+    def __init__(self, prompt_ids: np.ndarray, max_new_tokens: int,
+                 priority: str = "standard"):
         self.id = str(uuid.uuid4())
         self.prompt_ids = np.asarray(prompt_ids, np.int32).ravel()
         self.max_new_tokens = max_new_tokens
         self.tokens: List[int] = []
+        # SLO class (ISSUE 17): normalized on submit; plain metadata
+        # unless the server's priority scheduler exists
+        self.priority = priority
+        # lossless-preemption state (ISSUE 17): after a preempt the
+        # request re-queues journal-style as prompt + generated_so_far
+        # (resume_ids) with its remaining budget; _hold_rec pins the
+        # in-flight fence record whose drain must retire before the
+        # request may re-admit (a same-slot re-admission before the old
+        # step's fence drains would absorb that step's stale token)
+        self.resume_ids: Optional[np.ndarray] = None
+        self.preemptions = 0
+        self._hold_rec: Optional[dict] = None
         self.error: Optional[str] = None
         self.done = threading.Event()
         # cooperative cancellation (ISSUE 7): set by LLMServer.abort
@@ -426,7 +550,8 @@ class LLMServer:
                  slo: Optional[bool] = None,
                  mixed: Optional[bool] = None,
                  chunk_tokens: Optional[int] = None,
-                 chunk_wait: Optional[float] = None):
+                 chunk_wait: Optional[float] = None,
+                 priority: Optional[bool] = None):
         import inspect
 
         from bigdl_tpu.llm.models.llama import forward, init_cache
@@ -689,6 +814,26 @@ class LLMServer:
             # per-slot cache grant (suffix budget charge + adopted
             # shared pages) — release decrements refcounts at EOS
             self._slot_adm: List[Optional[Any]] = [None] * max_batch
+            # SLO-class priority scheduling + lossless preemption
+            # (ISSUE 17): constructed ONLY when enabled — disabled mode
+            # is structurally absent (no scheduler object, no parked-
+            # blob map, no bigdl_llm_preemptions_total / class-gauge
+            # series, admission stays FIFO off the intake queue)
+            pr = (priority if priority is not None else
+                  conf.get_bool("bigdl.llm.priority.enabled", False))
+            self._sched = _PriorityScheduler() if pr else None
+            # exported-on-preempt KV handoff blobs keyed by request id,
+            # dropped at resume (the parked chain survives radix
+            # eviction under pool pressure)
+            self._parked: Optional[Dict[str, bytes]] = {} if pr else None
+            # fence record of the most recent preemption: at most one
+            # preemption per in-flight window (its pages free at this
+            # fence — preempting again before it drains could not admit
+            # the waiter anyway)
+            self._preempt_rec: Optional[dict] = None
+            self._pri_ins = None
+            self.preemptions_total = 0
+            self.preempt_resumes_total = 0
         else:
             if kvtier:
                 raise ValueError("the host tier is page-pool only; "
@@ -697,6 +842,16 @@ class LLMServer:
                 raise ValueError("unified mixed dispatch is page-pool "
                                  "only; the slot-static cache has no "
                                  "chunked prefill")
+            if priority:
+                raise ValueError("priority scheduling is page-pool "
+                                 "only; lossless preemption needs the "
+                                 "paged KV chain to park and resume")
+            self._sched = None
+            self._parked = None
+            self._preempt_rec = None
+            self._pri_ins = None
+            self.preemptions_total = 0
+            self.preempt_resumes_total = 0
             self._mixed = self._mixed_active = False
             self._chunk_state = None
             self._kv = None       # the slot-static cache has no pages
@@ -740,14 +895,16 @@ class LLMServer:
         return self._kv.prefix_tokens_reused if self._kv else 0
 
     # -- client API ----------------------------------------------------------
-    def submit(self, prompt_ids, max_new_tokens: int = 32) -> Request:
+    def submit(self, prompt_ids, max_new_tokens: int = 32,
+               priority: Optional[str] = None) -> Request:
         reliability.inject("llm.submit")
         if max_new_tokens < 1:
             # a zero-budget request would occupy a slot with no step
             # ever dispatched for it (dispatches are capped at
             # max_new_tokens) — reject instead of wedging the slot
             raise ValueError("max_new_tokens must be >= 1")
-        req = Request(prompt_ids, max_new_tokens)
+        req = Request(prompt_ids, max_new_tokens,
+                      priority=normalize_priority(priority))
         if len(req.prompt_ids) + max_new_tokens > self.max_seq_len:
             raise ValueError("prompt + max_new_tokens exceeds max_seq_len")
         pages = None
@@ -787,6 +944,14 @@ class LLMServer:
             self._watchdog_fail(req, self._watchdog_msg())
             return req
         try:
+            # with the priority scheduler the engine drains the intake
+            # queue into its heap every pass, so the Queue's own maxsize
+            # alone would never fire: bound intake + scheduler backlog
+            # together to keep ISSUE 2's backpressure contract
+            if self._sched is not None and self.max_queue and \
+                    self._queue.qsize() + len(self._sched) >= \
+                    self.max_queue:
+                raise queue.Full
             self._queue.put_nowait(req)
         except queue.Full:
             # the 503 carries the page accounting (post-lookup suffix
@@ -826,6 +991,30 @@ class LLMServer:
                 pages_free=pages["pages_free"] if pages else None)
         return req
 
+    def retry_depth(self, priority: Optional[str] = None) -> float:
+        """Queue depth for Retry-After derivation (ISSUE 17 satellite).
+        Scheduler off: the plain intake depth, bit-identical to HEAD.
+        Scheduler on: intake + class-ordered backlog, weighted by the
+        shedded request's class so batch clients back off harder than
+        interactive ones under the SAME backlog (float — the caller's
+        ``reliability.retry_after_seconds`` truncates)."""
+        depth = self._queue.qsize()
+        if self._sched is None:
+            return depth
+        return ((depth + len(self._sched))
+                * CLASS_RETRY_WEIGHTS[normalize_priority(priority)])
+
+    def class_depths(self) -> Optional[Dict[str, int]]:
+        """Live scheduler backlog per SLO class; None when the priority
+        scheduler is off (callers emit no class keys at all)."""
+        return self._sched.depths() if self._sched is not None else None
+
+    @property
+    def preempt_parked(self) -> int:
+        """Preempted requests parked for resume on this engine (0 when
+        the scheduler is off — the fleet's scale-in filter is inert)."""
+        return self._sched.parked() if self._sched is not None else 0
+
     def export_chain(self, tokens) -> bytes:
         """Serialize the cached FULL pages of ``tokens`` into a handoff
         blob (ISSUE 6 disaggregation: the prefill-role side). Device
@@ -834,24 +1023,30 @@ class LLMServer:
         fence; host-resident chunks are read straight from the arena.
         Pages already evicted from both tiers are simply absent: the
         importer's decode worker re-prefills whatever is missing."""
-        from bigdl_tpu.llm.kvtier.handoff import serialize_chain
         if self._tier is None:
             raise RuntimeError(
                 "KV handoff needs bigdl.llm.kvtier.enabled")
         with self._lock:
-            dev, host = self._kv.chain_locations(tokens)
-            k_pages = [np.asarray(self._k_pages[:, pid]) for pid in dev]
-            v_pages = [np.asarray(self._v_pages[:, pid]) for pid in dev]
-            for key, slot in host:
-                # keyed copy-read: a concurrent import can LRU-re-key
-                # the slot between lookup and here — a mismatch
-                # truncates the export (contiguity ends at the first
-                # missing chunk) instead of shipping wrong bytes
-                pages = self._tier.arena.read_keyed(slot, key)
-                if pages is None:
-                    break
-                k_pages.append(pages[0])
-                v_pages.append(pages[1])
+            return self._export_chain_locked(tokens)
+
+    def _export_chain_locked(self, tokens) -> bytes:
+        """Export body, caller holds ``self._lock`` (the lock is NOT
+        reentrant — the engine thread's preempt path at _preempt_slot
+        already holds it and must call this directly)."""
+        from bigdl_tpu.llm.kvtier.handoff import serialize_chain
+        dev, host = self._kv.chain_locations(tokens)
+        k_pages = [np.asarray(self._k_pages[:, pid]) for pid in dev]
+        v_pages = [np.asarray(self._v_pages[:, pid]) for pid in dev]
+        for key, slot in host:
+            # keyed copy-read: a concurrent import can LRU-re-key
+            # the slot between lookup and here — a mismatch
+            # truncates the export (contiguity ends at the first
+            # missing chunk) instead of shipping wrong bytes
+            pages = self._tier.arena.read_keyed(slot, key)
+            if pages is None:
+                break
+            k_pages.append(pages[0])
+            v_pages.append(pages[1])
         blob = serialize_chain(
             np.asarray(tokens, np.int64)[:len(k_pages) * self._page],
             k_pages, v_pages, self._page)
@@ -923,6 +1118,7 @@ class LLMServer:
         with self._lock:
             return (self._queue.empty()
                     and getattr(self, "_pending_head", None) is None
+                    and (self._sched is None or self._sched.live() == 0)
                     and not self._fetch_wait
                     and not self._fetch_ready
                     and all(r is None for r in self._slots))
@@ -1036,6 +1232,13 @@ class LLMServer:
         head = getattr(self, "_pending_head", None)
         if head is not None:
             failed += self._watchdog_fail(head, msg)
+        sched = getattr(self, "_sched", None)
+        if sched is not None:
+            # flag-only, same contract as the queue drain above: the
+            # heap itself belongs to the engine thread, which drops
+            # done entries at its next pop (if it ever wakes)
+            for req in sched.requests():
+                failed += self._watchdog_fail(req, msg)
         for req in list(self._slots):
             if req is not None:
                 failed += self._watchdog_fail(req, msg)
@@ -1079,6 +1282,8 @@ class LLMServer:
                 with self._lock:
                     idle = (self._queue.empty()
                             and getattr(self, "_pending_head", None) is None
+                            and (self._sched is None
+                                 or self._sched.live() == 0)
                             and not self._fetch_wait
                             and not self._fetch_ready
                             and all(r is None for r in self._slots))
@@ -1122,6 +1327,14 @@ class LLMServer:
             req.error = "server stopped before the request took a slot"
             req.done.set()
         self._fetch_ready = []
+        if self._sched is not None:
+            # scheduler entries hold no budget (budget-blocked heads
+            # re-park WITHOUT an admission grant) — flag-only cleanup
+            for _, _, req in self._sched.drain():
+                if not req.done.is_set():
+                    req.error = ("server stopped before the request "
+                                 "took a slot")
+                    req.done.set()
         if self._tier is not None:
             self._tier.close()
         if self._pending_release:
@@ -1210,6 +1423,47 @@ class LLMServer:
                     and job is not None and not job.ok)
             self._fetch_ready.append((req, adm))
 
+    def _prompt_of(self, req: Request) -> np.ndarray:
+        """The token ids admission/prefill must process: the original
+        prompt, or prompt + generated_so_far after a preemption
+        (ISSUE 17 journal-style resume — greedy decode over the
+        extended prompt is deterministic, so the continuation is
+        bit-identical to the unpreempted run)."""
+        return (req.resume_ids if req.resume_ids is not None
+                else req.prompt_ids)
+
+    def _budget_of(self, req: Request) -> int:
+        """Decode budget still owed: ``max_new_tokens`` minus tokens
+        already drained to the handle before a preemption."""
+        return req.max_new_tokens - len(req.tokens)
+
+    def _sched_pop(self) -> Optional[tuple]:
+        """Pop the best live, unheld scheduler entry. Done handles are
+        dropped; held entries (preempted requests whose old fence
+        record has not drained yet — re-admitting one early could
+        absorb that step's stale speculative token) are skipped and
+        re-parked with their original order."""
+        held: List[tuple] = []
+        out = None
+        while True:
+            ent = self._sched.pop_entry()
+            if ent is None:
+                break
+            req = ent[2]
+            if req.done.is_set():
+                continue           # aborted/failed while queued
+            rec = req._hold_rec
+            if rec is not None:
+                if any(r is rec for r in self._inflight):
+                    held.append(ent)
+                    continue
+                req._hold_rec = None
+            out = ent
+            break
+        for h in held:
+            self._sched.push_entry(h)
+        return out
+
     def _admit(self):
         """Fill free slots from the queue; per-slot prefill. Paged mode
         additionally requires the request's worst-case page budget
@@ -1221,11 +1475,26 @@ class LLMServer:
         fetches re-enter here first."""
         if self._fetch_wait:
             self._poll_fetches()
+        if self._sched is not None:
+            # class-ordered admission (ISSUE 17): drain the thread-safe
+            # intake queue into the scheduler heap, then admit in
+            # (class rank, arrival) order. The heap is engine-thread
+            # only; submit() bounds intake + heap together.
+            try:
+                while True:
+                    self._sched.push(self._queue.get_nowait())
+            except queue.Empty:
+                pass
         for i in range(self.max_batch):
             if self._slots[i] is not None:
                 continue
             if not self._admit_into(i):
-                return
+                break
+        if self._sched is not None and self._sched.live():
+            # waiters remain after the sweep (no slot, or the best one
+            # is budget-blocked): lossless preemption of a lower-class
+            # decode is the relief valve
+            self._consider_preempt()
 
     def _admit_into(self, i: int) -> bool:
         """Admit one request into free slot ``i``. False stops the slot
@@ -1245,7 +1514,7 @@ class LLMServer:
                 # this very pass may have consumed what the poll saw
                 # free. Peek-then-pop so an injected kvcache.evict
                 # raise leaves the entry for the loop's retry.
-                own = (-(-len(req.prompt_ids) // self._page)
+                own = (-(-len(self._prompt_of(req)) // self._page)
                        - adm.matched_len // self._page)
                 if own > 0:
                     self._kv.ensure_free(own)
@@ -1258,32 +1527,45 @@ class LLMServer:
                 self._prefill_admitted(
                     i, req, adm,
                     chunked=(self._mixed_active
-                             and len(req.prompt_ids) - adm.matched_len
-                             > self._chunk_tokens),
+                             and len(self._prompt_of(req))
+                             - adm.matched_len > self._chunk_tokens),
                     prepaid=True)
                 return True
-            # a budget-blocked head is HELD here (not re-queued: put()
-            # appends, and clients submit concurrently, so
-            # drain-and-requeue would let a late submit overtake the
-            # whole waiting line)
-            req = getattr(self, "_pending_head", None)
-            if req is None:
-                try:
-                    req = self._queue.get_nowait()
-                except queue.Empty:
+            ent = None
+            if self._sched is not None:
+                # class-ordered source (ISSUE 17): the heap replaces
+                # both the FIFO queue and the held head — a budget-
+                # blocked best entry re-parks below with its ORIGINAL
+                # order, so head-of-line becomes head-of-class
+                ent = self._sched_pop()
+                if ent is None:
                     return False
-            self._pending_head = None
-            if req.done.is_set():
-                # aborted (or watchdog-failed) while queued: skip —
-                # nothing was charged for it yet
-                continue
+                req = ent[2]
+            else:
+                # a budget-blocked head is HELD here (not re-queued:
+                # put() appends, and clients submit concurrently, so
+                # drain-and-requeue would let a late submit overtake
+                # the whole waiting line)
+                req = getattr(self, "_pending_head", None)
+                if req is None:
+                    try:
+                        req = self._queue.get_nowait()
+                    except queue.Empty:
+                        return False
+                self._pending_head = None
+                if req.done.is_set():
+                    # aborted (or watchdog-failed) while queued: skip —
+                    # nothing was charged for it yet
+                    continue
+            ids = self._prompt_of(req)
+            budget = self._budget_of(req)
             adm = None
             chunked = False
             if self.paged:
                 t_lk = time.perf_counter()
                 chunk_first = None
                 if self._mixed_active and \
-                        len(req.prompt_ids) > self._chunk_tokens:
+                        len(ids) > self._chunk_tokens:
                     # chunked-admission decision (ISSUE 14): a long
                     # uncached DEVICE suffix is fed in page-aligned
                     # chunks, charging only the first chunk now.
@@ -1293,8 +1575,7 @@ class LLMServer:
                     # thread is the only index mutator. Prompts at or
                     # under chunk_tokens skip the peek outright (no
                     # second radix walk on the short-prompt hot path).
-                    pk = self._kv.peek(req.prompt_ids,
-                                       req.max_new_tokens)
+                    pk = self._kv.peek(ids, budget)
                     if pk["matched_tokens"] == pk["matched_device"] \
                             and pk["pages_needed"] <= \
                             self._num_pages - 1:
@@ -1305,10 +1586,10 @@ class LLMServer:
                         # check below fires — a chunked admit would
                         # loop charge→starve→"retriable" shed forever
                         off0 = pk["matched_device"]
-                        suffix = len(req.prompt_ids) - off0
+                        suffix = len(ids) - off0
                         if suffix > self._chunk_tokens:
                             end0 = self._chunk_end(
-                                off0, len(req.prompt_ids))
+                                off0, len(ids))
                             chunk_first = (-(-end0 // self._page)
                                            - off0 // self._page)
                 try:
@@ -1316,18 +1597,20 @@ class LLMServer:
                     # + pre-eviction for the prompt's own pages, in one
                     # atomic manager call (ISSUE 5); chunked admissions
                     # charge the first chunk only (ISSUE 14)
-                    adm = self._kv.admit(req.prompt_ids,
-                                         req.max_new_tokens,
+                    adm = self._kv.admit(ids, budget,
                                          chunk_pages=chunk_first)
                     chunked = chunk_first is not None
                 except BaseException:
                     # injected kvcache.evict fault: nothing was charged
-                    # or adopted — hold the head, let the loop retry
-                    self._pending_head = req
+                    # or adopted — hold the head (or re-park the heap
+                    # entry in place), let the loop retry
+                    if ent is not None:
+                        self._sched.push_entry(ent)
+                    else:
+                        self._pending_head = req
                     raise
                 if adm is None:
-                    peek = self._kv.peek(req.prompt_ids,
-                                         req.max_new_tokens)
+                    peek = self._kv.peek(ids, budget)
                     if peek["pages_needed"] > self._num_pages - 1:
                         # the cached prefix that made this request
                         # feasible at submit time has been evicted: it
@@ -1340,14 +1623,20 @@ class LLMServer:
                             "evicted since submit)")
                         req.done.set()
                         continue
-                    self._pending_head = req   # retry next loop pass
+                    if ent is not None:
+                        # budget-blocked: re-park in place, keep
+                        # sweeping nothing — the preempt pass at the
+                        # end of _admit is the relief valve
+                        self._sched.push_entry(ent)
+                    else:
+                        self._pending_head = req   # retry next pass
                     return False
                 if self._kv.enabled:
                     wall = time.perf_counter() - t_lk
                     obs.add_complete(
                         "kvcache/lookup", time.time() - wall, wall,
                         request=req.id, matched_tokens=adm.matched_len,
-                        prompt_tokens=len(req.prompt_ids))
+                        prompt_tokens=len(ids))
                     if flight.enabled:
                         flight.record(
                             "radix_hit" if adm.matched_len else
@@ -1355,7 +1644,7 @@ class LLMServer:
                             trace_id=_trace_of(req),
                             matched_tokens=adm.matched_len,
                             device_matched=adm.device_matched,
-                            prompt_tokens=len(req.prompt_ids))
+                            prompt_tokens=len(ids))
                         if adm.tail_src is not None:
                             flight.record(
                                 "cow_fork", request_id=req.id,
@@ -1394,12 +1683,27 @@ class LLMServer:
                 "llm/queue_wait", req.submitted_at,
                 time.time() - req.submitted_at, trace=ctx.trace_id,
                 stage="queue", request=req.id, **args)
+        ids = self._prompt_of(req)
         if flight.enabled:
             flight.record(
                 "admit", request_id=req.id, trace_id=_trace_of(req),
                 slot=i, chunked=chunked, prepaid=prepaid,
                 matched_tokens=adm.matched_len if adm else 0,
-                prompt_tokens=len(req.prompt_ids))
+                prompt_tokens=len(ids))
+        if self._sched is not None and req.resume_ids is not None:
+            # a preempted request re-took a slot (ISSUE 17): the resume
+            # event mirrors the preempt one — chaos reconciles the two
+            # tallies exactly against preemptions_total
+            self.preempt_resumes_total += 1
+            if self._parked is not None:
+                self._parked.pop(req.id, None)
+            if flight.enabled:
+                flight.record(
+                    "preempt_resume", request_id=req.id,
+                    trace_id=_trace_of(req), slot=i,
+                    priority=req.priority,
+                    tokens_done=len(req.tokens),
+                    remaining=self._budget_of(req))
         if chunked:
             self._begin_chunked(i, req, adm, prepaid)
             return
@@ -1407,7 +1711,7 @@ class LLMServer:
         try:
             with rc.activate(ctx), \
                     obs.span("llm/prefill", slot=i,
-                             tokens=len(req.prompt_ids),
+                             tokens=len(ids),
                              stage="llm_server", request=req.id):
                 (self._prefill_paged if self.paged
                  else self._prefill_slot)(i, req)
@@ -1423,8 +1727,7 @@ class LLMServer:
             req.done.set()
             raise
         req.decode_started_at = time.time()
-        suffix = len(req.prompt_ids) - (adm.matched_len if adm
-                                        else 0)
+        suffix = len(ids) - (adm.matched_len if adm else 0)
         self._record_prefill(suffix, time.perf_counter() - t0)
 
     def _instruments(self):
@@ -1437,8 +1740,24 @@ class LLMServer:
             self._ins = _llm_instruments()
         return self._ins
 
+    def _priority_instruments_get(self):
+        """None unless the priority scheduler exists AND observability
+        records — same lazy-declaration contract as _instruments(),
+        same structural-absence contract as _mixed_instruments()."""
+        if not (self._sched is not None and obs.enabled()):
+            return None
+        if self._pri_ins is None:
+            self._pri_ins = _priority_instruments()
+        return self._pri_ins
+
     def _record_kv_gauges(self, ins):
-        ins["queue"].set(self._queue.qsize())
+        backlog = len(self._sched) if self._sched is not None else 0
+        ins["queue"].set(self._queue.qsize() + backlog)
+        pri = self._priority_instruments_get()
+        if pri is not None:
+            for cls, depth in self._sched.depths().items():
+                pri["queue_class"].labels(**{"class": cls}).set(depth)
+            pri["parked"].set(self._sched.parked())
         if self.paged:
             ins["kv_pages"].set(self.pages_in_use)
             # page 0 is the reserved trash page, never allocatable
@@ -1567,7 +1886,7 @@ class LLMServer:
         paths."""
         self._pin(*pins, last, self._last, self._bt_dev, self._lens_dev)
         self._last = self._last.at[i].set(last)
-        T = len(req.prompt_ids)
+        T = len(self._prompt_of(req))
         npages = len(row_pages)
         self._bt[i, :] = 0
         self._bt[i, :npages] = row_pages
@@ -1586,7 +1905,7 @@ class LLMServer:
             self._kv.release_transient(adm)
         self._slot_pages[i] = own
         self._slots[i] = req
-        self._remaining[i] = req.max_new_tokens
+        self._remaining[i] = self._budget_of(req)
         self._index_prompt(i, req)
 
     def _prefill_paged(self, i: int, req: Request):
@@ -1599,7 +1918,8 @@ class LLMServer:
             return self._prefill_ragged(i, req, adm)
         if adm is not None and adm.matched_len:
             return self._prefill_paged_partial(i, req, adm)
-        t = len(req.prompt_ids)
+        prompt = self._prompt_of(req)
+        t = len(prompt)
         page = self._page
         npages = -(-t // page)
         ids = self._kv.alloc(npages)
@@ -1611,7 +1931,7 @@ class LLMServer:
                 fn = _PAGED_STEP_CACHE[key] = \
                     self._build_paged_prefill(bucket)
             toks = np.zeros((1, bucket), np.int32)
-            toks[0, :t] = req.prompt_ids
+            toks[0, :t] = prompt
             pids = np.zeros(bucket // page, np.int32)
             pids[:npages] = ids
             toks_d = jnp.asarray(toks)
@@ -1652,7 +1972,8 @@ class LLMServer:
         tail page is copy-on-write forked into the request's own first
         suffix page by the same scatter."""
         page = self._page
-        T = len(req.prompt_ids)
+        prompt = self._prompt_of(req)
+        T = len(prompt)
         off = adm.matched_len
         koff = off // page
         own = self._kv.alloc(-(-T // page) - koff)
@@ -1671,7 +1992,7 @@ class LLMServer:
                 fn = _PAGED_STEP_CACHE[key] = \
                     self._build_partial_prefill(n_pp, bucket)
             toks = np.zeros((1, bucket), np.int32)
-            toks[0, :t_suf] = req.prompt_ids[off:]
+            toks[0, :t_suf] = prompt[off:]
             pids = np.zeros(n_pp, np.int32)
             pids[:len(gsrc)] = gsrc
             # scatter targets for the page-aligned window at koff*page:
@@ -1740,7 +2061,8 @@ class LLMServer:
         from a device prefix hit by the time prefill runs). The COW
         tail fork is a single page copy fused ahead of the layer scan."""
         page = self._page
-        T = len(req.prompt_ids)
+        prompt = self._prompt_of(req)
+        T = len(prompt)
         off = adm.matched_len if adm is not None else 0
         koff = off // page
         shared = list(adm.shared_pages) if adm is not None else []
@@ -1756,7 +2078,7 @@ class LLMServer:
                 fn = _PAGED_STEP_CACHE[key] = \
                     self._build_ragged_prefill(bucket)
             toks = np.zeros((1, bucket), np.int32)
-            toks[0, :t_suf] = req.prompt_ids[off:]
+            toks[0, :t_suf] = prompt[off:]
             bt_row = np.zeros(self._pages_cap, np.int32)
             bt_row[:len(row_pages)] = row_pages
             # scatter targets for the suffix window [off, off+bucket):
@@ -1799,9 +2121,10 @@ class LLMServer:
         (COW) rather than racing this request's decode writes."""
         if self._kv is None or not self._kv.enabled:
             return
-        nfull = len(req.prompt_ids) // self._page
+        prompt = self._prompt_of(req)
+        nfull = len(prompt) // self._page
         if nfull:
-            self._kv.insert(req.prompt_ids[:nfull * self._page],
+            self._kv.insert(prompt[:nfull * self._page],
                             self._bt[i, :nfull])
 
     # -- unified mixed prefill+decode dispatch (ISSUE 14) --------------------
@@ -1841,6 +2164,25 @@ class LLMServer:
         if self._chunk_state is None:
             return None
         n = self.max_batch
+        if self._sched is not None:
+            # class-ordered chunk selection (ISSUE 17): the per-pass
+            # prefill budget goes to the highest-class chunker —
+            # within a class, lowest slot keeps the pick stable (no
+            # round-robin: two equal-class chunkers alternate only
+            # when the leader stalls on the ledger)
+            best = None
+            for i in range(n):
+                st = self._chunk_state[i]
+                if st is None:
+                    continue
+                if st["req"].cancel_requested or \
+                        st["req"].done.is_set():
+                    self._rollback_chunk(i, None)
+                    continue
+                key = (_PRIORITY_RANK[st["req"].priority], i)
+                if best is None or key < best[0]:
+                    best = (key, i)
+            return best[1] if best is not None else None
         for k in range(n):
             i = (self._chunk_rr + k) % n
             st = self._chunk_state[i]
@@ -1864,7 +2206,8 @@ class LLMServer:
         st = self._chunk_state[i]
         req, adm = st["req"], st["adm"]
         page = self._page
-        T = len(req.prompt_ids)
+        ids = self._prompt_of(req)
+        T = len(ids)
         off = st["off"]
         if not st["first"]:
             # the mid-admission fault site (ISSUE 14): a raise between
@@ -1891,7 +2234,7 @@ class LLMServer:
             # Σ(admit + chunk charges) equals the unchunked worst case
             # exactly (the first chunk never charges here: suffix >
             # chunk_tokens means it never reaches the prompt end)
-            need += (-(-(T + req.max_new_tokens) // page)
+            need += (-(-(T + self._budget_of(req)) // page)
                      - (-(-T // page)))
         # ledger FIRST: admit(chunk_pages=) already charged the FIRST
         # chunk, and prepaid (fetch-path) admissions charged in full —
@@ -1908,11 +2251,33 @@ class LLMServer:
             if st["wait_t0"] is None:
                 st["wait_t0"] = now
             elif now - st["wait_t0"] > self._chunk_wait:
+                victim = i
+                if self._sched is not None:
+                    # class-ordered shed victim (ISSUE 17): a starved
+                    # HIGH-class chunker sheds the worst strictly-
+                    # lower-class chunker instead of itself — freeing
+                    # that chain is exactly what unblocks the ledger.
+                    # No lower-class peer → shed self (unchanged).
+                    rank_i = _PRIORITY_RANK[req.priority]
+                    worst = None
+                    for j in range(self.max_batch):
+                        sj = self._chunk_state[j]
+                        if sj is None or j == i:
+                            continue
+                        rj = _PRIORITY_RANK[sj["req"].priority]
+                        if rj > rank_i and (worst is None
+                                            or (rj, j) > worst[0]):
+                            worst = ((rj, j), j)
+                    if worst is not None:
+                        victim = worst[1]
+                        st["wait_t0"] = now   # fresh window for i: the
+                        # shed frees pages only after the rollback
                 self._rollback_chunk(
-                    i, f"chunked admission starved: the ledger could "
-                       f"not cover the next {charge_now} pages within "
-                       f"{self._chunk_wait:g}s (retriable: partial "
-                       "chain rolled back; resubmit)")
+                    victim,
+                    f"chunked admission starved: the ledger could "
+                    f"not cover the next {charge_now} pages within "
+                    f"{self._chunk_wait:g}s (retriable: partial "
+                    "chain rolled back; resubmit)")
             return None
         st["wait_t0"] = None
         try:
@@ -1937,7 +2302,7 @@ class LLMServer:
                         0).astype(np.int32)
         slots = (pos % page).astype(np.int32)
         toks = np.zeros((1, bucket), np.int32)
-        toks[0, :c] = req.prompt_ids[off:end]
+        toks[0, :c] = ids[off:end]
         ops = (jnp.asarray(toks), jnp.asarray(c, jnp.int32),
                jnp.asarray(off, jnp.int32), jnp.asarray(bt_row),
                jnp.asarray(phys), jnp.asarray(slots),
@@ -2432,6 +2797,131 @@ class LLMServer:
             self._pos[i] = 0
             self._pin(self._pos_dev)
             self._pos_dev = self._pos_dev.at[i].set(0)
+
+    # -- lossless preemption (ISSUE 17) --------------------------------------
+    def _consider_preempt(self):
+        """A higher-class request is waiting and the admission sweep
+        could not seat it: evict the worst strictly-lower-class decode,
+        losslessly. At most one preemption per in-flight window — the
+        victim's pages only return at the newest fence, so a second
+        victim before that drains could not seat the waiter either."""
+        rec = self._preempt_rec
+        if rec is not None and any(r is rec for r in self._inflight):
+            return
+        self._preempt_rec = None
+        best = self._sched.best_rank()
+        if best is None:
+            return
+        victim = None
+        for i in range(self.max_batch):
+            req = self._slots[i]
+            if req is None or req.done.is_set() or req.cancel_requested:
+                continue
+            if self._chunk_state is not None and \
+                    self._chunk_state[i] is not None:
+                continue     # mid-prompt chunked admission: no usable
+                             # chain yet, rollback (not preempt) owns it
+            if self._remaining[i] <= 0:
+                continue     # budget exhausted: finishing at the next
+                             # drain anyway, eviction would save nothing
+            rank = _PRIORITY_RANK[req.priority]
+            if rank <= best:
+                continue     # only a STRICTLY lower class is evicted
+            key = (rank, -len(req.tokens), i)
+            if victim is None or key > victim[0]:
+                # worst class first; among equals the youngest decode
+                # (fewest tokens to re-prefill at resume)
+                victim = (key, i)
+        if victim is not None:
+            self._preempt_slot(victim[1])
+
+    def _preempt_slot(self, i: int):
+        """Losslessly evict the decode in slot ``i`` (ISSUE 17): park
+        its KV chain (radix index + optional host-tier handoff blob),
+        free the slot and pages at the in-flight fence exactly like
+        ``_finish_slot``, and re-queue the request journal-style as
+        ``prompt + generated_so_far`` with its remaining budget. Greedy
+        decode over the extended prompt is deterministic, so the resume
+        — with or without a surviving cached chain — continues
+        bit-identical to the unpreempted run; the chain only decides
+        how much prefill the resume pays, never what it generates."""
+        reliability.inject("llm.preempt")
+        req = self._slots[i]
+        t0 = time.perf_counter()
+        with obs.span("llm/preempt", slot=i, stage="llm_server",
+                      request=req.id, victim_class=req.priority,
+                      tokens_done=len(req.tokens)):
+            adm = self._slot_adm[i]
+            owned = self._slot_pages[i]
+            adopted = adm.shared_pages if adm is not None else []
+            charge = adm.charge if adm is not None else 0
+            toks = list(map(int, req.prompt_ids)) + \
+                list(map(int, req.tokens))
+            mode = "dropped"
+            if self._kv.enabled:
+                # park index-only: the chain survives at refcount 1
+                # (evictable) and the resume admission re-adopts it as
+                # an ordinary radix hit. In-flight speculative writes
+                # land PAST the indexed length — harmless, the same
+                # argument _finish_slot relies on.
+                self._kv.insert(toks,
+                                self._bt[i, :-(-len(toks)
+                                               // self._page)])
+                mode = "indexed"
+                if self._tier is not None:
+                    # belt and braces: a handoff blob pins the chain
+                    # against radix eviction under pool pressure, and
+                    # the router journal can resume on ANOTHER worker
+                    # by importing it (the PR 6 disaggregation path)
+                    try:
+                        self._parked[req.id] = \
+                            self._export_chain_locked(toks)
+                        mode = "exported"
+                    except Exception:
+                        pass   # export is an optimization, not a
+                               # correctness dependency: resume
+                               # re-prefills whatever is missing
+            self._slots[i] = None
+            self._remaining[i] = 0
+            self._slot_pages[i] = []
+            self._slot_adm[i] = None
+            if self._kv.enabled and self._inflight:
+                # the fence-deferred release walk, exactly as
+                # _finish_slot: in-flight speculative steps still read
+                # these pages through their device block tables
+                self._inflight[-1].setdefault("kv_release", []).append(
+                    (charge, owned, adopted))
+            else:
+                self._kv.release_slot(charge, owned, adopted)
+            self._bt[i, :] = 0
+            self._lens[i] = 0
+            self._pin(self._bt_dev, self._lens_dev)
+            self._bt_dev = self._bt_dev.at[i].set(0)
+            self._lens_dev = self._lens_dev.at[i].set(0)
+            # journal-style re-queue: resume = prompt + generated, with
+            # the remaining budget; the hold record keeps the request
+            # out of a slot until its old steps' fences drain (a
+            # same-slot re-admission could absorb a stale speculative
+            # token through the drain's identity check)
+            req.resume_ids = np.asarray(toks, np.int32)
+            req.preemptions += 1
+            req._hold_rec = self._inflight[-1] if self._inflight \
+                else None
+            self._preempt_rec = req._hold_rec
+            self.preemptions_total += 1
+            self._sched.push(req)
+        pri = self._priority_instruments_get()
+        if pri is not None:
+            pri["preemptions"].labels(**{"class": req.priority}).inc()
+        if flight.enabled:
+            # same site as the counter: the chaos harness reconciles
+            # flight preempt events == counter == preemptions_total
+            flight.record(
+                "preempt", request_id=req.id, trace_id=_trace_of(req),
+                slot=i, priority=req.priority, mode=mode,
+                tokens_done=len(req.tokens),
+                remaining=self._budget_of(req),
+                wall_ms=round((time.perf_counter() - t0) * 1000.0, 3))
 
     def _step_paged(self) -> bool:
         ci = self._chunk_slot()

@@ -87,6 +87,11 @@ from bigdl_tpu.observability.federation import (
 
 ROLES = ("", "prefill", "decode")
 
+# SLO-class header (ISSUE 17): case-insensitive (HTTPMessage lookups
+# already are), propagated router→worker alongside the trace/deadline
+# headers so the journal's failover re-dispatch keeps the class
+PRIORITY_HEADER = "X-BigDL-Priority"
+
 
 class _QuietHTTPServer(ThreadingHTTPServer):
     """Abandoned client connections are ROUTINE on these surfaces
@@ -177,8 +182,14 @@ class LLMWorker:
                 injected one — InjectedFault is deliberately NOT
                 special-cased, per the faults.py contract) answers 500
                 instead of killing the handler's connection."""
+                pri = self.headers.get(PRIORITY_HEADER)
                 try:
-                    return worker.server.submit(ids, max_new_tokens=mnt)
+                    # the kwarg is passed only when the header is
+                    # present: stub servers in tests (and any
+                    # priority-unaware engine) keep working unchanged
+                    kw = {"priority": pri} if pri is not None else {}
+                    return worker.server.submit(ids, max_new_tokens=mnt,
+                                                **kw)
                 except reliability.OverloadError as e:
                     # page accounting rides the Retry-After diagnostics
                     # (ISSUE 5 satellite): pages_needed is the POST-
@@ -196,9 +207,16 @@ class LLMWorker:
                     # Retry-After derived from observed queue depth
                     # (ISSUE 7 satellite) — a deep backlog tells
                     # clients to back off longer, jitter decorrelates
-                    # the retry herd
-                    q = getattr(worker.server, "_queue", None)
-                    depth = q.qsize() if q is not None else 0
+                    # the retry herd. With the priority scheduler the
+                    # depth is class-weighted (ISSUE 17 satellite):
+                    # batch clients back off harder than interactive
+                    # ones under the SAME backlog.
+                    rd = getattr(worker.server, "retry_depth", None)
+                    if rd is not None:
+                        depth = rd(pri)
+                    else:
+                        q = getattr(worker.server, "_queue", None)
+                        depth = q.qsize() if q is not None else 0
                     self._json(503, body, headers=(
                         ("Retry-After",
                          reliability.retry_after_seconds(depth)),))
@@ -246,12 +264,20 @@ class LLMWorker:
                         self._json(200, worker._drain.status())
                 elif self.path == "/worker_get_status":
                     dt = max(time.time() - worker._t0, 1e-9)
-                    self._json(200, {
+                    status = {
                         "model": worker.model_name,
                         "role": worker.role,
                         "queue_length": worker.server._queue.qsize(),
                         "steps": worker.server.steps,
-                        "speed": round(worker._tokens_out / dt, 2)})
+                        "speed": round(worker._tokens_out / dt, 2)}
+                    cd = getattr(worker.server, "class_depths", None)
+                    depths = cd() if cd is not None else None
+                    if depths is not None:
+                        # ISSUE 17: absent when the scheduler is off
+                        status["queue_by_class"] = depths
+                        status["preempt_parked"] = \
+                            worker.server.preempt_parked
+                    self._json(200, status)
                 elif self.path == "/metrics":
                     # same Prometheus surface as the cluster-serving
                     # frontend: prefill/decode tokens, KV occupancy, …
@@ -310,6 +336,17 @@ class LLMWorker:
                     slo = getattr(worker.server, "_slo", None)
                     if slo is not None:
                         body["slo"] = slo.status()
+                    # priority scheduler (ISSUE 17): per-class backlog
+                    # and preempted-parked count, keys structurally
+                    # absent when bigdl.llm.priority.enabled is off —
+                    # the fleet's scale-in victim filter and class-
+                    # pressure signal read these without federation
+                    cd = getattr(worker.server, "class_depths", None)
+                    depths = cd() if cd is not None else None
+                    if depths is not None:
+                        body["queue_by_class"] = depths
+                        body["preempt_parked"] = \
+                            worker.server.preempt_parked
                     self._json(200 if healthy else 503, body)
                 else:
                     self._json(404, {"error": "unknown path"})
@@ -956,12 +993,19 @@ class LLMRouter:
                 # the budget on any retry or hedge)
                 deadline = reliability.Deadline.from_header(
                     self.headers.get(reliability.DEADLINE_HEADER))
+                # SLO class (ISSUE 17): relayed verbatim like the trace
+                # headers — every backend attempt (including the
+                # journal's failover resume on ANOTHER worker) carries
+                # the submitter's class
+                pri = self.headers.get(PRIORITY_HEADER)
 
                 def fwd_headers():
                     hdrs = list(rc.to_headers(ctx))
                     if deadline is not None:
                         hdrs.append((reliability.DEADLINE_HEADER,
                                      deadline.to_header()))
+                    if pri is not None:
+                        hdrs.append((PRIORITY_HEADER, pri))
                     return hdrs
 
                 with rc.activate(ctx), \
@@ -969,7 +1013,7 @@ class LLMRouter:
                                  tokens=len(body["prompt_ids"])):
                     if router._active:
                         router._route_failover(self, body, fwd_headers,
-                                               deadline)
+                                               deadline, priority=pri)
                     else:
                         router._route(self, body, fwd_headers)
 
@@ -1484,14 +1528,15 @@ class LLMRouter:
             self._note_hedge_outcome("decode", outcome)
         return reason
 
-    def _route_failover(self, handler, body, fwd_headers, deadline):
+    def _route_failover(self, handler, body, fwd_headers, deadline,
+                        priority=None):
         prompt_ids = body["prompt_ids"]
         try:
             mnt = int(body.get("max_new_tokens", 32))
         except (TypeError, ValueError):
             handler._json(400, {"error": "bad max_new_tokens"})
             return
-        ent = self._journal.add(prompt_ids, mnt)
+        ent = self._journal.add(prompt_ids, mnt, priority=priority)
         self._hedge.note_request()
         ins = self._instruments()
         if ins is not None and "journal" in ins:

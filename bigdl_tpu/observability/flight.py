@@ -45,7 +45,7 @@ EVENT_KINDS: Tuple[str, ...] = (
     "queue", "admit", "radix_hit", "radix_miss", "cow_fork", "park",
     "fetch", "chunk_charge", "rollback", "shed", "evict", "spill",
     "failover", "hedge", "drain_migrate", "scale_out", "scale_in",
-    "finish",
+    "preempt", "preempt_resume", "finish",
 )
 
 

@@ -59,6 +59,8 @@ SITES = (
     "federation.scrape",           # fleet collector member scrape (ISSUE 12)
     "fleet.scale",                 # autoscaler scale action (ISSUE 15)
     "worker.drain",                # per-chain drain migration (ISSUE 15)
+    "llm.preempt",                 # before a victim's KV chain is
+                                   # exported (ISSUE 17)
 )
 
 

@@ -94,6 +94,11 @@ _DEFAULTS: Dict[str, str] = {
     "bigdl.llm.prefill.chunk_tokens": "0",    # 0 = auto (4 pages)
     "bigdl.llm.prefill.chunk.wait": "30.0",   # budget-starved chunk ->
                                               # shed + clean rollback
+    # SLO-class priority scheduling (ISSUE 17): class-ordered admission
+    # + lossless preemption of in-flight decodes (KV exported, request
+    # re-queued as prompt+generated with its remaining budget). false =
+    # FIFO, structurally absent (no scheduler objects, no class series)
+    "bigdl.llm.priority.enabled": "false",
     # tiered KV cache (ISSUE 6): evicted chains spill to a pinned
     # host-RAM arena with async HBM<->host migration. Requires the
     # prefix cache; false = structurally absent (PR 5 engine exactly)

@@ -80,6 +80,11 @@ def pytest_configure(config):
         "fleet: elastic serving fleet tests (autoscaler, graceful "
         "drain with KV migration, provider lifecycle; select with "
         "-m fleet)")
+    config.addinivalue_line(
+        "markers",
+        "priority: SLO-class priority scheduling / lossless preemption "
+        "tests (class-ordered admission, preempt-resume parity; select "
+        "with -m priority)")
 
 
 @pytest.fixture(scope="session")

@@ -231,6 +231,23 @@ def _flatten_full(rec: dict) -> Dict[str, float]:
         val = fe.get(field)
         if isinstance(val, (int, float)) and not isinstance(val, bool):
             flat[f"fleet_elastic.{field}"] = float(val)
+    # ISSUE 17: the priority-storm chaos pass — the TTFT pair is the
+    # headline (interactive latency with the scheduler on vs the FIFO
+    # baseline of the SAME storm; the on-number creeping toward the
+    # off-number means preemption stopped buying anything), and the
+    # robustness invariants pin at their contract values (lost 0,
+    # parked 0, resumes == preemptions)
+    pb = ((((rec.get("extra") or {}).get("telemetry") or {})
+          .get("chaos_all") or {}).get("preempt") or {})
+    for field, key in (("interactive_ttft_on_ms", "ttft_on_ms"),
+                       ("interactive_ttft_off_ms", "ttft_off_ms"),
+                       ("preemptions", "preemptions"),
+                       ("resumes", "resumes"),
+                       ("lost_requests", "lost_requests"),
+                       ("parked", "parked")):
+        val = pb.get(field)
+        if isinstance(val, (int, float)) and not isinstance(val, bool):
+            flat[f"priority.{key}"] = float(val)
     # ISSUE 16: the live roofline gauges sampled while the serving
     # microbenches ran — MFU or achieved HBM bandwidth drifting down
     # between rounds is a dispatch-efficiency regression even when

@@ -12,7 +12,8 @@ harness exists to catch.
 
 Usage:
     python tools/chaos_check.py [--seed N] [--events K] [--full]
-        [--kvcache | --kvtier | --failover | --flight | --fleet | --all]
+        [--kvcache | --kvtier | --failover | --flight | --fleet
+         | --preempt | --all]
 
 Wired into ``bench.py``'s telemetry block as a smoke invocation and into
 pytest as ``-m chaos`` (kept out of tier-1 by the ``slow`` marker).
@@ -1175,6 +1176,286 @@ def run_fleet_chaos(seed: int = 0, smoke: bool = False) -> dict:
             conf.set("bigdl.llm.kvtier.sync", prev_sync)
 
 
+def run_preempt_chaos(seed: int = 0, smoke: bool = False) -> dict:
+    """ISSUE 17 acceptance: the priority storm. Sustained batch-class
+    decodes saturate every slot; an interactive burst arrives; the
+    SLO-class scheduler must preempt batch victims LOSSLESSLY — with
+    seeded ``llm.preempt`` faults aborting preemption attempts
+    mid-decision — and every request (preempted or not) must complete
+    with greedy output bit-identical to its unpreempted
+    ``model.generate`` golden, zero lost. The flight-recorder
+    ``preempt``/``preempt_resume`` events, the
+    ``bigdl_llm_preemptions_total`` counter, and the engine's plain-int
+    ledgers must reconcile EXACTLY, the KV ledger/arena must return to
+    idle, and interactive TTFT must be measurably better than the same
+    storm with the scheduler off (FIFO).
+
+    Also asserts the disabled-mode contract: with
+    ``bigdl.llm.priority.enabled`` off (the default) the engine builds
+    no scheduler objects, mints no priority metric series, and serves
+    the identical storm FIFO bit-identical — the class stamp is carried
+    but inert."""
+    import time as _time
+
+    import numpy as np
+
+    from bigdl_tpu import observability as obs
+    from bigdl_tpu import reliability as rel
+    from bigdl_tpu.llm.models.llama import LlamaConfig, LlamaForCausalLM
+    from bigdl_tpu.llm.serving import LLMServer
+    from bigdl_tpu.observability import flight
+    from bigdl_tpu.utils.conf import conf
+
+    GATE = "bigdl.llm.priority.enabled"
+    FLIGHT_GATE = "bigdl.observability.flight.enabled"
+    n_batch = 3 if smoke else 4
+    n_inter = 2 if smoke else 4
+    # the victim budget sets the FIFO baseline's slot-turnover time;
+    # the preempted path's TTFT is independent of it, so a long batch
+    # budget is what makes "measurably better" robust to CI jitter
+    batch_budget = 16
+    inter_budget = 3
+    num_pages = 32
+
+    model = LlamaForCausalLM.from_config(LlamaConfig.tiny(), seed=0,
+                                         max_cache_len=128)
+    rs = np.random.RandomState(seed)
+    shared = rs.randint(0, 250, 8).astype(np.int32)
+    batch_prompts = [np.concatenate(
+        [shared, rs.randint(0, 250, 6 + 2 * (j % 3)).astype(np.int32)])
+        for j in range(n_batch)]
+    inter_prompts = [rs.randint(0, 250, 6 + j % 4).astype(np.int32)
+                     for j in range(n_inter)]
+    prompts = batch_prompts + inter_prompts
+    budgets = [batch_budget] * n_batch + [inter_budget] * n_inter
+    classes = ["batch"] * n_batch + ["interactive"] * n_inter
+    want = [list(map(int,
+                     model.generate(p[None], max_new_tokens=b)
+                     [0, len(p):]))
+            for p, b in zip(prompts, budgets)]
+
+    def storm(priority: bool):
+        """One storm: saturate the 2 slots with batch decodes, then
+        burst the interactive prompts. Returns (outputs-in-submit-
+        order, interactive TTFTs, server) — the server already
+        stopped, so its ledgers are post-drain."""
+        srv = LLMServer(model, max_batch=2, max_seq_len=64, page_size=8,
+                        num_pages=num_pages, kvcache=True, kvtier=True,
+                        host_pages=64, priority=priority).start()
+        try:
+            b_reqs = [srv.submit(p, max_new_tokens=batch_budget,
+                                 priority="BATCH")     # case-insensitive
+                      for p in batch_prompts]
+            # the burst must land while batch decodes hold every slot —
+            # wait for first tokens, not just admission
+            deadline = _time.time() + 120.0
+            while _time.time() < deadline and \
+                    sum(1 for r in b_reqs if len(r.tokens) >= 1) < 2:
+                _time.sleep(0.005)
+            i_reqs = [srv.submit(p, max_new_tokens=inter_budget,
+                                 priority="interactive")
+                      for p in inter_prompts]
+            outs = [list(map(int, r.get(timeout=600)))
+                    for r in b_reqs + i_reqs]
+            ttfts = [r.t_first_token - r.t_submit for r in i_reqs
+                     if r.t_first_token]
+        finally:
+            srv.stop()
+        return outs, ttfts, srv
+
+    with conf._lock:
+        prev_sync = conf._set_layer.get("bigdl.llm.kvtier.sync")
+        prev_flight = conf._set_layer.get(FLIGHT_GATE)
+    conf.set("bigdl.llm.kvtier.sync", "true")   # inline migrations:
+    was_enabled = rel.enabled()                 # deterministic spills
+    if not was_enabled:
+        rel.enable()
+    try:
+        # --- part 1: disabled mode (the conf default) is structurally
+        # absent — no scheduler objects, no priority series, and the
+        # storm serves FIFO bit-identical with the class stamp inert
+        lines_before = (set(obs.render().splitlines())
+                        if obs.enabled() else set())
+        srv0 = LLMServer(model, max_batch=2, max_seq_len=64, page_size=8,
+                         num_pages=num_pages, kvcache=True).start()
+        try:
+            assert srv0._sched is None and srv0._parked is None, \
+                f"{GATE} off (the default) built scheduler state"
+            reqs0 = [srv0.submit(p, max_new_tokens=b, priority=c)
+                     for p, b, c in zip(prompts, budgets, classes)]
+            outs0 = [list(map(int, r.get(timeout=600))) for r in reqs0]
+            assert srv0.preemptions_total == 0 \
+                and srv0.preempt_parked == 0
+            assert srv0.class_depths() is None, \
+                f"{GATE} off still reports class depths"
+        finally:
+            srv0.stop()
+        if outs0 != want:
+            raise AssertionError(
+                f"priority-off storm is not FIFO bit-identical: "
+                f"{outs0} vs {want}")
+        if obs.enabled():
+            grown = "\n".join(set(obs.render().splitlines())
+                              - lines_before)
+            for name in ("bigdl_llm_preemptions_total",
+                         "bigdl_llm_queue_depth_class",
+                         "bigdl_llm_preempt_parked"):
+                assert name not in grown, \
+                    f"{GATE} off grew metric series {name}"
+
+        # warm the resume shapes: a second pass over every prompt hits
+        # the radix chains the first pass indexed, compiling the
+        # partial-prefill suffix programs preempt resumes re-enter
+        # (the compiled-step cache is shared across engine instances)
+        srv_w = LLMServer(model, max_batch=2, max_seq_len=64,
+                          page_size=8, num_pages=num_pages,
+                          kvcache=True).start()
+        try:
+            for p, b in zip(prompts, budgets):
+                srv_w.submit(p, max_new_tokens=b).get(timeout=600)
+                srv_w.submit(p, max_new_tokens=b).get(timeout=600)
+        finally:
+            srv_w.stop()
+
+        # --- part 2: the FIFO reference storm (scheduler off) under
+        # the same step-delay plan — the TTFT baseline the scheduler
+        # must beat. llm.step delays stretch every decode pass so the
+        # batch saturation genuinely blocks the burst.
+        plan_off = rel.FaultPlan(seed=seed)
+        plan_off.add("llm.step", "delay", times=None, delay=0.02)
+        rel.set_plan(plan_off)
+        try:
+            outs_off, ttft_off, _ = storm(priority=False)
+        finally:
+            rel.set_plan(None)
+        if outs_off != want:
+            raise AssertionError(
+                f"FIFO reference storm diverged: {outs_off} vs {want}")
+
+        # --- part 3: the priority storm, scheduler on, flight recorder
+        # on, seeded llm.preempt faults aborting preemption attempts
+        # (the site fires before any state mutates, so an aborted
+        # attempt must leave the victim decoding untouched and the
+        # next engine pass retries the preemption)
+        conf.set(FLIGHT_GATE, "true")
+        r = flight.ring()
+        evs = r.events() if r is not None else []
+        t_before = {
+            "preempt": sum(1 for e in evs if e["kind"] == "preempt"),
+            "resume": sum(1 for e in evs
+                          if e["kind"] == "preempt_resume"),
+            "dropped": r.dropped if r is not None else 0,
+        }
+        c_before = _counter_total("bigdl_llm_preemptions_total")
+        plan = rel.FaultPlan(seed=seed)
+        plan.add("llm.preempt", "raise", times=1, after=0)
+        plan.add("llm.preempt", "delay", times=None, delay=0.005)
+        plan.add("llm.step", "delay", times=None, delay=0.02)
+        rel.set_plan(plan)
+        try:
+            outs_on, ttft_on, srv = storm(priority=True)
+        finally:
+            rel.set_plan(None)
+        if outs_on != want:
+            raise AssertionError(
+                f"priority storm diverged under preemption "
+                f"(fired: {[f'{s}:{a}' for s, a in plan.fired]}): "
+                f"{outs_on} vs {want}")
+        if srv.preemptions_total == 0:
+            raise AssertionError(
+                "priority storm completed without a single preemption "
+                "— the burst never displaced a batch decode")
+        if not any(s == "llm.preempt" for s, _ in plan.fired):
+            raise AssertionError(
+                "priority storm armed but no llm.preempt fault fired")
+        if srv.preempt_resumes_total != srv.preemptions_total:
+            raise AssertionError(
+                f"{srv.preemptions_total} preemptions but "
+                f"{srv.preempt_resumes_total} resumes — a preempted "
+                "request never re-admitted")
+        # ledger/arena idle: every page charge returned at the drain,
+        # every parked handoff blob consumed by its resume
+        if srv._budget_avail != num_pages - 1:
+            raise AssertionError(
+                f"priority storm ledger leak: idle budget "
+                f"{srv._budget_avail} vs pool {num_pages - 1}")
+        if srv.preempt_parked != 0:
+            raise AssertionError(
+                f"{srv.preempt_parked} exported chains still parked "
+                "after every request completed")
+        if srv._tier is not None and srv._tier.migrator.inflight():
+            raise AssertionError("arena migrations still in flight")
+        # reconciliation: flight events == counter == plain-int ledger
+        r = flight.ring()
+        evs = r.events() if r is not None else []
+        t_after = {
+            "preempt": sum(1 for e in evs if e["kind"] == "preempt"),
+            "resume": sum(1 for e in evs
+                          if e["kind"] == "preempt_resume"),
+            "dropped": r.dropped if r is not None else 0,
+        }
+        if t_after["dropped"] != t_before["dropped"]:
+            raise AssertionError(
+                "flight ring dropped events mid-check; raise "
+                "bigdl.observability.flight.capacity")
+        ev_preempt = t_after["preempt"] - t_before["preempt"]
+        ev_resume = t_after["resume"] - t_before["resume"]
+        if ev_preempt != srv.preemptions_total:
+            raise AssertionError(
+                f"{ev_preempt} flight preempt events vs "
+                f"{srv.preemptions_total} ledger preemptions")
+        if ev_resume != srv.preempt_resumes_total:
+            raise AssertionError(
+                f"{ev_resume} flight preempt_resume events vs "
+                f"{srv.preempt_resumes_total} ledger resumes")
+        counters_reconciled: object = "obs disabled: ledger-only"
+        if c_before is not None:
+            c_delta = _counter_total("bigdl_llm_preemptions_total") \
+                - c_before
+            if c_delta != srv.preemptions_total:
+                raise AssertionError(
+                    f"bigdl_llm_preemptions_total moved {c_delta} for "
+                    f"{srv.preemptions_total} ledger preemptions")
+            counters_reconciled = True
+        # the headline: interactive TTFT measurably better than FIFO
+        worst_on = max(ttft_on) if ttft_on else None
+        worst_off = max(ttft_off) if ttft_off else None
+        if worst_on is None or worst_off is None:
+            raise AssertionError("a storm stamped no interactive TTFT")
+        if worst_on >= worst_off:
+            raise AssertionError(
+                f"scheduler-on interactive TTFT {worst_on * 1e3:.1f}ms "
+                f"is no better than FIFO {worst_off * 1e3:.1f}ms — "
+                "preemption bought nothing")
+        return {
+            "seed": seed,
+            "requests": len(prompts),
+            "events_fired": [f"{s}:{a}" for s, a in plan.fired],
+            "preemptions": srv.preemptions_total,
+            "resumes": srv.preempt_resumes_total,
+            "flight_events": {"preempt": ev_preempt,
+                              "resume": ev_resume},
+            "counters_reconciled": counters_reconciled,
+            "idle_budget": srv._budget_avail,
+            "parked": srv.preempt_parked,
+            "interactive_ttft_on_ms": round(worst_on * 1e3, 3),
+            "interactive_ttft_off_ms": round(worst_off * 1e3, 3),
+            "lost_requests": 0,
+            "match": True,
+        }
+    finally:
+        if not was_enabled:
+            rel.disable()
+        if prev_flight is None:
+            conf.unset(FLIGHT_GATE)
+        else:
+            conf.set(FLIGHT_GATE, prev_flight)
+        if prev_sync is None:
+            conf.unset("bigdl.llm.kvtier.sync")
+        else:
+            conf.set("bigdl.llm.kvtier.sync", prev_sync)
+
+
 class ElasticUnsupported(RuntimeError):
     """This jax build cannot do loopback multi-process distributed
     init — the elastic pass is skipped, mirroring the graceful skip in
@@ -1469,6 +1750,8 @@ def run_all_chaos(seed: int = 0) -> dict:
                              seed=seed, smoke=True)),
                          ("fleet", lambda: run_fleet_chaos(
                              seed=seed, smoke=True)),
+                         ("preempt", lambda: run_preempt_chaos(
+                             seed=seed, smoke=True)),
                          ("elastic", lambda: run_elastic_chaos(
                              seed=seed, smoke=True))):
             try:
@@ -1534,6 +1817,14 @@ def main():
                          "outputs bit-identical to a clean run, and "
                          "drained workers' warm KV chains serving "
                          "prefix hits on survivors (ISSUE 15)")
+    ap.add_argument("--preempt", action="store_true",
+                    help="run the priority-storm pass: an interactive "
+                         "burst over saturated batch-class decodes "
+                         "with seeded llm.preempt faults — every "
+                         "preempted request completes bit-identical, "
+                         "zero lost, flight events/counters/ledgers "
+                         "reconcile exactly, and interactive TTFT "
+                         "beats the scheduler-off baseline (ISSUE 17)")
     ap.add_argument("--elastic", action="store_true",
                     help="run the elastic-training pass: a seeded kill "
                          "of 1-of-2 DistriOptimizer processes mid-"
@@ -1542,9 +1833,9 @@ def main():
                          "run (ISSUE 10)")
     ap.add_argument("--all", action="store_true",
                     help="run every chaos suite (train, kvcache, "
-                         "kvtier, mixed, failover, fleet, elastic) and "
-                         "report one record per pass (the bench.py "
-                         "chaos_all block)")
+                         "kvtier, mixed, failover, fleet, preempt, "
+                         "elastic) and report one record per pass "
+                         "(the bench.py chaos_all block)")
     ap.add_argument("--cpu", action="store_true",
                     help="force the CPU backend (sitecustomize pins the "
                          "axon TPU platform; env vars are ineffective)")
@@ -1560,6 +1851,8 @@ def main():
         return
     if args.elastic:
         out = run_elastic_chaos(seed=args.seed)
+    elif args.preempt:
+        out = run_preempt_chaos(seed=args.seed)
     elif args.flight:
         out = run_flight_chaos(seed=args.seed)
     elif args.fleet:

@@ -52,6 +52,44 @@ PROMPT_LENS = (6, 10, 16, 24, 40)
 #: mixed output budgets paired with them
 OUTPUT_LENS = (2, 4, 6, 8)
 
+#: SLO-class request header + known classes (ISSUE 17) — kept literal
+#: here so the CLI works without importing the serving stack
+PRIORITY_HEADER = "X-BigDL-Priority"
+PRIORITY_CLASSES = ("interactive", "standard", "batch")
+
+
+def parse_priority_mix(spec: str) -> List[Tuple[str, int]]:
+    """``"interactive:1,standard:1,batch:2"`` → ``[(class, weight)]``.
+    Weights are relative request counts in the deterministic
+    round-robin pattern :func:`assign_classes` cycles through."""
+    out: List[Tuple[str, int]] = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, w = part.partition(":")
+        weight = int(w) if w else 1
+        if weight < 0:
+            raise ValueError(f"negative weight in --priority-mix: {part}")
+        cls = name.strip().lower()
+        if cls not in PRIORITY_CLASSES:
+            # the server degrades unknown classes to "standard", but a
+            # typo'd mix spec should fail fast, not skew the soak
+            raise ValueError(f"unknown class in --priority-mix: {part} "
+                             f"(known: {', '.join(PRIORITY_CLASSES)})")
+        out.append((cls, weight))
+    if not out or all(w == 0 for _, w in out):
+        raise ValueError(f"empty --priority-mix spec: {spec!r}")
+    return out
+
+
+def assign_classes(n: int, mix: List[Tuple[str, int]]) -> List[str]:
+    """Deterministic per-request class list: the weighted pattern
+    (each class repeated ``weight`` times) cycled over ``n`` requests,
+    so reruns of a seeded soak see identical class placement."""
+    pattern = [cls for cls, w in mix for _ in range(w)]
+    return [pattern[i % len(pattern)] for i in range(n)]
+
 
 def gen_prompts(n: int, seed: int = 0, vocab: int = 250,
                 shared_prefix: int = 0) -> List[Any]:
@@ -72,12 +110,15 @@ def gen_prompts(n: int, seed: int = 0, vocab: int = 250,
     return out
 
 
-def _post(addr: Tuple[str, int], body: dict, timeout: float):
+def _post(addr: Tuple[str, int], body: dict, timeout: float,
+          headers: Optional[dict] = None):
     import http.client
     conn = http.client.HTTPConnection(addr[0], addr[1], timeout=timeout)
     try:
-        conn.request("POST", "/worker_generate", json.dumps(body),
-                     {"Content-Type": "application/json"})
+        hdrs = {"Content-Type": "application/json"}
+        if headers:
+            hdrs.update(headers)
+        conn.request("POST", "/worker_generate", json.dumps(body), hdrs)
         resp = conn.getresponse()
         data = resp.read()
         try:
@@ -89,14 +130,79 @@ def _post(addr: Tuple[str, int], body: dict, timeout: float):
         conn.close()
 
 
+def _post_stream(addr: Tuple[str, int], body: dict, timeout: float,
+                 headers: Optional[dict] = None):
+    """``/worker_generate_stream`` client leg: returns ``(status,
+    final_payload, msg, ttft_s, itl_gaps_s)``. TTFT is request-send to
+    the first token-bearing chunk; ITL gaps are wall time between
+    consecutive token-bearing chunks (a chunk may batch tokens, so this
+    is the client-visible gap, the same thing a streaming UI stalls
+    on)."""
+    import http.client
+    conn = http.client.HTTPConnection(addr[0], addr[1], timeout=timeout)
+    try:
+        hdrs = {"Content-Type": "application/json"}
+        if headers:
+            hdrs.update(headers)
+        t_send = time.perf_counter()
+        conn.request("POST", "/worker_generate_stream",
+                     json.dumps(body), hdrs)
+        resp = conn.getresponse()
+        if resp.status != 200:
+            data = resp.read()
+            try:
+                parsed = json.loads(data.decode())
+            except ValueError:
+                parsed = {"error": data.decode(errors="replace")[:200]}
+            return resp.status, parsed, resp.msg, None, []
+        ttft = None
+        gaps: List[float] = []
+        t_prev = None
+        seen = 0
+        last: dict = {}
+        while True:
+            line = resp.readline()
+            if not line:
+                break
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line.decode())
+            except ValueError:
+                continue
+            now = time.perf_counter()
+            ntok = len(obj.get("output_ids", []))
+            if ntok > seen:
+                if ttft is None:
+                    ttft = now - t_send
+                elif t_prev is not None:
+                    gaps.append(now - t_prev)
+                t_prev = now
+                seen = ntok
+            last = obj
+            if obj.get("done"):
+                break
+        return 200, last, resp.msg, ttft, gaps
+    finally:
+        conn.close()
+
+
 def run_load(addr: Tuple[str, int], prompts: Sequence[Any],
              max_new_tokens: Any = 4, qps: float = 20.0,
              concurrency: int = 4,
              max_retries: int = 20, retry_cap_s: float = 0.25,
-             request_timeout: float = 120.0) -> Dict[str, Any]:
+             request_timeout: float = 120.0,
+             priorities: Optional[Sequence[str]] = None,
+             stream: bool = False) -> Dict[str, Any]:
     """Drive ``prompts`` through ``addr`` at ``qps`` scheduled arrivals.
     ``max_new_tokens`` may be one int or a per-prompt sequence of the
-    same length (the mixed-output part of the soak). Returns the result
+    same length (the mixed-output part of the soak). ``priorities``
+    (per-prompt SLO-class names, ISSUE 17) are sent as the
+    ``X-BigDL-Priority`` header and split every counter/sketch per
+    class under the ``per_class`` result key. ``stream=True`` uses the
+    streaming endpoint so the per-class sketches include client-visible
+    TTFT and ITL, not just completion latency. Returns the result
     record described in the module docstring; ``outputs[i]`` is request
     ``i``'s token list (None when lost — the zero-lost assertion is
     ``lost == 0``)."""
@@ -110,11 +216,22 @@ def run_load(addr: Tuple[str, int], prompts: Sequence[Any],
         budgets = [int(v) for v in max_new_tokens]
     else:
         budgets = [int(max_new_tokens)] * n
+    if priorities is not None and len(priorities) != n:
+        raise ValueError(
+            f"priorities has {len(priorities)} entries for {n} prompts")
     outputs: List[Optional[List[int]]] = [None] * n
     errors: List[dict] = []
     sketch = QuantileSketch()
     lock = threading.Lock()
     counters = {"ok": 0, "lost": 0, "retries_503": 0}
+    per_class: Dict[str, Dict[str, Any]] = {}
+    if priorities is not None:
+        for cls in priorities:
+            per_class.setdefault(cls, {
+                "sent": 0, "ok": 0, "lost": 0, "retries_503": 0,
+                "latency": QuantileSketch(), "ttft": QuantileSketch(),
+                "itl": QuantileSketch()})
+            per_class[cls]["sent"] += 1
     next_idx = [0]
     t0 = time.perf_counter()
 
@@ -137,23 +254,47 @@ def run_load(addr: Tuple[str, int], prompts: Sequence[Any],
                 return
             body = {"prompt_ids": [int(t) for t in prompts[i]],
                     "max_new_tokens": budgets[i]}
+            cls = priorities[i] if priorities is not None else None
+            req_headers = {PRIORITY_HEADER: cls} if cls else None
             t_req = time.perf_counter()
             last_err = "retries exhausted"
             done = False
             for _attempt in range(max_retries + 1):
+                ttft = None
+                gaps: List[float] = []
                 try:
-                    status, parsed, hdrs = _post(addr, body,
-                                                 request_timeout)
+                    if stream:
+                        status, parsed, hdrs, ttft, gaps = \
+                            _post_stream(addr, body, request_timeout,
+                                         req_headers)
+                    else:
+                        status, parsed, hdrs = _post(
+                            addr, body, request_timeout, req_headers)
                 except Exception as e:  # noqa: BLE001 — retriable
                     last_err = f"transport: {e}"
                     time.sleep(min(0.05, retry_cap_s))
                     continue
+                if status == 200 and parsed.get("error") is not None:
+                    # terminal stream chunk carried the engine's error
+                    # (retriable) — same treatment as a transport fault
+                    last_err = f"stream: {parsed['error']}"
+                    time.sleep(min(0.05, retry_cap_s))
+                    continue
                 if status == 200:
+                    lat = time.perf_counter() - t_req
                     with lock:
                         outputs[i] = [int(t)
                                       for t in parsed["output_ids"]]
                         counters["ok"] += 1
-                        sketch.observe(time.perf_counter() - t_req)
+                        sketch.observe(lat)
+                        if cls is not None:
+                            rec = per_class[cls]
+                            rec["ok"] += 1
+                            rec["latency"].observe(lat)
+                            if ttft is not None:
+                                rec["ttft"].observe(ttft)
+                            for g in gaps:
+                                rec["itl"].observe(g)
                     done = True
                     break
                 if status == 503:
@@ -162,6 +303,8 @@ def run_load(addr: Tuple[str, int], prompts: Sequence[Any],
                     # Shed-then-served is latency, never loss.
                     with lock:
                         counters["retries_503"] += 1
+                        if cls is not None:
+                            per_class[cls]["retries_503"] += 1
                     try:
                         ra = float(hdrs.get("Retry-After") or 0.05)
                     except (TypeError, ValueError):
@@ -174,6 +317,8 @@ def run_load(addr: Tuple[str, int], prompts: Sequence[Any],
             if not done:
                 with lock:
                     counters["lost"] += 1
+                    if cls is not None:
+                        per_class[cls]["lost"] += 1
                     errors.append({"request": i, "error": last_err})
 
     threads = [threading.Thread(target=client,
@@ -185,7 +330,7 @@ def run_load(addr: Tuple[str, int], prompts: Sequence[Any],
         t.join()
     wall = time.perf_counter() - t0
     qs = sketch.quantiles((0.5, 0.95, 0.99))
-    return {
+    out = {
         "sent": n,
         "ok": counters["ok"],
         "lost": counters["lost"],
@@ -197,6 +342,25 @@ def run_load(addr: Tuple[str, int], prompts: Sequence[Any],
         "latency_p99_ms": _ms(qs.get(0.99)),
         "outputs": outputs,
         "errors": errors[:16],
+    }
+    if priorities is not None:
+        out["per_class"] = {
+            cls: _class_report(rec) for cls, rec in per_class.items()}
+    return out
+
+
+def _class_report(rec: Dict[str, Any]) -> Dict[str, Any]:
+    lat = rec["latency"].quantiles((0.5, 0.99))
+    ttft = rec["ttft"].quantiles((0.5, 0.99))
+    itl = rec["itl"].quantiles((0.99,))
+    return {
+        "sent": rec["sent"], "ok": rec["ok"], "lost": rec["lost"],
+        "retries_503": rec["retries_503"],
+        "latency_p50_ms": _ms(lat.get(0.5)),
+        "latency_p99_ms": _ms(lat.get(0.99)),
+        "ttft_p50_ms": _ms(ttft.get(0.5)),
+        "ttft_p99_ms": _ms(ttft.get(0.99)),
+        "itl_p99_ms": _ms(itl.get(0.99)),
     }
 
 
@@ -258,14 +422,19 @@ def sketch_window(before: Optional[dict], after: Optional[dict],
 
 
 def run_fleet_soak(n_requests: int = 8, qps: float = 100.0,
-                   seed: int = 0) -> Dict[str, Any]:
+                   seed: int = 0,
+                   priority_mix: Optional[str] = None) -> Dict[str, Any]:
     """The ``fleet_elastic`` bench telemetry block (ISSUE 15): a
     fault-free soak of the elastic fleet — spike against one worker,
     autoscaler scale-out, graceful drain-and-scale-in back to the
     floor — reporting client-visible p99 TTFT / engine p99 ITL for
     exactly this soak's requests (SLO sketch windows), requests lost
-    (must be 0), and the scale-event counts. The chaos variant with
-    kills lives in ``tools/chaos_check.py --fleet``."""
+    (must be 0), and the scale-event counts. ``priority_mix`` (an
+    ISSUE 17 ``parse_priority_mix`` spec) turns on the SLO-class
+    scheduler in the pool's workers, stamps each request with its
+    class, and adds a ``per_class`` block — the mixed-class version of
+    the same soak. The chaos variant with kills lives in
+    ``tools/chaos_check.py --fleet``."""
     import time as _time
 
     from bigdl_tpu.llm.fleet import LocalWorkerProvider
@@ -276,14 +445,18 @@ def run_fleet_soak(n_requests: int = 8, qps: float = 100.0,
     model = LlamaForCausalLM.from_config(LlamaConfig.tiny(), seed=0,
                                          max_cache_len=128)
     prompts = gen_prompts(n_requests, seed=seed, shared_prefix=16)
+    classes = (assign_classes(n_requests, parse_priority_mix(
+        priority_mix)) if priority_mix else None)
     with conf._lock:
         prev_sync = conf._set_layer.get("bigdl.llm.kvtier.sync")
     conf.set("bigdl.llm.kvtier.sync", "true")
-    provider = LocalWorkerProvider(
-        model, server_kwargs=dict(
-            max_batch=2, max_seq_len=64, page_size=8, num_pages=24,
-            kvcache=True, kvtier=True, host_pages=64, max_queue=8,
-            slo=True))
+    server_kwargs = dict(
+        max_batch=2, max_seq_len=64, page_size=8, num_pages=24,
+        kvcache=True, kvtier=True, host_pages=64, max_queue=8,
+        slo=True)
+    if classes is not None:
+        server_kwargs["priority"] = True
+    provider = LocalWorkerProvider(model, server_kwargs=server_kwargs)
     router = None
     ttft_before = registry_sketch_snapshot("bigdl_router_ttft_seconds")
     itl_before = registry_sketch_snapshot("bigdl_llm_itl_seconds")
@@ -308,7 +481,8 @@ def run_fleet_soak(n_requests: int = 8, qps: float = 100.0,
         def _run():
             holder["res"] = run_load(router.address, prompts,
                                      max_new_tokens=4, qps=qps,
-                                     concurrency=4)
+                                     concurrency=4,
+                                     priorities=classes)
         t = _threading.Thread(target=_run, daemon=True)
         t.start()
         deadline = _time.time() + 60.0
@@ -326,7 +500,7 @@ def run_fleet_soak(n_requests: int = 8, qps: float = 100.0,
         itl = sketch_window(
             itl_before,
             registry_sketch_snapshot("bigdl_llm_itl_seconds"))
-        return {
+        out = {
             "requests": n_requests,
             "qps_target": qps,
             "requests_lost": int(res.get("lost", 0)),
@@ -339,6 +513,9 @@ def run_fleet_soak(n_requests: int = 8, qps: float = 100.0,
             "ttft_p99_ms": _ms(ttft.get(0.99)),
             "itl_p99_ms": _ms(itl.get(0.99)),
         }
+        if "per_class" in res:
+            out["per_class"] = res["per_class"]
+        return out
     finally:
         if router is not None:
             router.stop()
@@ -361,13 +538,27 @@ def main():
     ap.add_argument("--shared-prefix", type=int, default=0,
                     help="tokens of seeded shared prefix across all "
                          "prompts (exercises the prefix cache)")
+    ap.add_argument("--priority-mix", default=None,
+                    help="mixed-class soak (ISSUE 17): weighted SLO "
+                         "classes, e.g. 'interactive:1,standard:1,"
+                         "batch:2' — stamps X-BigDL-Priority and "
+                         "reports per-class TTFT/ITL sketches")
+    ap.add_argument("--no-stream", action="store_true",
+                    help="with --priority-mix, use the blocking "
+                         "endpoint (per-class TTFT/ITL unavailable; "
+                         "needed when the target is a router)")
     args = ap.parse_args()
     host, port = args.url.rsplit(":", 1)
     prompts = gen_prompts(args.requests, seed=args.seed,
                           shared_prefix=args.shared_prefix)
+    classes = (assign_classes(args.requests, parse_priority_mix(
+        args.priority_mix)) if args.priority_mix else None)
     out = run_load((host, int(port)), prompts,
                    max_new_tokens=args.max_new, qps=args.qps,
-                   concurrency=args.concurrency)
+                   concurrency=args.concurrency,
+                   priorities=classes,
+                   stream=bool(classes is not None
+                               and not args.no_stream))
     out.pop("outputs")          # token lists are for parity asserts,
     print(json.dumps(out, indent=1))   # not for the CLI report
     if out["lost"]:
