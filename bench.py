@@ -1042,6 +1042,17 @@ def _telemetry_block() -> dict:
     except Exception as e:
         out["fleet_elastic"] = {"error": repr(e)}
     try:
+        # ISSUE 18: the time-series plane — windowed-store sampling
+        # cost over the live post-bench registry (every series the
+        # benches above created, so the number tracks real cardinality)
+        # plus one default-rule evaluation pass. bench_regress lifts
+        # ts.sample_overhead_us / alerts.transitions: overhead creeping
+        # up means snapshot cost regressed; transitions going nonzero
+        # means the bench round itself tripped an SLO page
+        out["alerts"] = _alerts_block()
+    except Exception as e:
+        out["alerts"] = {"error": repr(e)}
+    try:
         # ISSUE 16: the live roofline — per-dispatch wall time sampled
         # while the serving microbenches above ran, joined with the
         # XLA cost analyses into achieved GB/s, MFU and bandwidth
@@ -1058,6 +1069,53 @@ def _telemetry_block() -> dict:
         else:
             _conf.set("bigdl.observability.flight.enabled", _flight_prior)
     return out
+
+
+def _alerts_block() -> dict:
+    """ISSUE 18 micro-measurement: periodic-sampler overhead against
+    the full live registry and one alert-engine pass over the built-in
+    burn-rate rules. The gate is raised only for the measurement and
+    restored on the way out (the plane stays default-off elsewhere)."""
+    from bigdl_tpu.observability import alerts as _alerts
+    from bigdl_tpu.observability import timeseries as _ts
+    from bigdl_tpu.utils.conf import conf as _conf
+    keys = ("bigdl.observability.timeseries.enabled",
+            "bigdl.observability.timeseries.interval")
+    prior = {k: _conf.get(k) for k in keys}
+    _conf.set("bigdl.observability.timeseries.enabled", "true")
+    # park the background thread: the synchronous samples below are the
+    # measurement, a concurrent wall-clock tick would just add noise
+    _conf.set("bigdl.observability.timeseries.interval", "3600")
+    try:
+        st = _ts.acquire()
+        if st is None:
+            return {"error": "store unavailable (observability off?)"}
+        overheads = []
+        for _ in range(8):
+            st.sample_now()
+            overheads.append(st.last_overhead_us)
+        eng = _alerts.engine()
+        if eng is not None:
+            eng.evaluate(st.clock())
+        status = st.status()
+        overheads.sort()
+        return {
+            "sample_overhead_us": round(
+                overheads[len(overheads) // 2], 1),
+            "sample_overhead_max_us": round(overheads[-1], 1),
+            "samples": status["samples"],
+            "rules": len(eng.rules) if eng is not None else 0,
+            "evaluations": eng.evaluations if eng is not None else 0,
+            "transitions": eng.transitions if eng is not None else 0,
+            "firing": eng.firing() if eng is not None else [],
+        }
+    finally:
+        _ts.release()
+        for k in keys:
+            if prior[k] is None:
+                _conf.unset(k)
+            else:
+                _conf.set(k, prior[k])
 
 
 def _static_analysis_block() -> dict:

@@ -49,11 +49,14 @@ from .registrydrift import load_docs
 
 #: routes the shared debug_endpoint helpers serve (each with its own
 #: gate 404 inside the helper): tracing.debug_endpoint for the trace
-#: pair, flight.debug_endpoint for the flight/explain pair — a handler
-#: calling either helper serves all four (unowned paths return None and
-#: fall through to the next helper / elif chain)
+#: pair, flight.debug_endpoint for the flight/explain pair,
+#: timeseries.debug_endpoint for the windowed query/timeline pair and
+#: alerts.debug_endpoint for the rule table — a handler calling any of
+#: them serves the whole set (unowned paths return None and fall
+#: through to the next helper / elif chain)
 DEBUG_HELPER_ROUTES = ("/debug/traces", "/debug/trace/*",
-                       "/debug/flight", "/debug/explain/*")
+                       "/debug/flight", "/debug/explain/*",
+                       "/metrics/query", "/fleet/timeline", "/alerts")
 
 #: client callables whose string args are request paths
 _CLIENT_FUNCS = frozenset({"request", "_call", "post", "_post", "_get",

@@ -165,6 +165,8 @@ CONF_KEYS.update({
         "comma-separated ints; '' = auto",
     "bigdl.num.processes":
         "multi-process world size ('' = single process)",
+    "bigdl.observability.alerts.rules":
+        "JSON rule list replacing the built-in burn-rate alert set",
     "bigdl.observability.enabled":
         "metrics + trace spans",
     "bigdl.observability.exemplars":
@@ -179,6 +181,15 @@ CONF_KEYS.update({
         "flight recorder + explain endpoints + roofline gauges; false = absent",
     "bigdl.observability.sketch.alpha":
         "quantile-sketch relative-error bound (merge requires equal alpha)",
+    "bigdl.observability.timeseries.enabled":
+        "windowed metric store + alert engine + timeline endpoints; "
+        "false = absent",
+    "bigdl.observability.timeseries.interval":
+        "registry-snapshot sampling cadence (seconds)",
+    "bigdl.observability.timeseries.retention":
+        "ring horizon (seconds); older samples evicted",
+    "bigdl.observability.timeseries.slo.window":
+        "window (seconds) backing the store-fed SLO burn gauges",
     "bigdl.observability.trace.capacity":
         "span ring entries",
     "bigdl.optimizer.max.retry":
@@ -197,6 +208,9 @@ CONF_KEYS.update({
         "per-request TTFT/ITL SLO accounting; false = no sketch/slo series",
     "bigdl.slo.itl_ms":
         "inter-token-latency objective: worst gap per request",
+    "bigdl.slo.objective":
+        "availability objective; alert burn = violation_ratio / "
+        "(1 - objective)",
     "bigdl.slo.ttft_ms":
         "time-to-first-token objective (admission to first token)",
     "bigdl.slo.window":
@@ -208,6 +222,12 @@ CONF_KEYS.update({
 })
 
 METRICS.update({
+    "bigdl_alerts_firing":
+        "Alert rules currently in the firing state",
+    "bigdl_alerts_recorded":
+        "Recording-rule outputs, one series per rule",
+    "bigdl_alerts_transitions_total":
+        "Alert state-machine transitions by rule and new state",
     "bigdl_build_info":
         "Constant 1; the build identity lives in the labels",
     "bigdl_cluster_serving_batch_size":
@@ -386,6 +406,10 @@ METRICS.update({
         "Finished requests classified against the bigdl.slo.* thresholds",
     "bigdl_summary_scalar":
         "Last value of each Train/ValidationSummary scalar tag",
+    "bigdl_timeseries_sample_overhead_us":
+        "Host microseconds the last time-series sample cost",
+    "bigdl_timeseries_samples_total":
+        "Registry snapshots taken into the time-series ring",
     "bigdl_train_compute_seconds_total":
         "Cumulative host time spent dispatching the compiled step",
     "bigdl_train_data_wait_seconds_total":
@@ -579,6 +603,11 @@ FEATURE_GATES.update({
         "package": "bigdl_tpu/observability/flight.py",
         "desc": "decision-event ring + explain endpoints + live "
                 "roofline gauges (utilization.py shares the gate)"},
+    "bigdl.observability.timeseries.enabled": {
+        "package": "bigdl_tpu/observability/timeseries.py",
+        "desc": "windowed metric store + query/timeline endpoints "
+                "(alerts.py shares the gate: the engine is only ever "
+                "built by timeseries.acquire())"},
     "bigdl.reliability.enabled": {
         "package": None,            # pervasive: runtime-gated via _state
         "desc": "fault sites + retry/deadline/breaker policies"},
@@ -588,6 +617,12 @@ FEATURE_GATES.update({
 })
 
 HTTP_ENDPOINTS.update({
+    "/alerts": {
+        "methods": ("GET",),
+        "gate": "bigdl.observability.timeseries.enabled",
+        "gate404": "helper",
+        "desc": "alert rule table + firing set (worker/router/elastic "
+                "supervisor)"},
     "/backends": {
         "methods": ("POST",), "gate": "bigdl.llm.failover.enabled",
         "desc": "live router pool membership (add/remove backends)"},
@@ -624,6 +659,11 @@ HTTP_ENDPOINTS.update({
     "/fleet/status": {
         "methods": ("GET",), "gate": "bigdl.observability.federation",
         "desc": "fleet collector member/staleness status"},
+    "/fleet/timeline": {
+        "methods": ("GET",),
+        "gate": "bigdl.observability.timeseries.enabled",
+        "gate404": "helper",
+        "desc": "per-member + merged windowed series for one metric"},
     "/healthz": {
         "methods": ("GET",),
         "desc": "liveness + checks (503 = drain/stall/restarting)"},
@@ -633,6 +673,12 @@ HTTP_ENDPOINTS.update({
     "/metrics.json": {
         "methods": ("GET",),
         "desc": "legacy JSON counters on ServingFrontend"},
+    "/metrics/query": {
+        "methods": ("GET",),
+        "gate": "bigdl.observability.timeseries.enabled",
+        "gate404": "helper",
+        "desc": "typed window query (?series=&window=&fn=) over the "
+                "time-series ring"},
     "/metrics/snapshot": {
         "methods": ("GET",), "gate": "bigdl.observability.federation",
         "desc": "full registry JSON for the fleet collector's merge"},
@@ -686,4 +732,7 @@ PYTEST_MARKERS.update({
         "fleet telemetry plane tests (sketches, federation, SLO accounting)",
     "slow":
         "excluded from the tier-1 gate (-m 'not slow')",
+    "timeseries":
+        "time-series plane tests (windowed store, alert engine, "
+        "timelines)",
 })

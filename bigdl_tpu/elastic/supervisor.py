@@ -334,7 +334,17 @@ class Supervisor:
                 self.wfile.write(body)
 
             def do_GET(self):
-                if self.path == "/elastic/status":
+                # time-series plane (ISSUE 18): windowed queries,
+                # fleet timelines and the alert table over the
+                # supervisor's collector cache; 404 arms included
+                from bigdl_tpu.observability import (alerts as _alerts,
+                                                     timeseries as _ts)
+                debug = _ts.debug_endpoint(self.path)
+                if debug is None:
+                    debug = _alerts.debug_endpoint(self.path)
+                if debug is not None:
+                    self._json(*debug)
+                elif self.path == "/elastic/status":
                     self._json(200, sup.status())
                 elif self.path == "/healthz":
                     ok = sup.sweep()
@@ -387,6 +397,10 @@ class Supervisor:
         self._thread.start()
         if self._collector is not None:
             self._collector.start()
+        from bigdl_tpu.observability import timeseries
+        self._timeseries = timeseries.acquire()
+        if self._timeseries is not None and self._collector is not None:
+            timeseries.attach_collector(self._collector)
         return self
 
     @property
@@ -396,6 +410,12 @@ class Supervisor:
         return self._httpd.server_address[:2]
 
     def stop(self):
+        if getattr(self, "_timeseries", None) is not None:
+            from bigdl_tpu.observability import timeseries
+            if self._collector is not None:
+                timeseries.detach_collector(self._collector)
+            timeseries.release()
+            self._timeseries = None
         if self._collector is not None:
             self._collector.stop()
         if self._httpd is not None:

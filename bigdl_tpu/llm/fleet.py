@@ -60,6 +60,7 @@ from typing import Any, Dict, List, Optional, Tuple
 from bigdl_tpu import observability as obs
 from bigdl_tpu import reliability
 from bigdl_tpu.observability import flight
+from bigdl_tpu.observability import timeseries
 
 
 def fleet_enabled(override: Optional[bool] = None) -> bool:
@@ -499,7 +500,12 @@ class FleetController:
         self._hot = 0                 # consecutive pressured ticks
         self._cold = 0                # consecutive idle ticks
         self._last_action = 0.0       # monotonic stamp of the last act
-        self._last_sheds: Optional[float] = None
+        # per-member reset-aware shed deltas (ISSUE 18): the window
+        # primitive replaces the old summed _last_sheds bookkeeping —
+        # a restarted member's counter drop is a reset for that member
+        # only, never a clamp that swallows the others' sheds
+        self._sheds = timeseries.WindowedCounter()
+        self.decisions: List[dict] = []   # bounded per-tick trace
         self._draining: Optional[dict] = None   # {"addr", "t0"}
         self.scale_outs = 0
         self.scale_ins = 0
@@ -564,6 +570,7 @@ class FleetController:
                 per[inst] = self._from_snapshot(snap)
         queue = active = 0.0
         sheds = 0.0
+        sheds_by: Dict[str, float] = {}
         occ_max = 0.0
         q_interactive = 0.0
         parked_by: Dict[Tuple[str, int], float] = {}
@@ -575,6 +582,8 @@ class FleetController:
             queue += vals.get("queue", 0.0)
             active += vals.get("active", 0.0)
             sheds += vals.get("sheds", 0.0)
+            if "sheds" in vals:
+                sheds_by[name] = float(vals["sheds"])
             occ_max = max(occ_max, vals.get("occupancy", 0.0))
             q_interactive += vals.get("queue_interactive", 0.0)
             parked_by[tuple(addr)] = vals.get("parked", 0.0)
@@ -585,6 +594,9 @@ class FleetController:
             "active": active,
             "inflight": journal.inflight() if journal else 0,
             "sheds": sheds,
+            # per-member cumulative sheds: the WindowedCounter's keys,
+            # so each member's counter resets independently
+            "sheds_by": sheds_by,
             "occupancy_max": occ_max,
             # ISSUE 17: zero everywhere unless engines run the
             # priority scheduler — the class-pressure term and the
@@ -653,10 +665,13 @@ class FleetController:
             return
         sig = self.signals()
         n = sig["workers"]
-        shed_delta = 0.0
-        if self._last_sheds is not None:
-            shed_delta = max(sig["sheds"] - self._last_sheds, 0.0)
-        self._last_sheds = sig["sheds"]
+        # a signals() override that predates the per-member contract
+        # (or a healthz-only scrape) may carry just the aggregate —
+        # feed it as a single-key observation so delta math still runs
+        sheds_by = sig.get("sheds_by")
+        if not sheds_by and "sheds" in sig:
+            sheds_by = {"__total__": float(sig["sheds"])}
+        shed_delta = self._sheds.observe(sheds_by or {})
         pressure = (sig["queue"] > self.queue_high * max(n, 1)
                     or shed_delta > 0
                     or (n > 0 and sig["occupancy_max"] > 0.9)
@@ -677,12 +692,25 @@ class FleetController:
         now = time.monotonic()
         cool = now - self._last_action < self.cooldown \
             and self._last_action > 0
+        action = "none"
         if pressure and self._hot >= self.sustain and not cool \
                 and n < self.max_workers:
+            action = "scale_out"
             self._scale_out(sig)
         elif idle and self._cold >= self.sustain and not cool \
                 and n > self.min_workers:
+            action = "scale_in"
             self._begin_scale_in(sig)
+        # bounded decision trace: chaos_check --alerts replays the old
+        # summed-delta formula over sheds_by and asserts the identical
+        # pressure/idle/action sequence
+        self.decisions.append({
+            "tick": self.ticks, "workers": n, "queue": sig["queue"],
+            "sheds_by": dict(sig.get("sheds_by") or {}),
+            "shed_delta": shed_delta, "pressure": pressure,
+            "idle": idle, "action": action})
+        if len(self.decisions) > 512:
+            del self.decisions[:-512]
         self._record_gauges()
 
     def _scale_out(self, sig: dict):

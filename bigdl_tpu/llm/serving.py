@@ -1268,6 +1268,12 @@ class LLMServer:
                 target=self._watchdog_loop, name="bigdl-llm-watchdog",
                 daemon=True)
             self._watchdog_thread.start()
+        # time-series plane (ISSUE 18): the engine-side refcount on the
+        # sampler, so store-backed SLO burn windows work in processes
+        # with no HTTP surface. No-op (builds nothing) when the gate is
+        # off.
+        from bigdl_tpu.observability import timeseries
+        self._timeseries = timeseries.acquire()
         return self
 
     def stop(self, drain: bool = True, timeout: float = 30.0):
@@ -1294,6 +1300,10 @@ class LLMServer:
         if self._watchdog_thread is not None:
             self._watchdog_stop.set()
             self._watchdog_thread.join(timeout=5)
+        if getattr(self, "_timeseries", None) is not None:
+            from bigdl_tpu.observability import timeseries
+            timeseries.release()
+            self._timeseries = None
         if self._thread:
             self._thread.join(timeout=30)
         if self._thread is not None and self._thread.is_alive():

@@ -79,8 +79,10 @@ import numpy as np
 
 from bigdl_tpu import observability as obs
 from bigdl_tpu import reliability
+from bigdl_tpu.observability import alerts
 from bigdl_tpu.observability import flight
 from bigdl_tpu.observability import request_context as rc
+from bigdl_tpu.observability import timeseries
 from bigdl_tpu.observability import tracing
 from bigdl_tpu.observability.federation import (
     federation_enabled, registry_snapshot)
@@ -243,6 +245,12 @@ class LLMWorker:
                     # flight recorder + per-request explain (ISSUE 16):
                     # same shared-helper idiom, 404 arms included
                     debug = flight.debug_endpoint(self.path)
+                if debug is None:
+                    # time-series plane (ISSUE 18): /metrics/query +
+                    # /fleet/timeline + /alerts, 404 arms included
+                    debug = timeseries.debug_endpoint(self.path)
+                if debug is None:
+                    debug = alerts.debug_endpoint(self.path)
                 if debug is not None:
                     self._json(*debug)
                 elif self.path == "/debug/kvcache":
@@ -652,6 +660,8 @@ class LLMWorker:
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         daemon=True)
         self._thread.start()
+        # time-series plane (ISSUE 18): refcounted — released on stop
+        self._timeseries = timeseries.acquire()
         return self
 
     def stop(self):
@@ -661,6 +671,9 @@ class LLMWorker:
         # keeps admission closed (the engine is about to stop for good)
         if self._drain is not None:
             self._drain.cancel(resume=False)
+        if getattr(self, "_timeseries", None) is not None:
+            timeseries.release()
+            self._timeseries = None
         if self._thread is not None:
             # shutdown() handshakes with serve_forever — calling it on
             # a never-started server would wait forever
@@ -917,6 +930,13 @@ class LLMRouter:
                     # the journal's failover/hedge/shed events live in
                     # this process, so explain works here too
                     debug = flight.debug_endpoint(self.path)
+                if debug is None:
+                    # time-series plane (ISSUE 18): with the collector
+                    # attached, /fleet/timeline serves per-member +
+                    # merged series off the scrape cache
+                    debug = timeseries.debug_endpoint(self.path)
+                if debug is None:
+                    debug = alerts.debug_endpoint(self.path)
                 if debug is not None:
                     self._json(*debug)
                 elif self.path == "/healthz":
@@ -1673,6 +1693,11 @@ class LLMRouter:
             self._prober.start()
         if self._collector is not None:
             self._collector.start()
+        # time-series plane (ISSUE 18): the router's store rides the
+        # federation collector's scrape cache when there is one
+        self._timeseries = timeseries.acquire()
+        if self._timeseries is not None and self._collector is not None:
+            timeseries.attach_collector(self._collector)
         if self._fleet is not None and self._start_fleet:
             self._fleet.start()
         return self
@@ -1683,6 +1708,11 @@ class LLMRouter:
         # prober/membership surfaces it depends on go away
         if self._fleet is not None:
             self._fleet.stop()
+        if getattr(self, "_timeseries", None) is not None:
+            if self._collector is not None:
+                timeseries.detach_collector(self._collector)
+            timeseries.release()
+            self._timeseries = None
         if self._collector is not None:
             self._collector.stop()
         if self._prober is not None:

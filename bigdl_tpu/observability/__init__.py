@@ -133,6 +133,9 @@ def reset():
     compile_recorder.reset()
     flight.reset()
     utilization.reset()
+    from bigdl_tpu.observability import alerts, timeseries
+    alerts.reset()
+    timeseries.reset()
 
 
 __all__ = [

@@ -406,6 +406,14 @@ class FederationCollector:
                 instance=self.include_self)
         return out
 
+    def stale_instances(self) -> set:
+        """Members whose last scrape failed (serving last-known
+        snapshots). The time-series store excludes them at sample time
+        so merged windows only aggregate live members."""
+        with self._lock:
+            return {name for name, ent in self._members.items()
+                    if ent["stale"]}
+
     def merged(self) -> dict:
         return merge_snapshots(self.snapshots())
 

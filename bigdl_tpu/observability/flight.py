@@ -45,7 +45,8 @@ EVENT_KINDS: Tuple[str, ...] = (
     "queue", "admit", "radix_hit", "radix_miss", "cow_fork", "park",
     "fetch", "chunk_charge", "rollback", "shed", "evict", "spill",
     "failover", "hedge", "drain_migrate", "scale_out", "scale_in",
-    "preempt", "preempt_resume", "finish",
+    "preempt", "preempt_resume", "finish", "alert_fire",
+    "alert_resolve",
 )
 
 

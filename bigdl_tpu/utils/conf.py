@@ -55,6 +55,20 @@ _DEFAULTS: Dict[str, str] = {
     # series, endpoints 404
     "bigdl.observability.flight.enabled": "false",
     "bigdl.observability.flight.capacity": "4096",  # ring events
+    # in-process time-series plane (ISSUE 18): bounded ring of periodic
+    # registry snapshots with typed window queries (/metrics/query,
+    # /fleet/timeline) + the declarative alert engine (/alerts).
+    # false = no sampler thread, no ring, no bigdl_timeseries_* /
+    # bigdl_alerts_* series, all three endpoints 404
+    "bigdl.observability.timeseries.enabled": "false",
+    "bigdl.observability.timeseries.interval": "5.0",   # sample cadence (s)
+    "bigdl.observability.timeseries.retention": "600",  # history kept (s)
+    # window backing the bigdl_slo_burn_rate gauges when the plane is
+    # on (seconds of traffic instead of slo.py's last-N-requests deque)
+    "bigdl.observability.timeseries.slo.window": "300",
+    # JSON list of alert rules replacing the built-in multi-window SLO
+    # burn set (see observability/alerts.py); "" = built-ins
+    "bigdl.observability.alerts.rules": "",
     # per-platform peak specs for the roofline gauges; 0 = auto-detect
     # from the PJRT device_kind (see observability/utilization.py)
     "bigdl.device.peak.tflops": "0",          # dense bf16 TFLOP/s
@@ -66,6 +80,9 @@ _DEFAULTS: Dict[str, str] = {
     "bigdl.slo.ttft_ms": "500",               # admission -> first token
     "bigdl.slo.itl_ms": "200",                # worst inter-token gap
     "bigdl.slo.window": "100",                # burn-rate request window
+    # availability objective backing the alert engine's error budget:
+    # burn = violation_ratio / (1 - objective)
+    "bigdl.slo.objective": "0.99",
     "bigdl.reliability.enabled": "true",      # fault sites + policies
     "bigdl.reliability.retry.max.attempts": "3",   # tries, not retries
     "bigdl.reliability.retry.base.delay": "0.05",  # seconds

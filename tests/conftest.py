@@ -85,6 +85,10 @@ def pytest_configure(config):
         "priority: SLO-class priority scheduling / lossless preemption "
         "tests (class-ordered admission, preempt-resume parity; select "
         "with -m priority)")
+    config.addinivalue_line(
+        "markers",
+        "timeseries: time-series plane tests (windowed store, alert "
+        "engine, fleet timelines; select with -m timeseries)")
 
 
 @pytest.fixture(scope="session")

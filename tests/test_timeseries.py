@@ -1,0 +1,585 @@
+"""ISSUE 18: the in-process time-series plane — windowed store ring
+semantics (fake clocks throughout), reset-aware counter windows across
+worker restarts, stale/departed federation members excluded from
+merged windows, sketch-snapshot subtraction with alpha-mismatch
+passthrough, the empty-window NaN contract, retention eviction, the
+declarative alert engine's pending/firing/resolved state machine with
+flight-event reconciliation, and the disabled-mode structural-absence
+contract for ``bigdl.observability.timeseries.enabled``."""
+
+import math
+import threading
+
+import pytest
+
+from bigdl_tpu import observability as obs
+from bigdl_tpu.observability import alerts, flight
+from bigdl_tpu.observability import timeseries as ts
+from bigdl_tpu.observability.sketch import QuantileSketch
+from bigdl_tpu.utils.conf import conf
+
+pytestmark = pytest.mark.timeseries
+
+GATE = "bigdl.observability.timeseries.enabled"
+
+
+@pytest.fixture(autouse=True)
+def _ts_clean():
+    """Observability on, the time-series gate at its default (OFF),
+    and no live store/engine around every test; tests opt in via
+    ``conf.set(GATE, "true")``. The global registry is NOT cleared
+    (live modules hold instrument refs) — absence tests read render
+    deltas."""
+    was = obs.enabled()
+    obs.enable()
+    ts.reset()
+    alerts.reset()
+    flight.reset()
+    yield
+    for key in (GATE, "bigdl.observability.timeseries.interval",
+                "bigdl.observability.timeseries.retention",
+                "bigdl.observability.timeseries.slo.window",
+                "bigdl.observability.alerts.rules",
+                "bigdl.observability.flight.enabled",
+                "bigdl.slo.objective"):
+        conf.unset(key)
+    ts.reset()
+    alerts.reset()
+    flight.reset()
+    if was:
+        obs.enable()
+    else:
+        obs.disable()
+
+
+def _doc(counters=None, gauges=None, sketches=None):
+    """A minimal federation wire snapshot: unlabeled series only."""
+    metrics = []
+    for name, v in (counters or {}).items():
+        metrics.append({"name": name, "kind": "counter", "help": "",
+                        "labelnames": [],
+                        "series": [{"labels": [], "value": float(v)}]})
+    for name, v in (gauges or {}).items():
+        metrics.append({"name": name, "kind": "gauge", "help": "",
+                        "labelnames": [],
+                        "series": [{"labels": [], "value": float(v)}]})
+    for name, snap in (sketches or {}).items():
+        metrics.append({"name": name, "kind": "summary", "help": "",
+                        "labelnames": [],
+                        "series": [{"labels": [], "sketch": snap}]})
+    return {"instance": "synthetic", "ts": 0.0, "metrics": metrics}
+
+
+class _StubCollector:
+    """Quacks like the federation collector's scrape cache: the store
+    only reads ``snapshots()``, ``stale_instances()`` and
+    ``include_self``."""
+
+    def __init__(self, include_self="m1"):
+        self.include_self = include_self
+        self.snaps = {}
+        self.stale = set()
+
+    def snapshots(self):
+        return dict(self.snaps)
+
+    def stale_instances(self):
+        return set(self.stale)
+
+
+def _member_store(retention=600.0):
+    st = ts.TimeSeriesStore(interval=1.0, retention=retention,
+                            clock=lambda: 0.0)
+    coll = _StubCollector()
+    st.attach_collector(coll)
+    return st, coll
+
+
+# ---------------------------------------------------------------------------
+# pure window primitives
+# ---------------------------------------------------------------------------
+
+class TestPrimitives:
+    def test_counter_delta_reset_aware(self):
+        # 5->9 (+4), 9->2 (restart: +2), 2->4 (+2)
+        assert ts.counter_delta([5.0, 9.0, 2.0, 4.0]) == 8.0
+
+    def test_counter_delta_empty_window_is_nan_not_zero(self):
+        assert math.isnan(ts.counter_delta([]))
+        assert math.isnan(ts.counter_delta([7.0]))
+
+    def test_counter_rate(self):
+        assert ts.counter_rate([(0.0, 0.0), (10.0, 40.0)]) == 4.0
+        assert math.isnan(ts.counter_rate([(5.0, 1.0)]))
+        assert math.isnan(ts.counter_rate([(5.0, 1.0), (5.0, 2.0)]))
+
+    def test_gauge_stats_empty_all_nan(self):
+        stats = ts.gauge_stats([])
+        assert all(math.isnan(v) for v in stats.values())
+        stats = ts.gauge_stats([2.0, 8.0, 5.0])
+        assert (stats["avg"], stats["min"], stats["max"],
+                stats["last"]) == (5.0, 2.0, 8.0, 5.0)
+
+    def test_histogram_delta_and_restart_passthrough(self):
+        first = {"bounds": [1.0], "cum": [2], "sum": 3.0, "count": 4}
+        last = {"bounds": [1.0], "cum": [5], "sum": 9.0, "count": 8}
+        d = ts.histogram_delta(first, last)
+        assert (d["count"], d["sum"]) == (4.0, 6.0)
+        # count drop = restart: last passes through whole
+        d = ts.histogram_delta(last, first)
+        assert (d["count"], d["sum"]) == (4.0, 3.0)
+
+    def test_windowed_counter_per_member_semantics(self):
+        wc = ts.WindowedCounter()
+        assert wc.observe({"a": 5.0, "b": 3.0}) == 0.0   # first sight
+        assert wc.observe({"a": 7.0, "b": 3.0}) == 2.0
+        # a restarts (7 -> 1): ITS post-reset value is the delta; b's
+        # growth is not swallowed by any summed clamp
+        assert wc.observe({"a": 1.0, "b": 6.0}) == 4.0
+        # b departs: no contribution, no phantom negative
+        assert wc.observe({"a": 1.0}) == 0.0
+        # b rejoins: first sight again, delta 0
+        assert wc.observe({"a": 1.0, "b": 9.0}) == 0.0
+
+
+class TestSketchWindow:
+    def _snap(self, values, alpha=0.01):
+        sk = QuantileSketch(alpha=alpha)
+        for v in values:
+            sk.observe(v)
+        return sk.to_snapshot()
+
+    def test_window_isolates_between_samples(self):
+        before = self._snap([0.1] * 50)
+        sk = QuantileSketch.from_snapshot(before)
+        for _ in range(50):
+            sk.observe(5.0)
+        win = ts.sketch_window(before, sk.to_snapshot(), qs=(0.5,))
+        assert abs(win[0.5] - 5.0) / 5.0 < 0.05
+
+    def test_alpha_mismatch_passes_after_through(self):
+        before = self._snap([0.1] * 20, alpha=0.01)
+        after = self._snap([0.1] * 20 + [9.0] * 20, alpha=0.02)
+        d = ts.sketch_delta(before, after)
+        assert d == dict(after)          # no lying subtraction
+        win = ts.sketch_window(before, after, qs=(0.5,))
+        assert win[0.5] is not None      # quantiles of after, whole
+
+    def test_count_drop_passes_after_through(self):
+        before = self._snap([1.0] * 30)
+        after = self._snap([2.0] * 10)   # restarted: fewer samples
+        assert ts.sketch_delta(before, after) == dict(after)
+
+    def test_empty_delta_yields_none_quantiles(self):
+        snap = self._snap([1.0] * 10)
+        win = ts.sketch_window(snap, snap, qs=(0.5, 0.99))
+        assert win == {0.5: None, 0.99: None}
+
+
+# ---------------------------------------------------------------------------
+# the windowed store (fake clocks; synthetic federation members)
+# ---------------------------------------------------------------------------
+
+class TestStoreWindows:
+    def test_counter_reset_across_worker_restart(self):
+        # the member's counter drops mid-window (worker restart): the
+        # post-reset value is new increase, never a negative delta
+        st, coll = _member_store()
+        for now, val in ((0.0, 100.0), (10.0, 130.0), (20.0, 5.0),
+                         (30.0, 12.0)):
+            coll.snaps = {"m1": _doc(counters={"x_total": val})}
+            st.sample_now(now=now)
+        assert st.query("x_total", "delta", window=30.0,
+                        instance="m1", now=30.0) == 30.0 + 5.0 + 7.0
+        assert st.query("x_total", "rate", window=30.0, instance="m1",
+                        now=30.0) == pytest.approx(42.0 / 30.0)
+
+    def test_empty_window_is_nan_never_zero(self):
+        st, coll = _member_store()
+        assert math.isnan(st.query("x_total", "delta", window=60.0))
+        coll.snaps = {"m1": _doc(counters={"x_total": 9.0})}
+        st.sample_now(now=0.0)
+        # one point is not a window
+        assert math.isnan(st.query("x_total", "delta", window=60.0,
+                                   instance="m1", now=0.0))
+        st.sample_now(now=10.0)
+        # a series the window never saw is NaN, not 0
+        assert math.isnan(st.query("nope_total", "delta", window=60.0,
+                                   instance="m1", now=10.0))
+        assert math.isnan(st.query("nope_gauge", "avg", window=60.0,
+                                   instance="m1", now=10.0))
+
+    def test_retention_evicts_old_samples(self):
+        st, coll = _member_store(retention=30.0)
+        coll.snaps = {"m1": _doc(counters={"x_total": 1.0})}
+        for now in (0.0, 10.0, 20.0, 40.0):
+            st.sample_now(now=now)
+        # floor = 40 - 30 = 10: the t=0 sample is gone, t=10 survives
+        assert len(st) == 3
+        assert st.evicted == 1
+        assert st._window(None, 40.0)[0][0] == 10.0
+
+    def test_stale_members_excluded_at_sample_time(self):
+        st, coll = _member_store()
+        coll.snaps = {"m1": _doc(counters={"x_total": 1.0}),
+                      "m2": _doc(counters={"x_total": 100.0})}
+        st.sample_now(now=0.0)
+        assert st.instances(now=0.0) == ["m1", "m2"]
+        coll.stale = {"m2"}          # m2's scrape failed: cached copy
+        coll.snaps["m1"] = _doc(counters={"x_total": 4.0})
+        st.sample_now(now=10.0)
+        assert st.instances(now=10.0) == ["m1"]
+        # merged window only aggregates live members
+        assert st.query("x_total", "delta", window=10.0, instance="*",
+                        now=10.0) == 3.0
+
+    def test_departed_members_leave_merged_windows(self):
+        st, coll = _member_store()
+        coll.snaps = {"m1": _doc(counters={"x_total": 10.0}),
+                      "m2": _doc(counters={"x_total": 50.0})}
+        st.sample_now(now=0.0)
+        coll.snaps = {"m1": _doc(counters={"x_total": 12.0}),
+                      "m2": _doc(counters={"x_total": 55.0})}
+        st.sample_now(now=10.0)
+        del coll.snaps["m2"]         # m2 left the pool
+        coll.snaps["m1"] = _doc(counters={"x_total": 15.0})
+        st.sample_now(now=20.0)
+        # membership = the window's most recent sample
+        assert st.instances(now=20.0) == ["m1"]
+        assert st.query("x_total", "delta", window=20.0, instance="*",
+                        now=20.0) == 5.0
+        tl = st.timeline("x_total", window=20.0, now=20.0)
+        assert [p[1] for p in tl["instances"]["m2"]] == [50.0, 55.0]
+        assert tl["merged"][-1] == [20.0, 15.0]
+
+    def test_merged_delta_resets_per_member(self):
+        # m1 restarts while m2 grows: per-member reset detection means
+        # m2's growth survives (the summed-trace clamp would eat it)
+        st, coll = _member_store()
+        coll.snaps = {"m1": _doc(counters={"x_total": 90.0}),
+                      "m2": _doc(counters={"x_total": 10.0})}
+        st.sample_now(now=0.0)
+        coll.snaps = {"m1": _doc(counters={"x_total": 2.0}),
+                      "m2": _doc(counters={"x_total": 30.0})}
+        st.sample_now(now=10.0)
+        assert st.query("x_total", "delta", window=10.0, instance="*",
+                        now=10.0) == 2.0 + 20.0
+
+    def test_gauge_window_and_timeline(self):
+        st, coll = _member_store()
+        for now, v1, v2 in ((0.0, 2.0, 4.0), (10.0, 4.0, 4.0)):
+            coll.snaps = {"m1": _doc(gauges={"g": v1}),
+                          "m2": _doc(gauges={"g": v2})}
+            st.sample_now(now=now)
+        assert st.query("g", "max", window=10.0, instance="*",
+                        now=10.0) == 8.0
+        assert st.query("g", "avg", window=10.0, instance="*",
+                        now=10.0) == 7.0
+        assert st.query("g", "last", window=10.0, instance="m1",
+                        now=10.0) == 4.0
+
+    def test_merged_sketch_skips_alpha_mismatched_member(self):
+        def snap(values, alpha):
+            sk = QuantileSketch(alpha=alpha)
+            for v in values:
+                sk.observe(v)
+            return sk.to_snapshot()
+
+        st, coll = _member_store()
+        coll.snaps = {"m1": _doc(sketches={"lat": snap([1.0], 0.01)}),
+                      "m2": _doc(sketches={"lat": snap([9.0], 0.05)})}
+        st.sample_now(now=0.0)
+        coll.snaps = {
+            "m1": _doc(sketches={"lat": snap([1.0] * 40, 0.01)}),
+            "m2": _doc(sketches={"lat": snap([9.0] * 40, 0.05)})}
+        st.sample_now(now=10.0)
+        # merged p50 uses m1 + whichever mates merge cleanly; the
+        # alpha-mismatched m2 is skipped instead of poisoning the merge
+        val = st.query("lat", "p50", window=10.0, instance="*",
+                       now=10.0)
+        assert not math.isnan(val)
+        assert abs(val - 1.0) < 0.5
+
+    def test_parse_series(self):
+        name, labels = ts.parse_series(
+            'bigdl_slo_requests_total{slo="ttft",verdict="ok"}')
+        assert name == "bigdl_slo_requests_total"
+        assert labels == {"slo": "ttft", "verdict": "ok"}
+        assert ts.parse_series("plain_total") == ("plain_total", {})
+        with pytest.raises(ValueError):
+            ts.parse_series("bad{unclosed")
+
+
+# ---------------------------------------------------------------------------
+# the alert engine (fake clock: evaluate(now) on manual store ticks)
+# ---------------------------------------------------------------------------
+
+class TestAlertEngine:
+    def _slo_member(self, st, coll, now, ok, violated):
+        coll.snaps = {"m1": _doc(counters={})}
+        doc = _doc()
+        doc["metrics"].append({
+            "name": "bigdl_slo_requests_total", "kind": "counter",
+            "help": "", "labelnames": ["slo", "verdict"],
+            "series": [
+                {"labels": ["ttft", "ok"], "value": float(ok)},
+                {"labels": ["ttft", "violated"],
+                 "value": float(violated)}]})
+        coll.snaps = {"m1": doc}
+        st.sample_now(now=now)
+
+    def test_burn_rate_fires_and_resolves(self):
+        st, coll = _member_store()
+        eng = alerts.AlertEngine(st, rules=[
+            {"name": "fb", "kind": "burn_rate", "slo": "ttft",
+             "short": 10.0, "long": 20.0, "factor": 5.0,
+             "objective": 0.99}])
+        self._slo_member(st, coll, 0.0, ok=10, violated=0)
+        eng.evaluate(0.0)
+        assert eng.firing() == []
+        # 10 violated of 12 total in both windows: burn = .833/.01 = 83
+        self._slo_member(st, coll, 10.0, ok=12, violated=10)
+        eng.evaluate(10.0)
+        assert eng.firing() == ["fb"]
+        # windows drain past the storm: resolve
+        self._slo_member(st, coll, 50.0, ok=20, violated=10)
+        self._slo_member(st, coll, 55.0, ok=25, violated=10)
+        eng.evaluate(55.0)
+        assert eng.firing() == []
+        state = eng.status()["rules"][0]
+        assert state["state"] == "resolved"
+        assert state["fired_count"] == 1
+
+    def test_burn_rate_needs_both_windows(self):
+        # short window hot but long window cold: no page (the
+        # multi-window guard against one bad scrape)
+        st, coll = _member_store()
+        eng = alerts.AlertEngine(st, rules=[
+            {"name": "fb", "kind": "burn_rate", "slo": "ttft",
+             "short": 10.0, "long": 100.0, "factor": 5.0,
+             "objective": 0.9}])
+        self._slo_member(st, coll, 0.0, ok=1000, violated=0)
+        self._slo_member(st, coll, 95.0, ok=2000, violated=0)
+        self._slo_member(st, coll, 100.0, ok=2000, violated=30)
+        eng.evaluate(100.0)
+        assert eng.firing() == []
+
+    def test_threshold_pending_for_then_firing(self):
+        st, coll = _member_store()
+        eng = alerts.AlertEngine(st, rules=[
+            {"name": "qh", "kind": "threshold", "series": "q",
+             "fn": "last", "window": 30.0, "op": ">", "value": 5.0,
+             "for": 10.0}])
+        coll.snaps = {"m1": _doc(gauges={"q": 9.0})}
+        st.sample_now(now=0.0)
+        eng.evaluate(0.0)
+        assert eng.status()["rules"][0]["state"] == "pending"
+        st.sample_now(now=5.0)
+        eng.evaluate(5.0)
+        assert eng.firing() == []          # held, not yet past `for`
+        st.sample_now(now=12.0)
+        eng.evaluate(12.0)
+        assert eng.firing() == ["qh"]
+        coll.snaps = {"m1": _doc(gauges={"q": 0.0})}
+        st.sample_now(now=20.0)
+        eng.evaluate(20.0)
+        assert eng.firing() == []
+
+    def test_pending_cancelled_when_condition_clears(self):
+        st, coll = _member_store()
+        eng = alerts.AlertEngine(st, rules=[
+            {"name": "qh", "kind": "threshold", "series": "q",
+             "fn": "last", "window": 30.0, "op": ">", "value": 5.0,
+             "for": 10.0}])
+        coll.snaps = {"m1": _doc(gauges={"q": 9.0})}
+        st.sample_now(now=0.0)
+        eng.evaluate(0.0)
+        coll.snaps = {"m1": _doc(gauges={"q": 1.0})}
+        st.sample_now(now=5.0)
+        eng.evaluate(5.0)
+        assert eng.status()["rules"][0]["state"] == "inactive"
+        assert eng.status()["rules"][0]["fired_count"] == 0
+
+    def test_absence_rule_scrape_hole_is_not_absence(self):
+        st, coll = _member_store()
+        eng = alerts.AlertEngine(st, rules=[
+            {"name": "ab", "kind": "absence", "series": "heartbeat",
+             "window": 30.0, "instance": "m1"}])
+        eng.evaluate(0.0)              # empty store: a scrape hole
+        assert eng.firing() == []
+        coll.snaps = {"m1": _doc(gauges={"other": 1.0})}
+        st.sample_now(now=10.0)
+        eng.evaluate(10.0)             # samples exist, series absent
+        assert eng.firing() == ["ab"]
+        coll.snaps = {"m1": _doc(gauges={"heartbeat": 1.0})}
+        st.sample_now(now=20.0)
+        eng.evaluate(20.0)
+        assert eng.firing() == []
+
+    def test_transitions_reconcile_with_flight_events(self):
+        conf.set("bigdl.observability.flight.enabled", "true")
+        st, coll = _member_store()
+        eng = alerts.AlertEngine(st, rules=[
+            {"name": "fb", "kind": "burn_rate", "slo": "ttft",
+             "short": 10.0, "long": 20.0, "factor": 5.0,
+             "objective": 0.99}])
+
+        def counts():
+            evs = flight.ring().events() if flight.ring() else []
+            reg = obs.REGISTRY
+            return {
+                "fire_ev": sum(1 for e in evs
+                               if e["kind"] == "alert_fire"),
+                "resolve_ev": sum(1 for e in evs
+                                  if e["kind"] == "alert_resolve"),
+                "fire_tr": reg.sample_value(
+                    "bigdl_alerts_transitions_total", rule="fb",
+                    state="firing") or 0.0,
+                "resolve_tr": reg.sample_value(
+                    "bigdl_alerts_transitions_total", rule="fb",
+                    state="resolved") or 0.0,
+            }
+
+        before = counts()
+        self._slo_member(st, coll, 0.0, ok=10, violated=0)
+        eng.evaluate(0.0)
+        self._slo_member(st, coll, 10.0, ok=12, violated=10)
+        eng.evaluate(10.0)
+        self._slo_member(st, coll, 50.0, ok=20, violated=10)
+        self._slo_member(st, coll, 55.0, ok=25, violated=10)
+        eng.evaluate(55.0)
+        after = counts()
+        delta = {k: after[k] - before[k] for k in after}
+        # same call site: transitions and flight events move in lockstep
+        assert delta == {"fire_ev": 1, "resolve_ev": 1,
+                         "fire_tr": 1.0, "resolve_tr": 1.0}
+        assert (obs.REGISTRY.sample_value("bigdl_alerts_firing")
+                or 0.0) == 0.0
+
+    def test_record_rule_publishes_gauge(self):
+        st, coll = _member_store()
+        eng = alerts.AlertEngine(st, rules=[
+            {"name": "qdepth", "kind": "record", "series": "q",
+             "fn": "last", "window": 30.0, "instance": "m1"}])
+        coll.snaps = {"m1": _doc(gauges={"q": 7.0})}
+        st.sample_now(now=0.0)
+        eng.evaluate(0.0)
+        assert eng.status()["rules"][0]["state"] == "recording"
+        assert obs.REGISTRY.sample_value("bigdl_alerts_recorded",
+                                         rule="qdepth") == 7.0
+
+    def test_declarative_rules_override_and_fallback(self):
+        conf.set("bigdl.observability.alerts.rules",
+                 '[{"name": "only", "kind": "threshold", '
+                 '"series": "q", "value": 1}]')
+        assert [r["name"] for r in alerts.load_rules()] == ["only"]
+        conf.set("bigdl.observability.alerts.rules", "{broken json")
+        names = [r["name"] for r in alerts.load_rules()]
+        assert names == [r["name"] for r in alerts.default_rules()]
+        assert "slo-fast-burn-ttft" in names
+
+
+# ---------------------------------------------------------------------------
+# lifecycle + the structural-absence contract
+# ---------------------------------------------------------------------------
+
+class TestGateLifecycle:
+    def test_disabled_is_structurally_absent(self):
+        # bigdl.observability.timeseries.enabled defaults off
+        assert not ts.enabled
+        lines_before = set(obs.render().splitlines())
+        assert ts.acquire() is None
+        assert ts.store() is None
+        assert alerts.engine() is None
+        assert ts.sample_now(now=0.0) is None
+        assert ts.slo_burn("ttft", "router") is None
+        for path in ("/metrics/query?series=x_total&window=60",
+                     "/fleet/timeline?series=x_total"):
+            status, body = ts.debug_endpoint(path)
+            assert status == 404
+            assert body["gate"] == GATE
+        status, body = alerts.debug_endpoint("/alerts")
+        assert status == 404 and body["gate"] == GATE
+        assert not [t for t in threading.enumerate()
+                    if t.name == ts.TimeSeriesStore.THREAD_NAME]
+        grown = set(obs.render().splitlines()) - lines_before
+        assert not [g for g in grown if "bigdl_timeseries" in g
+                    or "bigdl_alerts" in g]
+
+    def test_acquire_release_refcount(self):
+        conf.set(GATE, "true")
+        conf.set("bigdl.observability.timeseries.interval", "3600")
+        st = ts.acquire()
+        assert st is ts.store() is ts.acquire()   # refcount 2
+        assert alerts.engine() is not None
+        assert [t for t in threading.enumerate()
+                if t.name == ts.TimeSeriesStore.THREAD_NAME]
+        ts.release()
+        assert [t for t in threading.enumerate()
+                if t.name == ts.TimeSeriesStore.THREAD_NAME]
+        ts.release()                              # last ref: stop
+        assert not [t for t in threading.enumerate()
+                    if t.name == ts.TimeSeriesStore.THREAD_NAME]
+
+    def test_conf_refresh_pokes_live_store(self):
+        conf.set(GATE, "true")
+        assert ts.enabled
+        conf.set("bigdl.observability.timeseries.interval", "3600")
+        st = ts.acquire()
+        try:
+            conf.set("bigdl.observability.timeseries.retention", "42")
+            assert st.retention == 42.0
+        finally:
+            ts.release()
+        conf.unset(GATE)
+        assert not ts.enabled
+
+    def test_query_endpoint_over_live_store(self):
+        conf.set(GATE, "true")
+        conf.set("bigdl.observability.timeseries.interval", "3600")
+        st = ts.acquire()
+        try:
+            c = obs.counter("bigdl_timeseries_samples_total")
+            del c                        # the instrument exists anyway
+            st.sample_now()
+            st.sample_now()
+            status, body = ts.debug_endpoint(
+                "/metrics/query?series=bigdl_timeseries_samples_total"
+                "&window=600&fn=delta")
+            assert status == 200
+            assert body["value"] >= 1.0
+            status, body = ts.debug_endpoint(
+                "/fleet/timeline?series=bigdl_timeseries_samples_total"
+                "&window=600")
+            assert status == 200
+            assert list(body["instances"]) == ["local"]
+            assert len(body["merged"]) == 2
+            status, body = ts.debug_endpoint(
+                "/metrics/query?series=x&window=nope")
+            assert status == 400
+            status, body = ts.debug_endpoint("/metrics/query")
+            assert status == 400
+        finally:
+            ts.release()
+
+    def test_slo_burn_from_store_windows(self):
+        conf.set(GATE, "true")
+        conf.set("bigdl.observability.timeseries.interval", "3600")
+        st = ts.acquire()
+        try:
+            reqs = obs.counter("bigdl_slo_requests_total",
+                               labelnames=("slo", "verdict", "scope"))
+            st.sample_now(now=0.0)
+            reqs.labels(slo="ttft", verdict="ok",
+                        scope="ts-test").inc(6)
+            reqs.labels(slo="ttft", verdict="violated",
+                        scope="ts-test").inc(2)
+            st.sample_now(now=10.0)
+            burn = ts.slo_burn("ttft", "ts-test", window=60.0,
+                               now=10.0)
+            assert burn == pytest.approx(0.25)
+            # warm store, idle scope: 0.0 (None means "no plane")
+            assert ts.slo_burn("ttft", "no-such-scope", window=60.0,
+                              now=10.0) == 0.0
+        finally:
+            ts.release()

@@ -248,6 +248,18 @@ def _flatten_full(rec: dict) -> Dict[str, float]:
         val = pb.get(field)
         if isinstance(val, (int, float)) and not isinstance(val, bool):
             flat[f"priority.{key}"] = float(val)
+    # ISSUE 18: the time-series plane — windowed-store sampling cost
+    # over the live post-bench registry (creeping up means snapshot
+    # cost or metric cardinality regressed) and the alert transitions
+    # the built-in burn-rate rules saw (nonzero means the bench round
+    # itself tripped an SLO page)
+    ab = (((rec.get("extra") or {}).get("telemetry") or {})
+          .get("alerts") or {})
+    for field, key in (("sample_overhead_us", "ts.sample_overhead_us"),
+                       ("transitions", "alerts.transitions")):
+        val = ab.get(field)
+        if isinstance(val, (int, float)) and not isinstance(val, bool):
+            flat[key] = float(val)
     # ISSUE 16: the live roofline gauges sampled while the serving
     # microbenches ran — MFU or achieved HBM bandwidth drifting down
     # between rounds is a dispatch-efficiency regression even when

@@ -891,6 +891,368 @@ def run_flight_chaos(seed: int = 0, new_tokens: int = 4,
     return out
 
 
+def run_alerts_chaos(seed: int = 0, new_tokens: int = 3,
+                     smoke: bool = False) -> dict:
+    """ISSUE 18 acceptance: the time-series plane + alert engine under
+    a seeded failover storm.
+
+    Part 1 — disabled mode is STRUCTURALLY absent. With
+    ``bigdl.observability.timeseries.enabled`` off, ``acquire()``
+    builds nothing, no sampler thread exists, no
+    ``bigdl_timeseries_*`` / ``bigdl_alerts_*`` series appears, and
+    ``/metrics/query``, ``/fleet/timeline`` and ``/alerts`` all answer
+    404 naming the gate key.
+
+    Part 2 — plane ON with a tiny-window fast-burn rule installed
+    through the declarative ``bigdl.observability.alerts.rules`` path:
+    clean traffic keeps the rule inactive; a seeded failover storm
+    (mid-stream ``router.dispatch`` kill + ``llm.step`` delays pushing
+    every request past the TTFT objective) must flip it to firing on
+    the FIRST store sample after the storm (one evaluation interval),
+    hold firing while the storm is still inside both windows, and
+    resolve once the windows drain past it under clean recovery
+    traffic. Alert state transitions must reconcile EXACTLY with the
+    flight ``alert_fire`` / ``alert_resolve`` events (same call site)
+    and with the ``bigdl_alerts_transitions_total`` counter deltas.
+
+    Part 3 — the autoscaler reads its shed-pressure signal through the
+    store's :class:`~bigdl_tpu.observability.timeseries.WindowedCounter`
+    primitive now; replaying the OLD summed-delta formula over the
+    controller's recorded ``sheds_by`` traces must yield the identical
+    pressure/idle/action sequence on restart-free traces (the
+    per-member primitive only diverges where the old clamp was wrong:
+    a member restart no longer swallows the other members' sheds)."""
+    import http.client
+    import json as _json
+    import threading
+    from urllib.parse import quote
+
+    import numpy as np
+
+    from bigdl_tpu import observability as obs
+    from bigdl_tpu import reliability as rel
+    from bigdl_tpu.llm.fleet import FleetController
+    from bigdl_tpu.llm.models.llama import LlamaConfig, LlamaForCausalLM
+    from bigdl_tpu.llm.serving import LLMServer
+    from bigdl_tpu.llm.worker import LLMRouter, LLMWorker
+    from bigdl_tpu.observability import alerts, flight
+    from bigdl_tpu.observability import timeseries as ts
+    from bigdl_tpu.utils.conf import conf
+
+    GATE = "bigdl.observability.timeseries.enabled"
+    KEYS = (GATE, "bigdl.observability.timeseries.interval",
+            "bigdl.observability.alerts.rules",
+            "bigdl.observability.flight.enabled")
+    with conf._lock:
+        prev = {k: conf._set_layer.get(k) for k in KEYS}
+
+    def post(addr, path, body, timeout=600):
+        conn = http.client.HTTPConnection(*addr, timeout=timeout)
+        try:
+            conn.request("POST", path, _json.dumps(body),
+                         {"Content-Type": "application/json"})
+            r = conn.getresponse()
+            return r.status, _json.loads(r.read().decode())
+        finally:
+            conn.close()
+
+    def get(addr, path, timeout=60):
+        conn = http.client.HTTPConnection(*addr, timeout=timeout)
+        try:
+            conn.request("GET", path)
+            r = conn.getresponse()
+            return r.status, _json.loads(r.read().decode())
+        finally:
+            conn.close()
+
+    def _alert_events():
+        r = flight.ring()
+        evs = r.events() if r is not None else []
+        return {"fire": sum(1 for e in evs if e["kind"] == "alert_fire"),
+                "resolve": sum(1 for e in evs
+                               if e["kind"] == "alert_resolve")}
+
+    RULE = "chaos-fast-burn-ttft"
+
+    def _trans(state):
+        if not obs.enabled():
+            return 0.0
+        return obs.REGISTRY.sample_value(
+            "bigdl_alerts_transitions_total", rule=RULE,
+            state=state) or 0.0
+
+    out = {"seed": seed, "gate": GATE}
+    try:
+        # --- part 1: disabled mode is structurally absent ---------------
+        conf.set(GATE, "false")
+        assert not ts.enabled, f"{GATE}=false left the plane armed"
+        lines_before = (set(obs.render().splitlines())
+                        if obs.enabled() else set())
+        assert ts.acquire() is None, \
+            "acquire() built a store while the gate was off"
+        for path in ("/metrics/query?series=bigdl_slo_requests_total"
+                     "&window=60",
+                     "/fleet/timeline?series=bigdl_slo_requests_total"):
+            resp = ts.debug_endpoint(path)
+            assert resp is not None and resp[0] == 404 \
+                and resp[1].get("gate") == GATE, \
+                f"{path} must 404 naming {GATE} while off, got {resp!r}"
+        resp = alerts.debug_endpoint("/alerts")
+        assert resp is not None and resp[0] == 404 \
+            and resp[1].get("gate") == GATE, \
+            f"/alerts must 404 naming {GATE} while off, got {resp!r}"
+        assert not [t for t in threading.enumerate()
+                    if t.name == ts.TimeSeriesStore.THREAD_NAME], \
+            "disabled mode has a live sampler thread"
+        if obs.enabled():
+            grown = set(obs.render().splitlines()) - lines_before
+            leaked = [g for g in grown
+                      if "bigdl_timeseries" in g or "bigdl_alerts" in g]
+            assert not leaked, \
+                f"disabled mode grew time-series series: {leaked}"
+        out["disabled_mode"] = "structurally absent"
+
+        # --- part 2: the storm, plane + alert engine on -----------------
+        conf.set(GATE, "true")
+        # park the wall-clock sampler: every sample below is a manual
+        # fake-clock tick, and a stray real-time sample (ts ~ 1.7e9)
+        # would evict the whole fake-ts ring through retention
+        conf.set("bigdl.observability.timeseries.interval", "3600")
+        conf.set("bigdl.observability.flight.enabled", "true")
+        rules = [{"name": RULE, "kind": "burn_rate", "slo": "ttft",
+                  "short": 6.0, "long": 12.0, "factor": 5.0}]
+        conf.set("bigdl.observability.alerts.rules", _json.dumps(rules))
+        assert ts.enabled
+
+        model = LlamaForCausalLM.from_config(LlamaConfig.tiny(), seed=0,
+                                             max_cache_len=128)
+        rs = np.random.RandomState(seed)
+        n_storm = 2 if smoke else 3
+        prompts = [rs.randint(0, 250, 10 + 2 * j).astype(np.int32)
+                   for j in range(n_storm)]
+
+        was_enabled = rel.enabled()
+        if not was_enabled:
+            rel.enable()
+        s1 = LLMServer(model, max_batch=2, max_seq_len=64, page_size=8,
+                       kvcache=True, slo=True).start()
+        s2 = LLMServer(model, max_batch=2, max_seq_len=64, page_size=8,
+                       kvcache=True, slo=True).start()
+        w1 = LLMWorker(s1, role="decode").start()
+        w2 = LLMWorker(s2, role="decode").start()
+        router = LLMRouter([], [w1.address, w2.address], failover=True,
+                           failover_attempts=8, start_prober=False,
+                           slo=True).start()
+        try:
+            st = ts.store()
+            eng = alerts.engine()
+            assert st is not None and eng is not None, \
+                "plane on but acquire() built no store/engine"
+            assert [r["name"] for r in eng.rules] == [RULE], \
+                "declarative rules override did not replace built-ins"
+            assert [t for t in threading.enumerate()
+                    if t.name == ts.TimeSeriesStore.THREAD_NAME], \
+                "plane on but no sampler thread"
+
+            # warm every storm shape on both engines (resume re-prefills
+            # through the partial-prefill shape; an unwarmed compile
+            # would smear real seconds into the TTFT the storm asserts)
+            for srv in (s1, s2):
+                for p in prompts:
+                    srv.submit(p, max_new_tokens=1).get(timeout=600)
+                    srv.submit(p, max_new_tokens=1).get(timeout=600)
+
+            ev_before = _alert_events()
+            tr_before = {s: _trans(s) for s in ("firing", "resolved")}
+
+            def serve(p):
+                stt, body = post(router.address, "/worker_generate",
+                                 {"prompt_ids": [int(t) for t in p],
+                                  "max_new_tokens": new_tokens})
+                assert stt == 200, body
+
+            # clean phase: fast traffic, rule must stay inactive
+            st.sample_now(now=0.0)
+            for p in prompts[:2]:
+                serve(p)
+            st.sample_now(now=2.0)
+            st.sample_now(now=4.0)
+            assert eng.firing() == [], \
+                f"clean traffic fired {eng.firing()}"
+
+            # the storm: a mid-stream dispatch kill (failover resumes
+            # it) + per-step delays pushing every TTFT past the 500 ms
+            # objective on both the engine and the router scope
+            plan = rel.FaultPlan(seed=seed)
+            plan.add("router.dispatch", "raise", times=1, after=1)
+            plan.add("llm.step", "delay", times=None, delay=0.6)
+            rel.set_plan(plan)
+            try:
+                for p in prompts:
+                    serve(p)
+            finally:
+                rel.set_plan(None)
+            fired_at = st.sample_now(now=6.0)
+            assert RULE in eng.firing(), \
+                "fast-burn rule not firing on the first evaluation " \
+                f"after the storm: {eng.status()}"
+            out["fired_at"] = fired_at
+            out["events_fired"] = [f"{s}:{a}" for s, a in plan.fired]
+
+            # live surfaces while firing (the HTTP arms default `now`
+            # to wall clock, so the windows must reach back to the
+            # fake-clock sample timestamps)
+            stt, body = get(w1.address, "/alerts")
+            assert stt == 200 and RULE in body["firing"], body
+            q = quote('bigdl_slo_requests_total{slo="ttft",'
+                      'verdict="violated"}', safe="")
+            stt, body = get(router.address,
+                            f"/metrics/query?series={q}&window=1e15"
+                            "&fn=delta")
+            assert stt == 200 and (body["value"] or 0) > 0, body
+            stt, body = get(router.address,
+                            "/fleet/timeline?series="
+                            "bigdl_slo_requests_total&window=1e15")
+            assert stt == 200 and body["merged"], body
+            if obs.enabled():
+                assert (obs.REGISTRY.sample_value("bigdl_alerts_firing")
+                        or 0) >= 1, "bigdl_alerts_firing gauge not set"
+
+            # storm deltas still inside both windows: one clean sample
+            # must NOT flap the alert off (the long window's job)
+            serve(prompts[0])
+            st.sample_now(now=8.0)
+            assert RULE in eng.firing(), \
+                "alert flapped off while the storm was in-window"
+
+            # recovery: windows drain past the storm; clean traffic
+            # between the next ticks evaluates to zero burn
+            st.sample_now(now=30.0)
+            for p in prompts[:2]:
+                serve(p)
+            st.sample_now(now=32.0)
+            assert eng.firing() == [], \
+                f"alert did not resolve after recovery: {eng.status()}"
+            rule_st = [r for r in eng.status()["rules"]
+                       if r["name"] == RULE][0]
+            assert rule_st["state"] == "resolved", rule_st
+
+            # the reconciliation: transitions == flight events, EXACTLY
+            ev_delta = {k: _alert_events()[k] - ev_before[k]
+                        for k in ev_before}
+            tr_delta = {s: _trans(s) - tr_before[s]
+                        for s in ("firing", "resolved")}
+            assert ev_delta == {"fire": 1, "resolve": 1}, \
+                f"flight alert events off: {ev_delta}"
+            if obs.enabled():
+                assert tr_delta == {"firing": 1.0, "resolved": 1.0}, \
+                    f"transition counters off: {tr_delta}"
+                out["transitions"] = tr_delta
+            out["alert_events"] = ev_delta
+            out["sample_overhead_us"] = st.status()["sample_overhead_us"]
+        finally:
+            rel.set_plan(None)
+            if not was_enabled:
+                rel.disable()
+            router.stop()
+            w1.stop()
+            w2.stop()
+            s1.stop()
+            s2.stop()
+
+        # --- part 3: autoscaler decision identity -----------------------
+        # same synthesized restart-free trace through (a) a live
+        # FleetController reading the WindowedCounter primitive and
+        # (b) a replay of the old summed max(total-last, 0) formula —
+        # pressure/idle/action must be IDENTICAL tick for tick
+        class _StubRouter:
+            def __init__(self):
+                self._pool_lock = threading.Lock()
+                self.decode_workers = [("stub", 1), ("stub", 2)]
+
+        def _sig(sheds_by, queue, active, workers):
+            return {"workers": workers, "queue": queue, "active": active,
+                    "inflight": 0, "sheds": sum(sheds_by.values()),
+                    "sheds_by": dict(sheds_by), "occupancy_max": 0.0,
+                    "queue_interactive": 0.0, "parked_by": {}}
+
+        trace = [
+            _sig({"a:1": 0.0, "b:1": 0.0}, 0.0, 1.0, 2),
+            _sig({"a:1": 2.0, "b:1": 0.0}, 0.0, 1.0, 2),  # sheds grew
+            _sig({"a:1": 2.0, "b:1": 3.0}, 5.0, 1.0, 2),  # grew + queue
+            _sig({"a:1": 2.0, "b:1": 3.0}, 0.0, 1.0, 2),  # flat
+            _sig({"a:1": 2.0}, 0.0, 0.0, 1),              # b departs flat
+            _sig({"a:1": 2.0}, 0.0, 0.0, 1),              # idle, n == min
+        ]
+        ctl = FleetController(_StubRouter(), min_workers=1,
+                              max_workers=4, sustain=2, cooldown=0.0,
+                              queue_high=2.0, idle_low=0.0)
+        it = iter(trace)
+        ctl.signals = lambda: next(it)
+        for _ in trace:
+            ctl.tick()
+        legacy = []
+        last_sum = None
+        hot = cold = 0
+        for sig in trace:
+            total = sum(sig["sheds_by"].values())
+            delta = 0.0 if last_sum is None \
+                else max(total - last_sum, 0.0)
+            last_sum = total
+            n = sig["workers"]
+            pressure = (sig["queue"] > ctl.queue_high * max(n, 1)
+                        or delta > 0
+                        or (n > 0 and sig["occupancy_max"] > 0.9)
+                        or (ctl.pressure_interactive
+                            and sig["queue_interactive"]
+                            > ctl.queue_high))
+            idle = (sig["queue"] + sig["active"]
+                    + sig["inflight"]) <= ctl.idle_low
+            if pressure:
+                hot += 1
+                cold = 0
+            elif idle:
+                cold += 1
+                hot = 0
+            else:
+                hot = cold = 0
+            action = "none"
+            if pressure and hot >= ctl.sustain and n < ctl.max_workers:
+                action = "scale_out"
+                hot = 0
+            elif idle and cold >= ctl.sustain and n > ctl.min_workers:
+                action = "scale_in"
+                cold = 0
+            legacy.append({"shed_delta": delta, "pressure": pressure,
+                           "idle": idle, "action": action})
+        got = [{k: d[k] for k in ("shed_delta", "pressure", "idle",
+                                  "action")} for d in ctl.decisions]
+        if got != legacy:
+            raise AssertionError(
+                "autoscaler diverged from the legacy shed-delta "
+                f"formula on a restart-free trace:\n new={got}\n "
+                f"old={legacy}")
+        assert [d["action"] for d in got].count("scale_out") == 1, got
+        # where the primitive intentionally differs: a member restart
+        # is a reset for THAT member (its post-restart count is the
+        # delta), not a clamp that swallows every other member's sheds
+        wc = ts.WindowedCounter()
+        assert wc.observe({"m": 10.0}) == 0.0
+        assert wc.observe({"m": 14.0}) == 4.0
+        assert wc.observe({"m": 3.0}) == 3.0
+        out["autoscaler_decisions"] = "identical"
+    finally:
+        for k, v in prev.items():
+            if v is None:
+                conf.unset(k)
+            else:
+                conf.set(k, v)
+        ts.reset()
+        alerts.reset()
+    out["match"] = True
+    return out
+
+
 def run_fleet_chaos(seed: int = 0, smoke: bool = False) -> dict:
     """ISSUE 15 acceptance: the elastic-fleet soak. A fleet-enabled
     router (autoscaler + graceful drain) over a
@@ -1753,6 +2115,8 @@ def run_all_chaos(seed: int = 0) -> dict:
                          ("preempt", lambda: run_preempt_chaos(
                              seed=seed, smoke=True)),
                          ("elastic", lambda: run_elastic_chaos(
+                             seed=seed, smoke=True)),
+                         ("alerts", lambda: run_alerts_chaos(
                              seed=seed, smoke=True))):
             try:
                 out[name] = fn()
@@ -1831,11 +2195,21 @@ def main():
                          "epoch must recover via the supervisor with "
                          "final weights bit-identical to the clean "
                          "run (ISSUE 10)")
+    ap.add_argument("--alerts", action="store_true",
+                    help="run the time-series/alerting pass: a seeded "
+                         "failover storm must flip the fast-burn SLO "
+                         "alert to firing within one evaluation "
+                         "interval and resolve after recovery, with "
+                         "transitions reconciling exactly against "
+                         "flight alert_fire/alert_resolve events, the "
+                         "autoscaler making identical decisions "
+                         "through the store primitive, and disabled "
+                         "mode structurally absent (ISSUE 18)")
     ap.add_argument("--all", action="store_true",
                     help="run every chaos suite (train, kvcache, "
                          "kvtier, mixed, failover, fleet, preempt, "
-                         "elastic) and report one record per pass "
-                         "(the bench.py chaos_all block)")
+                         "elastic, alerts) and report one record per "
+                         "pass (the bench.py chaos_all block)")
     ap.add_argument("--cpu", action="store_true",
                     help="force the CPU backend (sitecustomize pins the "
                          "axon TPU platform; env vars are ineffective)")
@@ -1851,6 +2225,8 @@ def main():
         return
     if args.elastic:
         out = run_elastic_chaos(seed=args.seed)
+    elif args.alerts:
+        out = run_alerts_chaos(seed=args.seed)
     elif args.preempt:
         out = run_preempt_chaos(seed=args.seed)
     elif args.flight:

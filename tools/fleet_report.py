@@ -15,6 +15,12 @@ Three entry points:
 
       python tools/fleet_report.py snapA.json snapB.json [--json]
 
+- **Fleet timeline** (ISSUE 18): per-member + merged series over time
+  from a time-series-enabled router/supervisor's ``/fleet/timeline``::
+
+      python tools/fleet_report.py --timeline 127.0.0.1:8000 \\
+          --series bigdl_llm_decode_tokens_total [--window 300]
+
 - **Library** (``run_fleet_micro``): spin up two tiny decode workers
   behind a failover router with federation + SLO accounting on, route
   a small request mix, and return the merged sketch percentiles
@@ -315,10 +321,78 @@ def run_fleet_micro(n_requests: int = 6, new_tokens: int = 4) -> Dict:
         s2.stop()
 
 
+def fetch_timeline(addr: Tuple[str, int], series: str,
+                   window: Optional[float] = None) -> dict:
+    """One ``GET /fleet/timeline`` roundtrip → the timeline document.
+    Raises with the body's error on non-200 (404 names the gate)."""
+    from urllib.parse import quote
+    path = f"/fleet/timeline?series={quote(series, safe='')}"
+    if window is not None:
+        path += f"&window={window}"
+    st, raw = _http_get(addr, path)
+    body = json.loads(raw.decode() or "{}")
+    if st != 200:
+        raise RuntimeError(
+            f"{addr[0]}:{addr[1]}{path} answered {st}: "
+            f"{body.get('error', '?')} — is "
+            "bigdl.observability.timeseries.enabled on?")
+    return body
+
+
+def timeline_report(doc: dict, as_json: bool = False) -> dict:
+    """Render one ``/fleet/timeline`` document: a sparkline row per
+    member plus the merged series."""
+    if as_json:
+        print(json.dumps(doc))
+        return doc
+    from tools.telemetry_report import sparkline
+    rows = []
+    for inst, pts in sorted(doc.get("instances", {}).items()):
+        vals = [v for _, v in pts]
+        rows.append([inst, len(pts),
+                     vals[0] if vals else None,
+                     vals[-1] if vals else None,
+                     sparkline(vals)])
+    merged = doc.get("merged", [])
+    mvals = [v for _, v in merged]
+    rows.append(["MERGED", len(merged),
+                 mvals[0] if mvals else None,
+                 mvals[-1] if mvals else None, sparkline(mvals)])
+    _print_table(
+        f"fleet timeline: {doc.get('series')} "
+        f"({doc.get('samples', 0)} samples)",
+        ["instance", "points", "first", "last", "trend"], rows)
+    return doc
+
+
 def main(argv: List[str]) -> int:
     as_json = "--json" in argv
     if "--micro" in argv:
         print(json.dumps(run_fleet_micro()))
+        return 0
+    if "--timeline" in argv:
+        def _opt(flag, default=None):
+            if flag in argv:
+                i = argv.index(flag)
+                if i + 1 < len(argv):
+                    return argv[i + 1]
+            return default
+        target = _opt("--timeline")
+        series = _opt("--series")
+        if not target or not series:
+            print("--timeline host:port needs --series name",
+                  file=sys.stderr)
+            return 2
+        host, port = target.replace("http://", "").split(":")
+        window = _opt("--window")
+        try:
+            doc = fetch_timeline(
+                (host, int(port)), series,
+                window=float(window) if window else None)
+        except RuntimeError as e:
+            print(str(e), file=sys.stderr)
+            return 1
+        timeline_report(doc, as_json=as_json)
         return 0
     if "--url" in argv:
         i = argv.index("--url")

@@ -389,36 +389,13 @@ def registry_sketch_snapshot(name: str) -> Optional[dict]:
 def sketch_window(before: Optional[dict], after: Optional[dict],
                   qs=(0.5, 0.95, 0.99)) -> Dict[float, Optional[float]]:
     """Quantiles of the samples observed BETWEEN two snapshots of one
-    cumulative sketch. Bucket counts only grow, so the bucket-wise
-    difference is itself a valid sketch of exactly the window's
-    samples."""
-    from bigdl_tpu.observability.sketch import QuantileSketch
-    if after is None:
-        return {q: None for q in qs}
-    if before is None:
-        return QuantileSketch.from_snapshot(after).quantiles(qs)
-    delta = {
-        "alpha": after["alpha"],
-        "gamma": after["gamma"],
-        "zero": int(after.get("zero", 0)) - int(before.get("zero", 0)),
-        "count": int(after.get("count", 0))
-        - int(before.get("count", 0)),
-        "sum": float(after.get("sum", 0.0))
-        - float(before.get("sum", 0.0)),
-        # min/max cannot be windowed; the after-run envelope is the
-        # honest conservative stand-in (quantiles read buckets only)
-        "min": after.get("min"),
-        "max": after.get("max"),
-        "buckets": {},
-    }
-    bb = before.get("buckets", {})
-    for k, c in after.get("buckets", {}).items():
-        d = int(c) - int(bb.get(k, 0))
-        if d > 0:
-            delta["buckets"][k] = d
-    if delta["count"] <= 0:
-        return {q: None for q in qs}
-    return QuantileSketch.from_snapshot(delta).quantiles(qs)
+    cumulative sketch. The subtraction itself moved into the
+    time-series plane (ISSUE 18) — this is the shared, tested
+    implementation; the thin alias here keeps the loadgen call sites
+    and their importers unchanged."""
+    from bigdl_tpu.observability.timeseries import (
+        sketch_window as _sketch_window)
+    return _sketch_window(before, after, qs)
 
 
 def run_fleet_soak(n_requests: int = 8, qps: float = 100.0,

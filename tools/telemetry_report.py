@@ -24,6 +24,12 @@ CLI:
         # one request's flight-recorder decision timeline + verdict
         # (ISSUE 16): from a saved /debug/explain or /debug/flight
         # JSON, or — with no path — the in-process flight ring
+    python tools/telemetry_report.py --watch host:port \
+            --series bigdl_llm_queue_depth[,more...] \
+            [--fn last] [--window 60] [--interval 2] [--count N]
+        # live terminal sparklines over GET /metrics/query (ISSUE 18):
+        # one row per series, redrawn every --interval seconds against
+        # a worker/router/supervisor with the time-series plane on
 
 The registry summary (library use) carries the live utilization gauges
 (``bigdl_device_mfu`` / ``bigdl_device_hbm_bw_gbps`` /
@@ -252,8 +258,112 @@ def summarize_explain(request_id: str,
     return flight.explain(request_id)
 
 
+# ---------------------------------------------------------------------------
+# live watch over /metrics/query (ISSUE 18)
+# ---------------------------------------------------------------------------
+
+SPARK_CHARS = "▁▂▃▄▅▆▇█"
+
+
+def sparkline(values: List[Optional[float]], width: int = 40) -> str:
+    """Terminal sparkline of the last ``width`` values; ``None`` (no
+    data in the window yet) renders as a gap."""
+    vals = list(values)[-width:]
+    known = [v for v in vals if v is not None]
+    if not known:
+        return " " * len(vals)
+    lo, hi = min(known), max(known)
+    span = (hi - lo) or 1.0
+    out = []
+    for v in vals:
+        if v is None:
+            out.append(" ")
+        else:
+            idx = int((v - lo) / span * (len(SPARK_CHARS) - 1))
+            out.append(SPARK_CHARS[idx])
+    return "".join(out)
+
+
+def parse_target(target: str):
+    """``host:port`` → (host, int(port))."""
+    host, _, port = target.rpartition(":")
+    return (host or "127.0.0.1", int(port))
+
+
+def query_value(addr, series: str, fn: str = "last",
+                window: float = 60.0,
+                timeout: float = 2.0) -> Optional[float]:
+    """One ``GET /metrics/query`` roundtrip → the windowed value
+    (None = empty window). Raises on HTTP errors — a 404 means the
+    target's time-series plane is off, and the watcher should say so
+    instead of drawing blanks."""
+    import http.client
+    from urllib.parse import quote
+    path = (f"/metrics/query?series={quote(series, safe='')}"
+            f"&fn={quote(fn)}&window={window}")
+    conn = http.client.HTTPConnection(addr[0], addr[1], timeout=timeout)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        body = json.loads(resp.read().decode() or "{}")
+        if resp.status != 200:
+            raise RuntimeError(
+                f"{addr[0]}:{addr[1]}{path} answered {resp.status}: "
+                f"{body.get('error', '?')}")
+        return body.get("value")
+    finally:
+        conn.close()
+
+
+def run_watch(target: str, series: List[str], fn: str = "last",
+              window: float = 60.0, interval: float = 2.0,
+              count: Optional[int] = None, width: int = 40,
+              out=print) -> int:
+    """Poll ``/metrics/query`` and redraw one sparkline row per series
+    until interrupted (or for ``count`` rounds — the tests' hook)."""
+    addr = parse_target(target)
+    history: Dict[str, List[Optional[float]]] = {s: [] for s in series}
+    rounds = 0
+    import time as _time
+    while count is None or rounds < count:
+        if rounds:
+            _time.sleep(interval)
+        rounds += 1
+        for s in series:
+            try:
+                val = query_value(addr, s, fn=fn, window=window)
+            except Exception as e:   # noqa: BLE001 — show, keep going
+                out(f"{s}: {e}")
+                continue
+            h = history[s]
+            h.append(val)
+            del h[:-width]
+            out(f"{s}  {fn}/{window:g}s  "
+                f"last={_fmt(val)}  {sparkline(h, width)}")
+    return 0
+
+
 def main(argv: List[str]) -> int:
     as_json = "--json" in argv
+    if "--watch" in argv:
+        def _opt(flag, default=None):
+            if flag in argv:
+                i = argv.index(flag)
+                if i + 1 < len(argv):
+                    return argv[i + 1]
+            return default
+        target = _opt("--watch")
+        series = [s for s in (_opt("--series") or "").split(",") if s]
+        if not target or not series:
+            print("--watch host:port needs --series name[,name...]",
+                  file=sys.stderr)
+            return 2
+        count = _opt("--count")
+        return run_watch(
+            target, series, fn=_opt("--fn", "last"),
+            window=float(_opt("--window", "60")),
+            interval=float(_opt("--interval", "2")),
+            count=int(count) if count is not None else None)
     trace_id = None
     if "--trace" in argv:
         i = argv.index("--trace")
