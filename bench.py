@@ -917,6 +917,21 @@ def _compact_northstar(out: dict) -> dict:
             "chunks": (xb.get("mixed_on") or {}).get("chunks"),
             "p99_ratio": xb.get("itl_p99_ratio_off_on"),
         }
+    # ISSUE 19: self-speculative decoding headline — batch-1 tok/s with
+    # drafts verified in bulk vs plain decode, the accepted-tokens-per-
+    # tick the ROADMAP bar is stated in, and the bit-parity verdict
+    sb = ((ex.get("telemetry") or {}).get("spec_decode") or {})
+    if "error" in sb:
+        ns["spec_decode"] = {"error": str(sb["error"])[:80]}
+    else:
+        ns["spec_decode"] = {
+            "tok_s_off": (sb.get("spec_off") or {}).get("tokens_per_s"),
+            "tok_s_on": (sb.get("spec_on") or {}).get("tokens_per_s"),
+            "accepted_per_tick": sb.get("accepted_tokens_per_tick"),
+            "accept_rate": sb.get("accept_rate"),
+            "speedup": sb.get("tokens_per_s_ratio"),
+            "bit_identical": sb.get("bit_identical"),
+        }
     return {"metric": out["metric"], "value": out["value"],
             "unit": out["unit"], "vs_baseline": out.get("vs_baseline"),
             "extra": {"northstar_summary": ns,
@@ -1020,6 +1035,15 @@ def _telemetry_block() -> dict:
             prompt_len=192, stream_tokens=24)
     except Exception as e:
         out["mixed_dispatch"] = {"error": repr(e)}
+    try:
+        # ISSUE 19: self-speculative decoding on/off — batch-1 tok/s on
+        # a repetitive-suffix workload, accepted-tokens/tick and the
+        # bit-parity verdict (bench_regress diffs spec.tokens_per_s /
+        # spec.accept_rate and the off/on itl_p99 pair)
+        from tools.microbench_decode import run_spec_bench
+        out["spec_decode"] = run_spec_bench(tokens=48)
+    except Exception as e:
+        out["spec_decode"] = {"error": repr(e)}
     try:
         # ISSUE 12: the fleet telemetry plane — two live workers behind
         # a federation+SLO router; merged sketch percentiles

@@ -153,6 +153,14 @@ CONF_KEYS.update({
         "Retry-After seconds added per queued request",
     "bigdl.llm.role":
         "worker role: '' unified, 'prefill' or 'decode' side of the KV handoff",
+    "bigdl.llm.spec.enabled":
+        "model-free self-speculative decoding (n-gram drafts + fused verify); false = structurally absent",
+    "bigdl.llm.spec.k":
+        "speculative draft-token ceiling per engine tick",
+    "bigdl.llm.spec.min_match":
+        "shortest suffix n-gram the proposer trusts for a draft",
+    "bigdl.llm.spec.backoff":
+        "acceptance-rate EMA floor below which the live draft length halves",
     "bigdl.llm.watchdog.step_timeout":
         "engine watchdog: a stalled step flips /healthz and fails retriably; 0 = off",
     "bigdl.device.peak.gbps":
@@ -356,6 +364,12 @@ METRICS.update({
         "Requests waiting for an engine slot, by SLO class (priority scheduler only)",
     "bigdl_llm_requests_total":
         "Requests finished by the engine",
+    "bigdl_llm_spec_accepted_tokens_total":
+        "Draft tokens accepted by the speculative verify pass",
+    "bigdl_llm_spec_passes_total":
+        "Engine passes that carried a speculative verify chunk",
+    "bigdl_llm_spec_proposed_tokens_total":
+        "Draft tokens dispatched to speculative verify",
     "bigdl_llm_ttft_seconds":
         "Engine time to first token (submit to first drained token), mergeable quantile sketch",
     "bigdl_llm_watchdog_trips_total":
@@ -487,6 +501,8 @@ SPAN_NAMES.update({
         "LLMWorker HTTP request envelope",
     "llm/route":
         "LLMRouter dispatch envelope (prefill+decode legs)",
+    "llm/spec_step":
+        "completion: one speculative pass (decode rows + a verify chunk)",
     "llm/watchdog_trip":
         "completion: engine watchdog declared a stall",
     "router/failover":
@@ -536,6 +552,8 @@ FAULT_SITES.update({
         "between chunks of one chunked admission (ISSUE 14)",
     "llm.preempt":
         "before a victim's KV chain is exported (ISSUE 17)",
+    "llm.spec":
+        "between drafting and the verify dispatch (ISSUE 19)",
     "llm.step":
         "LLM engine decode step",
     "llm.submit":
@@ -593,6 +611,11 @@ FEATURE_GATES.update({
         "package": None,            # tuning knob of the mixed gate
         "desc": "chunk size for the unified dispatch (0 = 4 pages); "
                 "read only when bigdl.llm.mixed.enabled"},
+    "bigdl.llm.spec.enabled": {
+        "package": "bigdl_tpu/llm/spec.py",
+        "desc": "model-free self-speculative decoding (n-gram drafts "
+                "+ fused verify); off = no proposer state, no "
+                "bigdl_llm_spec_* series"},
     "bigdl.observability.enabled": {
         "package": None,            # pervasive: runtime-gated via _state
         "desc": "metrics + spans; no-op instruments when off"},
@@ -732,6 +755,8 @@ PYTEST_MARKERS.update({
         "fleet telemetry plane tests (sketches, federation, SLO accounting)",
     "slow":
         "excluded from the tier-1 gate (-m 'not slow')",
+    "spec":
+        "self-speculative decoding tests (ISSUE 19)",
     "timeseries":
         "time-series plane tests (windowed store, alert engine, "
         "timelines)",

@@ -65,6 +65,46 @@ def fence_token(*arrays):
     return acc.astype(jnp.int32)[None]
 
 
+def spec_accept(ctoks, chunk_logits, n_draft):
+    """Fused speculative verify-accept (ISSUE 19), greedy exact-match.
+
+    ``ctoks`` (W,) int32 is the verify chunk — the on-device greedy
+    token ``g0`` followed by ``n_draft`` host drafts (zero-padded to the
+    bucket width W); ``chunk_logits`` (W, V) f32 are the ragged chunk
+    leg's logits, where row ``j`` is the distribution AFTER consuming
+    chunk token ``j`` (i.e. it predicts position ``offset + j + 1``).
+    Draft ``j`` (= ``ctoks[j]``, j >= 1) is accepted iff it equals
+    ``argmax(chunk_logits[j-1])`` — exactly the token greedy decode
+    would have emitted there — and every earlier draft was accepted.
+
+    Returns ``(n_acc, new_last)``: the emitted-token count (the
+    accepted-draft prefix plus the always-valid ``g0``, so
+    ``1 <= n_acc <= n_draft + 1``) and ``chunk_logits[n_acc - 1]`` —
+    the distribution following the LAST emitted token, which becomes
+    the row's ``last`` for the next engine step. With zero drafts
+    accepted this degenerates to a plain decode step: emit ``g0``,
+    carry ``chunk_logits[0]``.
+
+    Pad rows (``j >= n_draft``) can never match (the arange mask), so
+    garbage logits at padded positions — finite by the kernels'
+    masked-lane contract — cannot extend the accepted prefix.
+
+    Greedy only: the rejection-sampling acceptance rule for
+    ``temperature > 0`` hangs off this same contract (replace the
+    exact-match test with the p/q coin flip) but is gated off with the
+    engine's ``do_sample`` path for now.
+    """
+    w = ctoks.shape[0]
+    greedy = jnp.argmax(chunk_logits, axis=-1).astype(jnp.int32)  # (W,)
+    match = (ctoks[1:] == greedy[:-1]) & \
+        (jnp.arange(w - 1, dtype=jnp.int32) < n_draft)
+    # longest all-accepted prefix: cumprod zeroes everything after the
+    # first rejection, the sum counts the survivors
+    n_acc = jnp.sum(jnp.cumprod(match.astype(jnp.int32))) + 1
+    new_last = jnp.take(chunk_logits, n_acc - 1, axis=0)
+    return n_acc.astype(jnp.int32), new_last.astype(jnp.float32)
+
+
 def make_sampled_step(fam_step):
     """Lift a family ``paged_decode_step`` (toks-in, logits-out) into the
     pipelined engine's step shape (logits-in, sampled-ids-out).
@@ -98,6 +138,12 @@ def make_sampled_step(fam_step):
         logits, k_pages, v_pages = fam_step(
             params, cfg, k_pages, v_pages, bt_eff, lens_eff, toks,
             page=page)
+        # inactive rows carry their previous logits forward instead of
+        # the trash-page garbage their masked leg computed: a row
+        # sitting out passes while its speculative verify is in flight
+        # (ISSUE 19) must find its ``last`` intact at the drain, and an
+        # empty slot's lane was never read either way
+        logits = jnp.where(active[:, None], logits, last)
         new_lens = lens + active.astype(lens.dtype)
         out = jnp.concatenate(
             [toks, fence_token(k_pages, v_pages, logits)])

@@ -31,7 +31,8 @@ import threading
 from typing import Any, Dict, List, Optional
 
 from bigdl_tpu.llm.kvcache.pool import PagePool, PagePoolError
-from bigdl_tpu.llm.kvcache.prefill import make_partial_prefill
+from bigdl_tpu.llm.kvcache.prefill import (make_partial_prefill,
+                                           make_spec_step)
 from bigdl_tpu.llm.kvcache.radix import PrefixMatch, RadixIndex
 
 
@@ -566,4 +567,5 @@ class KVCacheManager:
 
 
 __all__ = ["Admission", "KVCacheManager", "PagePool", "PagePoolError",
-           "PrefixMatch", "RadixIndex", "make_partial_prefill"]
+           "PrefixMatch", "RadixIndex", "make_partial_prefill",
+           "make_spec_step"]

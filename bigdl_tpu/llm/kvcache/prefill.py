@@ -182,6 +182,89 @@ def make_mixed_step(fam_step, fam_ragged):
     return mixed_step
 
 
+def make_spec_step(fam_step, fam_ragged):
+    """Lift a family ``(paged_decode_step, paged_prefill_ragged)`` pair
+    into the engine's SPECULATIVE verify step (ISSUE 19).
+
+    The verify chunk is the mixed step's chunk leg re-aimed at decode:
+    instead of prompt tokens, the ``(1, W)`` chunk carries the row's
+    next greedy token followed by the host's n-gram drafts, run at the
+    row's position offset — logits for all chunk positions come back
+    from ONE dispatch, and :func:`kernels.sampling.spec_accept` keeps
+    the prefix greedy decode would have produced anyway. Structure:
+
+    - **chunk token 0 is computed on device**: ``g0 = argmax(last
+      [srow])`` — exactly the token the sampled decode leg would have
+      emitted for the row. With every draft rejected the step therefore
+      degenerates to a plain decode step for the row (emit ``g0``,
+      whose K/V the chunk leg wrote at position ``lens[srow]``, carry
+      ``chunk_logits[0]``), preserving the engine invariant that every
+      emitted token has its K/V in the pool and ``last`` predicts the
+      next position;
+    - the **chunk leg** is the family's ``paged_prefill_ragged``
+      VERBATIM (``full_logits=True``) at offset ``lens[srow]`` over the
+      row's own block table — no COW fork (a decode row's tail pages
+      are private by the admission contract), padding past
+      ``n_draft + 1`` routed to trash page 0 by the host's scatter
+      targets;
+    - the **decode leg** is the family's ``make_sampled_step`` body
+      with the spec row masked INACTIVE (trash-page dummy write, like
+      an empty slot) — every other active row advances exactly as in a
+      plain pass;
+    - the accepted length then advances the spec row's device length by
+      ``n_acc`` and splices ``chunk_logits[n_acc - 1]`` into the
+      ``last`` carry. K/V written for the REJECTED tail positions is
+      rolled back by length bookkeeping alone: attention reads only
+      positions ``< lens``, and later steps overwrite the garbage slots
+      as the row advances (docs/KVCACHE.md "Speculative charging").
+
+    Returns ``(out, logits, k_pages, v_pages, new_lens, key)`` where
+    ``out`` is ``(B + 1 + W + 1,)`` int32: the decode rows' sampled ids
+    (the spec row's lane is garbage — the host skips it), ``n_acc``,
+    the W chunk tokens (the host needs ``g0`` back — it was never on
+    the host), and one :func:`kernels.sampling.fence_token` bounding
+    both legs' pool writes. Compile-relevant shapes: batch width and
+    the chunk bucket ``ctoks.shape[1]`` only — ``srow``, ``n_draft``,
+    offsets and scatter targets are runtime data, so speculation adds
+    O(k-buckets) programs total.
+    """
+    from bigdl_tpu.llm.kernels.sampling import (fence_token,
+                                                make_sampled_step,
+                                                spec_accept)
+    sampled = make_sampled_step(fam_step)
+
+    def spec_step(params, cfg, k_pages, v_pages, bt, lens, last, active,
+                  temperature, key, srow, ctoks, n_draft, cbt_row,
+                  cphys, cslots, *, page: int, do_sample: bool = False,
+                  top_k: int = 0):
+        b = lens.shape[0]
+        rows = jnp.arange(b, dtype=jnp.int32)
+        onehot = rows == srow
+        slast = jnp.take(last, srow, axis=0)                    # (V,)
+        g0 = jnp.argmax(slast).astype(jnp.int32)
+        ctoks = ctoks.at[0, 0].set(g0)
+        clen = (n_draft + 1).astype(jnp.int32)
+        coff = jnp.take(lens, srow).astype(jnp.int32)
+        k_pages, v_pages, chunk_logits = fam_ragged(
+            params, cfg, k_pages, v_pages, ctoks, clen, coff, cbt_row,
+            cphys, cslots, jnp.int32(0), jnp.int32(0), page=page,
+            full_logits=True)
+        n_acc, new_slast = spec_accept(ctoks[0], chunk_logits, n_draft)
+        out, logits, k_pages, v_pages, new_lens, key = sampled(
+            params, cfg, k_pages, v_pages, bt, lens, last,
+            active & ~onehot, temperature, key, page=page,
+            do_sample=do_sample, top_k=top_k)
+        new_lens = new_lens + jnp.where(onehot, n_acc,
+                                        0).astype(new_lens.dtype)
+        logits = jnp.where(onehot[:, None], new_slast[None, :], logits)
+        out = jnp.concatenate(
+            [out[:b], n_acc[None], ctoks[0],
+             fence_token(k_pages, v_pages, logits)])
+        return out, logits, k_pages, v_pages, new_lens, key
+
+    return spec_step
+
+
 def make_partial_prefill(forward_fn, init_cache_fn):
     """Lift a family ``forward``/``init_cache`` pair into the engine's
     partial-prefill shape.

@@ -893,7 +893,8 @@ paged_prefill_partial = make_partial_prefill(forward, init_cache)
 
 def paged_prefill_ragged(params, cfg, k_pages, v_pages, toks, length,
                          offset, bt_row, phys, slots, fork_dst,
-                         fork_src, *, page: int):
+                         fork_src, *, page: int,
+                         full_logits: bool = False):
     """Ragged in-place prefill (ISSUE 8): the suffix tokens run through
     the llama layer math while attention reads the cached prefix
     DIRECTLY from the page pool (llm/kernels/ragged_prefill.py) — no
@@ -904,7 +905,10 @@ def paged_prefill_ragged(params, cfg, k_pages, v_pages, toks, length,
     scan. ``bt_row`` (pages_cap,), ``offset``/``length`` and the
     ``phys``/``slots`` scatter targets are all runtime data — the only
     compile-relevant shape is the suffix bucket ``toks.shape[1]``.
-    Returns ``(k_pages, v_pages, last_logits (V,) f32)``."""
+    Returns ``(k_pages, v_pages, last_logits (V,) f32)``; with
+    ``full_logits=True`` (the speculative verify leg, ISSUE 19) the
+    logits for ALL bucket positions come back as ``(bucket, V)`` f32
+    instead — a trace-time branch, so the default trace is unchanged."""
     from bigdl_tpu.llm.kvcache.prefill import (fork_tail_pages,
                                                ragged_prefill_attend,
                                                scatter_suffix_kv)
@@ -952,6 +956,8 @@ def paged_prefill_ragged(params, cfg, k_pages, v_pages, toks, length,
         logits = _linear(head, x)
     k_pages, v_pages = scatter_suffix_kv(k_pages, v_pages, phys, slots,
                                          k_new, v_new)
+    if full_logits:
+        return k_pages, v_pages, logits[0].astype(jnp.float32)
     last = jax.lax.dynamic_index_in_dim(logits[0], length - 1, 0,
                                         keepdims=False)
     return k_pages, v_pages, last.astype(jnp.float32)
@@ -974,6 +980,24 @@ def paged_step_mixed(params, cfg, k_pages, v_pages, bt, lens, last,
         params, cfg, k_pages, v_pages, bt, lens, last, active,
         temperature, key, ctoks, clen, coff, cbt_row, cphys, cslots,
         fork_dst, fork_src, page=page, do_sample=do_sample, top_k=top_k)
+
+
+def paged_step_spec(params, cfg, k_pages, v_pages, bt, lens, last,
+                    active, temperature, key, srow, ctoks, n_draft,
+                    cbt_row, cphys, cslots, *, page: int,
+                    do_sample: bool = False, top_k: int = 0):
+    """Speculative verify engine step (ISSUE 19): one compiled program
+    whose batch carries every active decode row PLUS one row's draft
+    tokens run as a verify chunk with fused greedy accept — the
+    composition of :func:`serving.paged_decode_step` (sampled) and
+    :func:`paged_prefill_ragged` (``full_logits=True``), see
+    :func:`bigdl_tpu.llm.kvcache.prefill.make_spec_step`."""
+    from bigdl_tpu.llm.kvcache.prefill import make_spec_step
+    from bigdl_tpu.llm.serving import paged_decode_step
+    return make_spec_step(paged_decode_step, paged_prefill_ragged)(
+        params, cfg, k_pages, v_pages, bt, lens, last, active,
+        temperature, key, srow, ctoks, n_draft, cbt_row, cphys, cslots,
+        page=page, do_sample=do_sample, top_k=top_k)
 
 
 # ---------------------------------------------------------------------------

@@ -244,13 +244,15 @@ paged_prefill_partial = make_partial_prefill(forward, init_cache)
 
 def paged_prefill_ragged(params, cfg, k_pages, v_pages, toks, length,
                          offset, bt_row, phys, slots, fork_dst,
-                         fork_src, *, page: int):
+                         fork_src, *, page: int,
+                         full_logits: bool = False):
     """Ragged in-place prefill (ISSUE 8) — StarCoder's layer math
     (learned position embeddings, MQA via the kernel's GQA grouping,
     sequential residual, tied head) over the suffix tokens, attention
     reading the cached prefix in place; COW fork + one post-scan
     scatter fused into the same dispatch (see llama.paged_prefill_ragged
-    for the structure)."""
+    for the structure and the ``full_logits`` speculative-verify
+    variant)."""
     from bigdl_tpu.llm.kvcache.prefill import (fork_tail_pages,
                                                ragged_prefill_attend,
                                                scatter_suffix_kv)
@@ -294,6 +296,8 @@ def paged_prefill_ragged(params, cfg, k_pages, v_pages, toks, length,
     logits = x @ params["wte"].T.astype(x.dtype)
     k_pages, v_pages = scatter_suffix_kv(k_pages, v_pages, phys, slots,
                                          k_new, v_new)
+    if full_logits:
+        return k_pages, v_pages, logits[0].astype(jnp.float32)
     last = jax.lax.dynamic_index_in_dim(logits[0], length - 1, 0,
                                         keepdims=False)
     return k_pages, v_pages, last.astype(jnp.float32)
@@ -312,6 +316,20 @@ def paged_step_mixed(params, cfg, k_pages, v_pages, bt, lens, last,
         params, cfg, k_pages, v_pages, bt, lens, last, active,
         temperature, key, ctoks, clen, coff, cbt_row, cphys, cslots,
         fork_dst, fork_src, page=page, do_sample=do_sample, top_k=top_k)
+
+
+def paged_step_spec(params, cfg, k_pages, v_pages, bt, lens, last,
+                    active, temperature, key, srow, ctoks, n_draft,
+                    cbt_row, cphys, cslots, *, page: int,
+                    do_sample: bool = False, top_k: int = 0):
+    """Speculative verify step (ISSUE 19) — the StarCoder decode and
+    full-logits ragged-chunk legs fused with the greedy accept kernel
+    (see :func:`bigdl_tpu.llm.kvcache.prefill.make_spec_step`)."""
+    from bigdl_tpu.llm.kvcache.prefill import make_spec_step
+    return make_spec_step(paged_decode_step, paged_prefill_ragged)(
+        params, cfg, k_pages, v_pages, bt, lens, last, active,
+        temperature, key, srow, ctoks, n_draft, cbt_row, cphys, cslots,
+        page=page, do_sample=do_sample, top_k=top_k)
 
 
 class StarCoderForCausalLM(CausalLMFacade):

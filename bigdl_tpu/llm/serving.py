@@ -551,7 +551,9 @@ class LLMServer:
                  mixed: Optional[bool] = None,
                  chunk_tokens: Optional[int] = None,
                  chunk_wait: Optional[float] = None,
-                 priority: Optional[bool] = None):
+                 priority: Optional[bool] = None,
+                 spec: Optional[bool] = None,
+                 spec_k: Optional[int] = None):
         import inspect
 
         from bigdl_tpu.llm.models.llama import forward, init_cache
@@ -573,6 +575,7 @@ class LLMServer:
             self._fam_partial_prefill = _llama_mod.paged_prefill_partial
             self._fam_ragged_prefill = _llama_mod.paged_prefill_ragged
             self._fam_mixed_step = _llama_mod.paged_step_mixed
+            self._fam_spec_step = _llama_mod.paged_step_spec
             self._family = "llama"
         else:
             self._fam_forward = fam_forward
@@ -592,6 +595,8 @@ class LLMServer:
                 fam_mod, "paged_prefill_ragged", None)
             self._fam_mixed_step = getattr(
                 fam_mod, "paged_step_mixed", None)
+            self._fam_spec_step = getattr(
+                fam_mod, "paged_step_spec", None)
             self._family = fam_mod.__name__.rsplit(".", 1)[-1]
             if paged and self._fam_paged_step is None:
                 raise NotImplementedError(
@@ -661,6 +666,17 @@ class LLMServer:
         self.mixed_passes = 0
         self._mixed_ins = None
         self._chunk_rr = 0
+        self._spec_rr = 0
+        # self-speculative decoding accounting (ISSUE 19, always-on
+        # plain ints): draft tokens proposed/accepted, tokens emitted
+        # by spec passes (accepted drafts + the bonus token) and the
+        # verify-pass count — tools/microbench_decode.py computes
+        # accepted-tokens-per-tick from these without observability
+        self.spec_proposed_total = 0
+        self.spec_accepted_total = 0
+        self.spec_emitted_total = 0
+        self.spec_passes = 0
+        self._spec_ins = None
         # ISSUE 3 flight recorder: every jit entry point of the engine
         # is wrapped so compiles/recompiles (the per-length prefill
         # buckets, a batch-width drift on the decode step) are counted,
@@ -771,6 +787,40 @@ class LLMServer:
             # engine structurally identical to the split one
             self._chunk_state: Optional[List[Optional[dict]]] = (
                 [None] * max_batch if self._mixed_active else None)
+            # model-free self-speculative decoding (ISSUE 19): a pass
+            # may carry one row's n-gram drafts as a verify chunk and
+            # emit up to k+1 tokens for it (llm/spec.py + the family's
+            # paged_step_spec). Needs the ragged in-place path (the
+            # verify chunk IS a ragged chunk) and greedy sampling (the
+            # accept rule is exact-match; the rejection-sampling hook
+            # for temperature > 0 is gated off). Disabled (the
+            # default) is structurally absent: no proposer state, no
+            # bigdl_llm_spec_* series, no new code on the step path.
+            sp = (spec if spec is not None else
+                  conf.get_bool("bigdl.llm.spec.enabled", False))
+            if sp and self._do_sample:
+                raise ValueError(
+                    "bigdl.llm.spec is greedy-only (temperature == 0): "
+                    "the rejection-sampling verify hook for sampled "
+                    "decode is gated off")
+            self._spec_active = (bool(sp) and self._ragged
+                                 and self._fam_spec_step is not None)
+            self._spec_state: Optional[List[Optional[dict]]] = (
+                [None] * max_batch if self._spec_active else None)
+            # slots whose in-flight spec verify has not drained: their
+            # host lens advance is data-dependent (accepted length), so
+            # they sit out dispatch until the record retires
+            self._spec_pending: set = set()
+            if self._spec_active:
+                from bigdl_tpu.llm.spec import NGramProposer
+                self._spec_proposer_cls = NGramProposer
+                self._spec_k = max(1, int(
+                    spec_k if spec_k is not None else
+                    conf.get_int("bigdl.llm.spec.k", 4)))
+                self._spec_min_match = max(1, conf.get_int(
+                    "bigdl.llm.spec.min_match", 2))
+                self._spec_backoff = conf.get_float(
+                    "bigdl.llm.spec.backoff", 0.5)
             self._kv = KVCacheManager(self._num_pages, page_size,
                                       enabled=bool(kv_on))
             # host spill tier (ISSUE 6): constructed ONLY when enabled —
@@ -846,6 +896,13 @@ class LLMServer:
                 raise ValueError("priority scheduling is page-pool "
                                  "only; lossless preemption needs the "
                                  "paged KV chain to park and resume")
+            if spec:
+                raise ValueError("self-speculative decoding is "
+                                 "page-pool only; the verify chunk is "
+                                 "a ragged chunk over pool pages")
+            self._spec_active = False
+            self._spec_state = None
+            self._spec_pending = set()
             self._sched = None
             self._parked = None
             self._preempt_rec = None
@@ -2576,6 +2633,212 @@ class LLMServer:
         self._record_mixed_pass(len(disp), cargs, t_step)
         return self._after_dispatch(rec, t_step)
 
+    # -- self-speculative decoding (ISSUE 19) --------------------------------
+    def _spec_instruments(self):
+        """Speculation counters — None unless the spec gate is live AND
+        observability records. ``bigdl.llm.spec.enabled`` off must
+        leave no ``bigdl_llm_spec_*`` series (the disabled-mode
+        absence contract)."""
+        if not (self._spec_active and obs.enabled()):
+            return None
+        if self._spec_ins is None:
+            self._spec_ins = {
+                "proposed": obs.counter(
+                    "bigdl_llm_spec_proposed_tokens_total",
+                    "Draft tokens dispatched to speculative verify"),
+                "accepted": obs.counter(
+                    "bigdl_llm_spec_accepted_tokens_total",
+                    "Draft tokens accepted by speculative verify"),
+                "passes": obs.counter(
+                    "bigdl_llm_spec_passes_total",
+                    "Engine passes carrying a speculative verify "
+                    "chunk"),
+            }
+        return self._spec_ins
+
+    def _spec_proposer(self, i: int, req: Request):
+        """Slot ``i``'s draft proposer, (re)created lazily per request
+        — the adaptive-k state (acceptance EMA, live draft length) is
+        the request's own, so a new occupant starts optimistic."""
+        st = self._spec_state[i]
+        if st is None or st["req"] is not req:
+            st = self._spec_state[i] = {
+                "req": req,
+                "prop": self._spec_proposer_cls(
+                    k=self._spec_k, min_match=self._spec_min_match,
+                    backoff=self._spec_backoff)}
+        return st["prop"]
+
+    def _prepare_spec(self) -> Optional[dict]:
+        """Pick one decode row whose token history predicts its future
+        and draft for it. None = no row proposes this pass (or the
+        ``llm.spec`` fault fired) — the pass degrades to plain decode,
+        bit-identically.
+
+        Two-phase on purpose: drafting needs the row's EXACT emitted
+        history and length, which at depth > 1 are only current after
+        the in-flight window drains — but draining costs the pipeline
+        overlap. So a cheap pre-check proposes on the possibly-stale
+        context first, and only a hit pays the drain (then re-proposes
+        on the now-exact context). Zero-match rows keep full
+        pipelining."""
+        cand = None
+        start = self._spec_rr % self.max_batch
+        for i in (list(range(start, self.max_batch))
+                  + list(range(start))):
+            req = self._slots[i]
+            if req is None or req.cancel_requested:
+                continue
+            if i in self._spec_pending or self._remaining[i] < 2:
+                continue
+            if self._chunk_state is not None and \
+                    self._chunk_state[i] is not None:
+                continue     # mid-prompt chunked admission: not a
+                             # decode row yet
+            prop = self._spec_proposer(i, req)
+            ids = list(map(int, req.prompt_ids)) + \
+                list(map(int, req.tokens))
+            if prop.propose(ids, limit=int(self._remaining[i])):
+                cand = i
+                break
+        if cand is None:
+            return None
+        # ISSUE 19 fault site: a ``raise`` between drafting and
+        # dispatch drops the drafts on the floor — the pass runs as
+        # plain decode, so outputs stay bit-identical (chaos_check
+        # --spec proves it); a ``delay`` models a slow host proposer
+        try:
+            reliability.inject("llm.spec")
+        except Exception:
+            return None
+        while self._inflight:
+            self._drain_next()
+        i = cand
+        req = self._slots[i]
+        if req is None or req.cancel_requested \
+                or self._remaining[i] < 2 or i in self._spec_pending:
+            return None       # the drain finished/cancelled the row
+        prop = self._spec_proposer(i, req)
+        ids = list(map(int, req.prompt_ids)) + \
+            list(map(int, req.tokens))
+        # the proposal's FIRST token is the proposer's guess at the
+        # very next token — a position the compiled step fills with
+        # the device-computed bonus token g0 instead (the host never
+        # sees g0 before dispatch; see make_spec_step). The usable
+        # drafts are the rest; emitted <= len(proposal) <= remaining.
+        proposal = prop.propose(ids, limit=int(self._remaining[i]))
+        drafts = proposal[1:]
+        if not drafts:
+            return None
+        self._spec_rr = i + 1
+        clen = len(drafts) + 1
+        bucket = max(2, 1 << (clen - 1).bit_length())   # pow2 ladder
+        pos0 = int(self._lens[i])
+        end = pos0 + clen
+        page = self._page
+        p_have = -(-pos0 // page)
+        return {"i": i, "req": req, "drafts": drafts, "clen": clen,
+                "bucket": bucket, "pos0": pos0, "end": end,
+                "p_have": p_have, "n_new": -(-end // page) - p_have,
+                "match": prop.last_match}
+
+    def _build_spec_step(self):
+        """Compile the family's speculative verify step for ONE chunk
+        bucket (the draft operand shape fixes it): the decode leg is
+        the family sampled step VERBATIM, the verify leg the family
+        ragged prefill VERBATIM (full logits) plus the fused accept —
+        see ``kvcache.prefill.make_spec_step``. Row index, drafts,
+        offsets and scatter targets are runtime data, so speculation
+        adds O(k-buckets) programs total (guarded by the
+        compile-recorder test in tests/test_spec_decode.py)."""
+        cfg, page = self.cfg, self._page
+        fam = self._fam_spec_step
+        do_sample, top_k = self._do_sample, self.top_k
+
+        def step(params, k_pages, v_pages, bt, lens, last, active,
+                 temp, key, srow, ctoks, n_draft, cbt_row, cphys,
+                 cslots):
+            return fam(params, cfg, k_pages, v_pages, bt, lens, last,
+                       active, temp, key, srow, ctoks, n_draft,
+                       cbt_row, cphys, cslots, page=page,
+                       do_sample=do_sample, top_k=top_k)
+
+        return obs.compiled(step, name="llm/step_spec",
+                            donate_argnums=(1, 2))
+
+    def _dispatch_spec(self, disp, active, sargs: dict,
+                       t_step: float) -> bool:
+        """One speculative pass (the ISSUE 19 tentpole): every other
+        active decode row advances one token while the chosen row's
+        drafts run as a verify chunk — up to ``n_draft + 1`` tokens
+        for that row through ONE fence. The drain applies the
+        accepted prefix; rejected-tail K/V is rolled back by length
+        bookkeeping alone (docs/KVCACHE.md)."""
+        i, req = sargs["i"], sargs["req"]
+        bucket, clen = sargs["bucket"], sargs["clen"]
+        n_draft = clen - 1
+        page = self._page
+        bt_row = self._bt[i].copy()     # post-grant view: the pages
+        # for [pos0, end) landed in the host table this pass
+        pos = sargs["pos0"] + np.arange(bucket)
+        phys = np.where(pos < sargs["end"],
+                        bt_row[np.minimum(pos // page,
+                                          self._pages_cap - 1)],
+                        0).astype(np.int32)
+        slots = (pos % page).astype(np.int32)
+        toks = np.zeros((1, bucket), np.int32)
+        toks[0, 1:clen] = sargs["drafts"]   # slot 0 = g0, set on
+        # device inside the compiled step
+        ops = (jnp.asarray(i, jnp.int32), jnp.asarray(toks),
+               jnp.asarray(n_draft, jnp.int32), jnp.asarray(bt_row),
+               jnp.asarray(phys), jnp.asarray(slots))
+        ck = self._step_cache_key() + ("spec", bucket,
+                                       self._do_sample, self.top_k)
+        pspec = _PAGED_STEP_CACHE.get(ck)
+        if pspec is None:
+            pspec = _PAGED_STEP_CACHE[ck] = self._build_spec_step()
+        bt_in, lens_in = self._bt_dev, self._lens_dev
+        last_in, key_in = self._last, self._sample_key
+        out, logits, self._k_pages, self._v_pages, self._lens_dev, \
+            self._sample_key = pspec(
+                self.model.params, self._k_pages, self._v_pages,
+                bt_in, lens_in, last_in, active, self._temp, key_in,
+                *ops)
+        self._last = logits
+        for j in disp:
+            self._lens[j] += 1
+            self._remaining[j] -= 1
+        # the spec row's host advance happens at DRAIN — the accepted
+        # length is data on the device — so it sits out dispatch until
+        # its record retires
+        self._spec_pending.add(i)
+        self.spec_proposed_total += n_draft
+        self.spec_passes += 1
+        ins = self._spec_instruments()
+        if ins is not None:
+            ins["proposed"].inc(n_draft)
+            ins["passes"].inc()
+        if flight.enabled:
+            # same site as the proposed counter: the chaos harness
+            # reconciles draft events == counter == proposed_total
+            flight.record(
+                "draft", request_id=req.id, trace_id=_trace_of(req),
+                slot=i, n_draft=n_draft, match_len=sargs["match"],
+                offset=sargs["pos0"])
+        wall = time.perf_counter() - t_step
+        obs.add_complete("llm/spec_step", time.time() - wall, wall,
+                         decode_rows=len(disp), n_draft=n_draft,
+                         slot=i)
+        rec = {"out": out, "fn": "llm/step_spec",
+               "pairs": [(j, self._slots[j]) for j in disp],
+               "spec": {"i": i, "req": req, "n_draft": n_draft,
+                        "bucket": bucket},
+               "refs": (bt_in, lens_in, last_in, active, key_in)
+               + ops,
+               "pinned": self._pending_release}
+        self._pending_release = []
+        return self._after_dispatch(rec, t_step)
+
     def _build_paged_decode(self):
         """One pipelined decode step over the page pool — the family's
         ``paged_decode_step_sampled`` jitted with donated pools:
@@ -2660,9 +2923,14 @@ class LLMServer:
         dispatch budget left. A request gets at most ``max_new_tokens``
         dispatched steps — so speculative dispatches past a data-
         dependent EOS never allocate pages beyond the admission
-        reserve, and a slot whose final step is in flight goes quiet."""
+        reserve, and a slot whose final step is in flight goes quiet.
+        A slot whose spec verify is in flight (ISSUE 19) also sits
+        out: its host length advance is data-dependent (the accepted
+        prefix), so the engine cannot place its next token until the
+        record drains."""
         return [i for i, r in enumerate(self._slots)
-                if r is not None and self._remaining[i] > 0]
+                if r is not None and self._remaining[i] > 0
+                and i not in self._spec_pending]
 
     def _after_dispatch(self, rec: dict, t0: float) -> bool:
         """Shared dispatch epilogue: account host time, push the record
@@ -2715,31 +2983,50 @@ class LLMServer:
                 cancelled += 1
                 continue
             tok = int(vals[i])
-            req.tokens.append(tok)
-            if self._slo is not None:
-                req.t_tokens.append(now)
-            if len(req.tokens) == 1:
-                req.t_first_token = time.perf_counter()  # TTFT stamp
-                if self._slo is not None:
-                    self._slo.observe_ttft(now - req.t_submit)
-                    req.t_last_token = now
-            elif self._slo is not None:
-                gap = now - req.t_last_token
-                req.t_last_token = now
-                if gap > req.itl_max:
-                    req.itl_max = gap
-                self._slo.observe_itl(gap)
             applied += 1
-            if (self.eos_token_id is not None
-                    and tok == self.eos_token_id) \
-                    or len(req.tokens) >= req.max_new_tokens:
-                self._finish_slot(i, req)
+            if self._apply_token(i, req, tok, now):
                 finished += 1
-                if self._slo is not None:
-                    self._slo.finish(
-                        (req.t_first_token - req.t_submit
-                         if req.t_first_token else None),
-                        req.itl_max if req.itl_max >= 0 else None)
+        sp = rec.get("spec")
+        if sp is not None:
+            i, req = sp["i"], sp["req"]
+            self._spec_pending.discard(i)
+            if self._slots[i] is not req:
+                pass     # slot reassigned under us: nothing to apply
+            elif req.cancel_requested:
+                self._finish_slot(i, req)
+                cancelled += 1
+            else:
+                # the accepted-length vector: [B decode ids][n_acc]
+                # [bucket chunk toks][fence]. The host learns BOTH the
+                # bonus token g0 (device-computed, never seen before)
+                # and how many drafts survived from this one fetch.
+                n_acc = int(vals[self.max_batch])
+                self._lens[i] += n_acc       # device twin advanced in
+                self._remaining[i] -= n_acc  # the compiled step
+                st = self._spec_state[i] if self._spec_state else None
+                if st is not None:
+                    st["prop"].observe(sp["n_draft"], n_acc - 1)
+                self.spec_accepted_total += n_acc - 1
+                self.spec_emitted_total += n_acc
+                ins_s = self._spec_instruments()
+                if ins_s is not None:
+                    ins_s["accepted"].inc(n_acc - 1)
+                if flight.enabled:
+                    kind = ("verify_accept"
+                            if n_acc - 1 == sp["n_draft"]
+                            else "verify_reject")
+                    flight.record(
+                        kind, request_id=req.id,
+                        trace_id=_trace_of(req), slot=i,
+                        n_draft=sp["n_draft"], accepted=n_acc - 1,
+                        emitted=n_acc)
+                base = self.max_batch + 1
+                for j in range(n_acc):
+                    applied += 1
+                    if self._apply_token(i, req,
+                                         int(vals[base + j]), now):
+                        finished += 1
+                        break
         if (finished or cancelled) and self.pipeline_depth == 1:
             # strict synchrony at depth 1: the freed-row resets above
             # must resolve before their consumed buffers drop (exactly
@@ -2756,6 +3043,40 @@ class LLMServer:
                             rec.get("host_s", 0.0), stall, finished,
                             cancelled, fn=rec.get("fn"))
 
+    def _apply_token(self, i: int, req: Request, tok: int,
+                     now: float) -> bool:
+        """Append one drained token to ``req`` with the SLO/TTFT
+        stamps, finishing the slot on EOS or budget exhaustion.
+        Returns True when the request finished — the shared tail of
+        the plain decode drain and the speculative accepted-prefix
+        drain (ISSUE 19), which applies up to k+1 tokens per pass
+        through this same path so EOS semantics cannot diverge."""
+        req.tokens.append(tok)
+        if self._slo is not None:
+            req.t_tokens.append(now)
+        if len(req.tokens) == 1:
+            req.t_first_token = time.perf_counter()  # TTFT stamp
+            if self._slo is not None:
+                self._slo.observe_ttft(now - req.t_submit)
+                req.t_last_token = now
+        elif self._slo is not None:
+            gap = now - req.t_last_token
+            req.t_last_token = now
+            if gap > req.itl_max:
+                req.itl_max = gap
+            self._slo.observe_itl(gap)
+        if (self.eos_token_id is not None
+                and tok == self.eos_token_id) \
+                or len(req.tokens) >= req.max_new_tokens:
+            self._finish_slot(i, req)
+            if self._slo is not None:
+                self._slo.finish(
+                    (req.t_first_token - req.t_submit
+                     if req.t_first_token else None),
+                    req.itl_max if req.itl_max >= 0 else None)
+            return True
+        return False
+
     def _finish_slot(self, i: int, req: Request):
         self._emit_decode_span(req)
         if flight.enabled:
@@ -2769,6 +3090,9 @@ class LLMServer:
         req.done.set()
         self._slots[i] = None
         self._remaining[i] = 0
+        if self._spec_state is not None:
+            self._spec_state[i] = None     # proposer state is per
+            # request — the next occupant starts fresh
         if self.paged:
             adm = self._slot_adm[i]
             owned = self._slot_pages[i]
@@ -2834,6 +3158,10 @@ class LLMServer:
             if self._remaining[i] <= 0:
                 continue     # budget exhausted: finishing at the next
                              # drain anyway, eviction would save nothing
+            if i in self._spec_pending:
+                continue     # spec verify in flight: the row's length
+                             # advance is data-dependent, park/export
+                             # bookkeeping would race the drain
             rank = _PRIORITY_RANK[req.priority]
             if rank <= best:
                 continue     # only a STRICTLY lower class is evicted
@@ -2958,6 +3286,22 @@ class LLMServer:
         if cargs is not None and not disp:
             self._dispatch_chunk_solo(cargs, t_step)
             return True
+        sargs = None
+        if cargs is None and ci is None and self._spec_active:
+            # self-speculative pass (ISSUE 19): a pass carries EITHER
+            # a prefill chunk OR one row's verify chunk (chunked
+            # admissions keep priority — TTFT over throughput)
+            sargs = self._prepare_spec()
+            # _prepare_spec may drain the whole in-flight window, and
+            # rows can finish or free at those fences: recompute the
+            # decode set either way (minus the verify row — its
+            # advance is the chunk's, not the decode leg's)
+            si = sargs["i"] if sargs is not None else -1
+            disp = [j for j in self._dispatchable() if j != si]
+            if sargs is None and not disp:
+                if self._inflight:
+                    self._drain_next()
+                return True
         page = self._page
         # the page for position lens[i] must exist before the step; the
         # grant is an incremental scatter into the device-resident block
@@ -2971,8 +3315,10 @@ class LLMServer:
         try:
             boundary = sum(1 for i in disp
                            if int(self._lens[i]) % page == 0)
-            if boundary:
-                self._kv.ensure_free(boundary)
+            need = boundary + (sargs["n_new"] if sargs is not None
+                               else 0)
+            if need:
+                self._kv.ensure_free(need)
             allocs = []
             for i in disp:
                 pos = int(self._lens[i])
@@ -2981,6 +3327,20 @@ class LLMServer:
                     self._bt[i, pos // page] = pid
                     self._slot_pages[i].append(pid)
                     allocs.append((i, pos // page, pid))
+            if sargs is not None:
+                # verify-chunk pages (ISSUE 19): every page covering
+                # [pos0, pos0 + clen) that the row does not own yet —
+                # within the admission worst-case charge (clen <=
+                # remaining), so no extra ledger traffic; a fully
+                # rejected tail leaves them as the row's ordinary
+                # decode pages for later positions
+                si = sargs["i"]
+                for j in range(sargs["n_new"]):
+                    pid = self._kv.take_free()
+                    col = sargs["p_have"] + j
+                    self._bt[si, col] = pid
+                    self._slot_pages[si].append(pid)
+                    allocs.append((si, col, pid))
         except BaseException:
             if cargs is not None:
                 self._restore_chunk_pass(cargs)
@@ -2994,6 +3354,8 @@ class LLMServer:
         mask = np.zeros(self.max_batch, bool)
         mask[disp] = True
         active = jnp.asarray(mask)
+        if sargs is not None:
+            return self._dispatch_spec(disp, active, sargs, t_step)
         if cargs is not None:
             return self._dispatch_mixed(disp, active, cargs, t_step)
         if self._mixed_active:

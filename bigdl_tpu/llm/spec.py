@@ -1,0 +1,143 @@
+"""Model-free self-speculative drafting (ISSUE 19).
+
+The batch-1 decode wall is bandwidth: every engine tick streams the full
+weight set to emit ONE token. Speculative decoding emits several per
+tick — draft k candidate tokens cheaply, verify them all in one pass —
+but the classic recipe needs a second (draft) model resident in HBM.
+This module is the **model-free** variant (prompt-lookup / n-gram
+decoding): the draft source is the request's OWN token history. Match
+the most recent suffix of ``prompt + generated_so_far`` against an
+earlier occurrence of the same n-gram, and propose the tokens that
+followed it. Zero extra device memory, zero extra model bandwidth, and
+on the repetitive workloads serving actually sees (code, templated
+text, retrieval-augmented prompts quoting their own context) the match
+rate is high exactly when the bandwidth win matters.
+
+The proposer is pure host-side python over int token ids — drafting
+runs in the dispatch gap while the device executes the previous step,
+so it adds nothing to the device critical path. Verification is the
+compiled chunk pass (``kvcache.prefill.make_spec_step``): the drafts
+run as a ragged chunk at the row's position offset and a fused accept
+kernel (``kernels.sampling.spec_accept``) keeps the longest prefix that
+greedy decode would have produced anyway — acceptance is exactness, so
+accepted output is bit-identical to the non-speculative engine.
+
+Adaptive k: a per-request proposer tracks an EMA of its draft
+acceptance rate. When it drops below ``bigdl.llm.spec.backoff`` the
+draft length halves (floor 2: one real draft) — a request whose
+history stops
+predicting its future degrades toward plain decode instead of paying a
+wide rejected verify chunk every tick; sustained acceptance grows k
+back to the configured ceiling.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+__all__ = ["NGramProposer"]
+
+
+class NGramProposer:
+    """Per-request prompt-lookup draft proposer with adaptive k.
+
+    ``k`` is the ceiling on drafts per tick (``bigdl.llm.spec.k``),
+    ``min_match`` the shortest suffix n-gram worth trusting
+    (``bigdl.llm.spec.min_match``), ``backoff`` the acceptance-rate EMA
+    floor below which the live draft length halves
+    (``bigdl.llm.spec.backoff``). One instance per engine slot /
+    request — the adaptive state is the request's, not the server's.
+    """
+
+    __slots__ = ("k", "min_match", "max_match", "backoff", "k_live",
+                 "acc_ema", "proposed_total", "accepted_total",
+                 "last_match")
+
+    def __init__(self, k: int = 4, min_match: int = 2,
+                 backoff: float = 0.5, max_match: int = 8):
+        self.k = max(1, int(k))
+        self.min_match = max(1, int(min_match))
+        self.max_match = max(self.min_match, int(max_match))
+        self.backoff = float(backoff)
+        self.k_live = self.k          # adaptive draft length (<= k)
+        self.acc_ema = 1.0            # optimistic start: first tick drafts
+        self.proposed_total = 0
+        self.accepted_total = 0
+        self.last_match = 0           # n-gram length behind the last draft
+
+    def propose(self, ids: Sequence[int],
+                limit: int | None = None) -> List[int]:
+        """Draft up to ``min(k_live, limit)`` continuation tokens for
+        ``ids`` (= prompt + generated so far), or ``[]`` when no suffix
+        n-gram of length >= ``min_match`` recurs earlier in ``ids`` —
+        the engine then degrades this pass to plain decode.
+
+        Longest-match-first, then the most recent occurrence that has a
+        FULL ``kmax``-token continuation after it — the same tie-break
+        prompt-lookup decoding uses, except occurrences too close to
+        the end of ``ids`` (a constant-token run always matches at the
+        second-to-last position, with nothing after it) lose to earlier
+        ones that can actually supply drafts. A proposal shorter than 2
+        tokens is worthless — the engine consumes it as
+        ``proposal[1:]``, the first token targeting the position the
+        verify step fills on device — so the floor is 2.
+        """
+        ids = list(ids)
+        n = len(ids)
+        kmax = self.k_live if limit is None else min(self.k_live,
+                                                    int(limit))
+        if kmax < 1 or n < self.min_match + 1:
+            return []
+        for m in range(min(self.max_match, n - 1),
+                       self.min_match - 1, -1):
+            tail = ids[n - m:]
+            last = tail[-1]
+            best: List[int] = []
+            # j = end index of a candidate EARLIER occurrence; right to
+            # left so the most recent context wins the tie
+            for j in range(n - 2, m - 2, -1):
+                if ids[j] != last or ids[j - m + 1:j + 1] != tail:
+                    continue
+                drafts = ids[j + 1:j + 1 + kmax]
+                if len(drafts) == kmax:
+                    self.last_match = m
+                    return drafts
+                if len(drafts) > len(best):
+                    best = drafts
+            if len(best) >= 2:
+                self.last_match = m
+                return best
+        return []
+
+    def observe(self, proposed: int, accepted: int) -> None:
+        """Fold one verify outcome (``accepted`` of ``proposed`` draft
+        tokens survived) into the acceptance EMA and adapt ``k_live``:
+        below ``backoff`` the draft length halves; an EMA back above
+        the midpoint between ``backoff`` and 1.0 regrows it one step
+        per tick toward ``k``.
+
+        The floor is 2, not 1: the engine consumes a proposal as
+        ``proposal[1:]`` (the first token targets the position the
+        verify step fills with the on-device greedy token), so a
+        1-token proposal carries zero drafts — speculation would shut
+        off permanently, and with no verifies this EMA could never
+        observe a recovery. Floor 2 keeps one real draft in play so a
+        history that turns repetitive again regrows k."""
+        if proposed <= 0:
+            return
+        self.proposed_total += proposed
+        self.accepted_total += accepted
+        rate = accepted / proposed
+        self.acc_ema = 0.5 * self.acc_ema + 0.5 * rate
+        if self.acc_ema < self.backoff:
+            self.k_live = max(min(2, self.k), self.k_live // 2)
+        elif self.acc_ema > (1.0 + self.backoff) / 2.0 and \
+                self.k_live < self.k:
+            self.k_live += 1
+
+    @property
+    def accept_rate(self) -> float:
+        """Lifetime draft acceptance rate (1.0 before any verify)."""
+        if self.proposed_total <= 0:
+            return 1.0
+        return self.accepted_total / self.proposed_total

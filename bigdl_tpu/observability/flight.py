@@ -46,7 +46,7 @@ EVENT_KINDS: Tuple[str, ...] = (
     "fetch", "chunk_charge", "rollback", "shed", "evict", "spill",
     "failover", "hedge", "drain_migrate", "scale_out", "scale_in",
     "preempt", "preempt_resume", "finish", "alert_fire",
-    "alert_resolve",
+    "alert_resolve", "draft", "verify_accept", "verify_reject",
 )
 
 

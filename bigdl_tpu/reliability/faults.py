@@ -61,6 +61,8 @@ SITES = (
     "worker.drain",                # per-chain drain migration (ISSUE 15)
     "llm.preempt",                 # before a victim's KV chain is
                                    # exported (ISSUE 17)
+    "llm.spec",                    # between drafting and the verify
+                                   # dispatch (ISSUE 19)
 )
 
 

@@ -111,6 +111,16 @@ _DEFAULTS: Dict[str, str] = {
     "bigdl.llm.prefill.chunk_tokens": "0",    # 0 = auto (4 pages)
     "bigdl.llm.prefill.chunk.wait": "30.0",   # budget-starved chunk ->
                                               # shed + clean rollback
+    # model-free self-speculative decoding (ISSUE 19): n-gram drafts
+    # from the request's own history verified by a fused chunk pass —
+    # up to k+1 tokens per engine tick, greedy-only, bit-identical
+    # output. false = structurally absent (no proposer state, no
+    # bigdl_llm_spec_* series)
+    "bigdl.llm.spec.enabled": "false",
+    "bigdl.llm.spec.k": "4",           # draft ceiling per tick
+    "bigdl.llm.spec.min_match": "2",   # shortest trusted suffix n-gram
+    "bigdl.llm.spec.backoff": "0.5",   # acceptance EMA floor: below it
+                                       # the live draft length halves
     # SLO-class priority scheduling (ISSUE 17): class-ordered admission
     # + lossless preemption of in-flight decodes (KV exported, request
     # re-queued as prompt+generated with its remaining budget). false =

@@ -89,6 +89,10 @@ def pytest_configure(config):
         "markers",
         "timeseries: time-series plane tests (windowed store, alert "
         "engine, fleet timelines; select with -m timeseries)")
+    config.addinivalue_line(
+        "markers",
+        "spec: self-speculative decoding tests (greedy bit-parity "
+        "matrix, adaptive-k, compile grid; select with -m spec)")
 
 
 @pytest.fixture(scope="session")

@@ -208,6 +208,27 @@ def _flatten_full(rec: dict) -> Dict[str, float]:
         val = _extra_field(xb.get("mixed_on"), field)
         if val is not None:
             flat[f"mixed.{field}"] = val
+    # ISSUE 19: the self-speculative decode microbench — the headline
+    # keys (spec.tokens_per_s / spec.accepted_per_tick / spec.speedup)
+    # carry the ON mode and the on/off ratio; accept_rate drifting down
+    # round over round means the proposer stopped matching (workload or
+    # adaptive-k regression) even if tok/s hasn't moved yet
+    sb = (((rec.get("extra") or {}).get("telemetry") or {})
+          .get("spec_decode") or {})
+    for mode in ("spec_off", "spec_on"):
+        for field in ("tokens_per_s", "itl_p99_ms"):
+            val = _extra_field(sb.get(mode), field)
+            if val is not None:
+                flat[f"{mode}.{field}"] = val
+    val = _extra_field(sb.get("spec_on"), "tokens_per_s")
+    if val is not None:
+        flat["spec.tokens_per_s"] = val
+    for field, key in (("accepted_tokens_per_tick", "accepted_per_tick"),
+                       ("accept_rate", "accept_rate"),
+                       ("tokens_per_s_ratio", "speedup")):
+        val = sb.get(field)
+        if isinstance(val, (int, float)) and not isinstance(val, bool):
+            flat[f"spec.{key}"] = float(val)
     # ISSUE 12: the fleet telemetry plane's merged sketch percentiles —
     # client-visible tail latency through the federated router. A
     # regression in p99 TTFT or inter-token latency between rounds is

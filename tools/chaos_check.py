@@ -378,6 +378,181 @@ def run_mixed_chaos(seed: int = 0, raises: int = 2) -> dict:
     return out
 
 
+def run_spec_chaos(seed: int = 0, raises: int = 2) -> dict:
+    """ISSUE 19 satellite: self-speculative decoding under faults.
+
+    A repetitive-suffix workload (so the n-gram proposer genuinely
+    drafts) is served twice: spec OFF clean, then spec ON with seeded
+    ``llm.spec`` faults armed — the site fires between drafting and
+    the verify dispatch, so a raise must degrade that tick to a plain
+    decode step, never a wrong token. The contract: greedy outputs
+    BIT-IDENTICAL to the spec-off run, the page ledger idle after
+    stop (speculative pages release with the slot), and the
+    proposed/accepted counters reconciling EXACTLY with the flight
+    ``draft``/``verify_accept``/``verify_reject`` events (same call
+    sites — any drift is a forked emission path)."""
+    import numpy as np
+
+    from bigdl_tpu import reliability as rel
+    from bigdl_tpu.llm.models.llama import LlamaConfig, LlamaForCausalLM
+    from bigdl_tpu.llm.serving import LLMServer
+    from bigdl_tpu.observability import flight
+    from bigdl_tpu.utils.conf import conf
+
+    model = LlamaForCausalLM.from_config(LlamaConfig.tiny(), seed=0,
+                                         max_cache_len=128)
+    # the long prompt's pattern is pinned to the seed whose greedy
+    # CONTINUATION cycles (what prompt-lookup drafts from is generated
+    # history, so acceptance needs the output to repeat) — the fault
+    # plan still randomizes on ``seed``
+    pattern = np.random.RandomState(42).randint(0, 250, 5) \
+        .astype(np.int32)
+    rs = np.random.RandomState(seed)
+    prompts = [np.tile(pattern, 6).astype(np.int32),
+               np.concatenate([pattern,
+                               rs.randint(0, 250, 4).astype(np.int32)]),
+               rs.randint(0, 250, 9).astype(np.int32)]
+    new_tokens = [24, 8, 8]
+
+    num_pages = 24
+
+    def serve_all(sp: bool):
+        srv = LLMServer(model, max_batch=2, max_seq_len=64, page_size=8,
+                        num_pages=num_pages, ragged_prefill=True,
+                        spec=sp, spec_k=4).start()
+        try:
+            reqs = [srv.submit(p, max_new_tokens=n)
+                    for p, n in zip(prompts, new_tokens)]
+            outs = [list(map(int, r.get(timeout=300))) for r in reqs]
+        finally:
+            srv.stop()
+        # read AFTER stop: the drain resolved every in-flight verify,
+        # so a nonzero delta is a real page leak
+        return (outs, srv._budget_avail,
+                {"passes": srv.spec_passes,
+                 "proposed": srv.spec_proposed_total,
+                 "accepted": srv.spec_accepted_total,
+                 "emitted": srv.spec_emitted_total})
+
+    def _spec_events():
+        r = flight.ring()
+        evs = r.events() if r is not None else []
+        return {
+            "draft": sum(1 for e in evs if e["kind"] == "draft"),
+            "drafted": sum(e.get("detail", {}).get("n_draft", 0)
+                           for e in evs if e["kind"] == "draft"),
+            "verdicts": sum(1 for e in evs
+                            if e["kind"] in ("verify_accept",
+                                             "verify_reject")),
+            "accepted": sum(e.get("detail", {}).get("accepted", 0)
+                            for e in evs
+                            if e["kind"] in ("verify_accept",
+                                             "verify_reject")),
+            "dropped": r.dropped if r is not None else 0,
+        }
+
+    GATE = "bigdl.observability.flight.enabled"
+    with conf._lock:
+        prev = conf._set_layer.get(GATE)
+    conf.set(GATE, "true")
+    was_enabled = rel.enabled()
+    if not was_enabled:
+        rel.enable()
+    try:
+        clean, clean_budget, _ = serve_all(sp=False)
+        ev_before = _spec_events()
+        c_before = {
+            "proposed": _counter_total(
+                "bigdl_llm_spec_proposed_tokens_total"),
+            "accepted": _counter_total(
+                "bigdl_llm_spec_accepted_tokens_total"),
+        }
+        plan = rel.FaultPlan(seed=seed)
+        # first-match-wins: bounded raises kill a speculative tick
+        # between the draft and its dispatch (degrade to plain decode),
+        # the unbounded delays stretch every other one
+        plan.add("llm.spec", "raise", times=raises, after=1)
+        plan.add("llm.spec", "delay", times=None, delay=0.002)
+        rel.set_plan(plan)
+        try:
+            injected, inj_budget, stats = serve_all(sp=True)
+        finally:
+            rel.set_plan(None)
+        ev_delta = {k: _spec_events()[k] - ev_before[k]
+                    for k in ev_before}
+        c_after = {
+            "proposed": _counter_total(
+                "bigdl_llm_spec_proposed_tokens_total"),
+            "accepted": _counter_total(
+                "bigdl_llm_spec_accepted_tokens_total"),
+        }
+    finally:
+        rel.set_plan(None)
+        if not was_enabled:
+            rel.disable()
+        if prev is None:
+            conf.unset(GATE)
+        else:
+            conf.set(GATE, prev)
+
+    match = injected == clean
+    out = {
+        "seed": seed,
+        "requests": len(prompts),
+        "spec_passes": stats["passes"],
+        "proposed": stats["proposed"],
+        "accepted": stats["accepted"],
+        "clean_idle_budget": clean_budget,
+        "injected_idle_budget": inj_budget,
+        "events_fired": [f"{s}:{a}" for s, a in plan.fired],
+        "flight_events": ev_delta,
+        "match": match,
+    }
+    if stats["passes"] == 0 or stats["accepted"] == 0:
+        raise AssertionError(
+            "spec chaos: the spec-on run never speculated (or never "
+            "accepted a draft) — the workload's continuation is not "
+            "repetitive enough, so the reconciliation is vacuous")
+    if not any(s == "llm.spec" for s, _ in plan.fired):
+        raise AssertionError(
+            "spec chaos armed but no llm.spec fault fired")
+    if inj_budget != clean_budget or inj_budget != num_pages - 1:
+        raise AssertionError(
+            f"spec chaos page leak: idle budget {inj_budget} vs clean "
+            f"{clean_budget} (pool {num_pages - 1})")
+    if not match:
+        raise AssertionError(
+            f"spec chaos divergence under llm.spec faults "
+            f"(fired: {out['events_fired']}): {clean} vs {injected}")
+    if ev_delta["dropped"]:
+        raise AssertionError(
+            "flight ring dropped events mid-check; raise "
+            "bigdl.observability.flight.capacity")
+    # the reconciliation: EXACT — the events are emitted at the same
+    # call sites as the counter increments and the plain-int ledgers
+    if ev_delta["draft"] != stats["passes"] \
+            or ev_delta["verdicts"] != stats["passes"]:
+        raise AssertionError(
+            f"flight draft/verdict events ({ev_delta['draft']}/"
+            f"{ev_delta['verdicts']}) != {stats['passes']} spec passes")
+    if ev_delta["drafted"] != stats["proposed"] \
+            or ev_delta["accepted"] != stats["accepted"]:
+        raise AssertionError(
+            f"flight drafted/accepted token tallies {ev_delta} != "
+            f"engine ledgers {stats}")
+    if c_before["proposed"] is not None:
+        for key in ("proposed", "accepted"):
+            got = c_after[key] - c_before[key]
+            if got != stats[key]:
+                raise AssertionError(
+                    f"bigdl_llm_spec_{key}_tokens_total delta ({got}) "
+                    f"!= engine ledger ({stats[key]})")
+        out["counters_reconciled"] = True
+    else:
+        out["counters_reconciled"] = "obs disabled: ledger-only"
+    return out
+
+
 def run_failover_chaos(seed: int = 0, n_requests: int = 4,
                        kills: int = 2, stalls: int = 1,
                        new_tokens: int = 5,
@@ -2106,6 +2281,7 @@ def run_all_chaos(seed: int = 0) -> dict:
                          ("kvcache", lambda: run_kvcache_chaos(seed=seed)),
                          ("kvtier", lambda: run_kvtier_chaos(seed=seed)),
                          ("mixed", lambda: run_mixed_chaos(seed=seed)),
+                         ("spec", lambda: run_spec_chaos(seed=seed)),
                          ("failover", lambda: run_failover_chaos(
                              seed=seed, smoke=True)),
                          ("flight", lambda: run_flight_chaos(
@@ -2195,6 +2371,14 @@ def main():
                          "epoch must recover via the supervisor with "
                          "final weights bit-identical to the clean "
                          "run (ISSUE 10)")
+    ap.add_argument("--spec", action="store_true",
+                    help="run the self-speculative fault pass: seeded "
+                         "llm.spec raises/delays mid-verify must degrade "
+                         "to plain decode with greedy outputs "
+                         "bit-identical to the clean run, zero page-"
+                         "budget leak, and draft/verify flight events "
+                         "reconciling exactly with the engine ledgers "
+                         "and bigdl_llm_spec_* counters (ISSUE 19)")
     ap.add_argument("--alerts", action="store_true",
                     help="run the time-series/alerting pass: a seeded "
                          "failover storm must flip the fast-burn SLO "
@@ -2208,8 +2392,8 @@ def main():
     ap.add_argument("--all", action="store_true",
                     help="run every chaos suite (train, kvcache, "
                          "kvtier, mixed, failover, fleet, preempt, "
-                         "elastic, alerts) and report one record per "
-                         "pass (the bench.py chaos_all block)")
+                         "spec, elastic, alerts) and report one record "
+                         "per pass (the bench.py chaos_all block)")
     ap.add_argument("--cpu", action="store_true",
                     help="force the CPU backend (sitecustomize pins the "
                          "axon TPU platform; env vars are ineffective)")
@@ -2223,7 +2407,9 @@ def main():
         if not out["ok"]:
             sys.exit(1)
         return
-    if args.elastic:
+    if args.spec:
+        out = run_spec_chaos(seed=args.seed)
+    elif args.elastic:
         out = run_elastic_chaos(seed=args.seed)
     elif args.alerts:
         out = run_alerts_chaos(seed=args.seed)
