@@ -932,6 +932,19 @@ def _compact_northstar(out: dict) -> dict:
             "speedup": sb.get("tokens_per_s_ratio"),
             "bit_identical": sb.get("bit_identical"),
         }
+    # ISSUE 20: OpenAI-gateway headline — streaming TTFT through the
+    # SSE leg vs the native stream, the gateway's added latency, and
+    # the parity tally (must stay 0)
+    ab = ((ex.get("telemetry") or {}).get("openai_api") or {})
+    if "error" in ab:
+        ns["api"] = {"error": str(ab["error"])[:80]}
+    else:
+        ns["api"] = {
+            "ttft_direct_ms": ab.get("ttft_direct_p50_ms"),
+            "ttft_gateway_ms": ab.get("ttft_gateway_p50_ms"),
+            "overhead_ms": ab.get("gateway_overhead_ms"),
+            "mismatches": ab.get("output_mismatches"),
+        }
     return {"metric": out["metric"], "value": out["value"],
             "unit": out["unit"], "vs_baseline": out.get("vs_baseline"),
             "extra": {"northstar_summary": ns,
@@ -1065,6 +1078,16 @@ def _telemetry_block() -> dict:
         out["fleet_elastic"] = run_fleet_soak()
     except Exception as e:
         out["fleet_elastic"] = {"error": repr(e)}
+    try:
+        # ISSUE 20: the OpenAI gateway — client-visible streaming TTFT
+        # through /v1/completions SSE vs the native stream on the same
+        # seeded prompts, and the gateway's added latency. The
+        # output_mismatches tally must pin at 0 (bench_regress diffs
+        # api.ttft_gateway_p50_ms / api.gateway_overhead_ms)
+        from tools.loadgen import run_openai_bench
+        out["openai_api"] = run_openai_bench()
+    except Exception as e:
+        out["openai_api"] = {"error": repr(e)}
     try:
         # ISSUE 18: the time-series plane — windowed-store sampling
         # cost over the live post-bench registry (every series the

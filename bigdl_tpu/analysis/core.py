@@ -80,6 +80,11 @@ class ModuleInfo:
         self.imports: Dict[str, str] = {}
         #: module-level lock variables (name -> lock id)
         self.module_locks: Dict[str, str] = {}
+        #: id(node) -> flat ast.walk list — passes re-traverse the same
+        #: function bodies many times (donation alone walks each ~5×);
+        #: the AST is immutable after parse, so the flat list is safe to
+        #: compute once and share
+        self._walks: Dict[int, List[ast.AST]] = {}
         self._index()
 
     def _index(self):
@@ -100,6 +105,16 @@ class ModuleInfo:
                 name = node.targets[0].id
                 if _is_lock_ctor(node.value):
                     self.module_locks[name] = f"{self.relpath}::{name}"
+
+    def walk(self, node: ast.AST) -> List[ast.AST]:
+        """Cached ``list(ast.walk(node))`` for a subtree of this
+        module. Keyed by ``id(node)`` — sound because every node is
+        kept alive by ``self.tree`` for the ModuleInfo's lifetime."""
+        key = id(node)
+        got = self._walks.get(key)
+        if got is None:
+            got = self._walks[key] = list(ast.walk(node))
+        return got
 
     def segment(self, node: ast.AST) -> str:
         """Source text of a node's line span — the cheap replacement
@@ -335,7 +350,7 @@ def reachable(index: ProjectIndex, roots: Iterable[FuncRef]
         node = index.func_node(ref)
         mod = index.modules[ref.module]
         cinfo = mod.classes.get(ref.cls) if ref.cls else None
-        for sub in ast.walk(node):
+        for sub in mod.walk(node):
             if isinstance(sub, ast.Call):
                 for callee in resolver.resolve(sub, mod, cinfo):
                     if callee not in seen and \

@@ -137,10 +137,10 @@ def _builder_summaries(index: ProjectIndex) -> Dict[FuncRef, DonationSpec]:
     """Functions that RETURN a donating compiled callable."""
     out: Dict[FuncRef, DonationSpec] = {}
     for mod, cinfo, name, node in iter_functions(index):
-        local_defs = {n.name: n for n in ast.walk(node)
+        local_defs = {n.name: n for n in mod.walk(node)
                       if isinstance(n, ast.FunctionDef)}
         returned: Dict[str, DonationSpec] = {}
-        for sub in ast.walk(node):
+        for sub in mod.walk(node):
             if isinstance(sub, ast.Assign) and \
                     isinstance(sub.value, ast.Call):
                 spec = _donation_spec(sub.value, local_defs)
@@ -148,7 +148,7 @@ def _builder_summaries(index: ProjectIndex) -> Dict[FuncRef, DonationSpec]:
                     for tgt in sub.targets:
                         if isinstance(tgt, ast.Name):
                             returned[tgt.id] = spec
-        for sub in ast.walk(node):
+        for sub in mod.walk(node):
             if not isinstance(sub, ast.Return) or sub.value is None:
                 continue
             spec = None
@@ -175,10 +175,10 @@ def _collect_bindings(index: ProjectIndex,
     for mod, cinfo, name, node in iter_functions(index):
         md = out.setdefault(mod.relpath, _ModuleDonations())
         qual = f"{cinfo.name}.{name}" if cinfo else name
-        local_defs = {n.name: n for n in ast.walk(node)
+        local_defs = {n.name: n for n in mod.walk(node)
                       if isinstance(n, ast.FunctionDef)}
         locals_here: Dict[str, DonationSpec] = {}
-        for sub in ast.walk(node):
+        for sub in mod.walk(node):
             if not isinstance(sub, ast.Assign) or \
                     not isinstance(sub.value, ast.Call):
                 continue
@@ -209,7 +209,7 @@ def _collect_bindings(index: ProjectIndex,
         if md is None:
             continue
         qual = f"{cinfo.name}.{name}"
-        for sub in ast.walk(node):
+        for sub in mod.walk(node):
             if not isinstance(sub, ast.Assign) or \
                     not isinstance(sub.value, ast.Attribute) or \
                     not isinstance(sub.value.value, ast.Name) or \
@@ -278,7 +278,7 @@ def run_donation_pass(index: ProjectIndex) -> List[Finding]:
         qual = f"{cinfo.name}.{fname}" if cinfo else fname
         ref_qual = f"{mod.relpath}::{qual}"
         df: Optional[FunctionDataflow] = None
-        for sub in ast.walk(node):
+        for sub in mod.walk(node):
             if not isinstance(sub, ast.Call):
                 continue
             spec = _spec_for_call(sub, qual, cinfo, md)
@@ -427,7 +427,7 @@ def _check_unfenced_drain(emit, index, mod, cinfo, bindings):
     for mname, meth in cinfo.methods.items():
         qual = f"{cinfo.name}.{mname}"
         result_names: Set[str] = set()
-        for sub in ast.walk(meth):
+        for sub in mod.walk(meth):
             if isinstance(sub, ast.Assign) and \
                     isinstance(sub.value, ast.Call) and \
                     _spec_for_call(sub.value, qual, cinfo, md):
@@ -440,7 +440,7 @@ def _check_unfenced_drain(emit, index, mod, cinfo, bindings):
                             if isinstance(e, ast.Name))
         if not result_names:
             continue
-        for sub in ast.walk(meth):
+        for sub in mod.walk(meth):
             if not (isinstance(sub, ast.Call) and
                     isinstance(sub.func, ast.Attribute) and
                     sub.func.attr == "append" and
@@ -470,7 +470,7 @@ def _check_unfenced_drain(emit, index, mod, cinfo, bindings):
         if any(h in src for h in _BARRIER_HINTS):
             continue        # an explicit barrier covers the partial read
         popped: Dict[str, str] = {}     # local -> container attr
-        for sub in ast.walk(meth):
+        for sub in mod.walk(meth):
             if isinstance(sub, ast.Assign) and \
                     isinstance(sub.value, ast.Call) and \
                     isinstance(sub.value.func, ast.Attribute) and \
@@ -484,7 +484,7 @@ def _check_unfenced_drain(emit, index, mod, cinfo, bindings):
                         popped[tgt.id] = sub.value.func.value.attr
         if not popped:
             continue
-        for sub in ast.walk(meth):
+        for sub in mod.walk(meth):
             if not isinstance(sub, ast.Call):
                 continue
             fn = sub.func

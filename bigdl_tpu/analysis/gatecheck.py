@@ -131,7 +131,7 @@ def _gate_derived_names(mod: ModuleInfo,
     """Names/attrs assigned from an expression that mentions one of the
     gate keys — conditions over them count as guarding."""
     derived: Set[str] = set()
-    for node in ast.walk(mod.tree):
+    for node in mod.walk(mod.tree):
         if not isinstance(node, (ast.Assign, ast.AnnAssign)):
             continue
         value = node.value

@@ -112,7 +112,7 @@ def _sync_findings(ref: FuncRef, node: ast.AST,
                    mod: ModuleInfo) -> List[Finding]:
     out: List[Finding] = []
     jaxy = mod.imports_jax()
-    for sub in ast.walk(node):
+    for sub in mod.walk(node):
         if not isinstance(sub, ast.Call):
             continue
         dn = _dotted(sub.func)
@@ -149,21 +149,29 @@ def _sync_findings(ref: FuncRef, node: ast.AST,
 
 def compiled_functions(mod: ModuleInfo) -> List[Tuple[ast.AST, int]]:
     """Local ``def f`` passed to ``obs.compiled(f, ...)`` — the repo's
-    jit entry points. Returns (fn node, compiled-call line)."""
-    out = []
-    # map def name -> node per enclosing scope, nearest-definition wins
-    for scope in ast.walk(mod.tree):
-        if not isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef,
-                                  ast.Module)):
-            continue
-        local_defs = {n.name: n for n in getattr(scope, "body", [])
-                      if isinstance(n, ast.FunctionDef)}
-        for sub in ast.walk(scope):
-            if isinstance(sub, ast.Call) and \
-                    _call_name(sub.func) == "compiled" and sub.args and \
-                    isinstance(sub.args[0], ast.Name) and \
-                    sub.args[0].id in local_defs:
-                out.append((local_defs[sub.args[0].id], sub.lineno))
+    jit entry points. Returns (fn node, compiled-call line). One
+    recursive descent carrying the scope stack (nearest definition
+    wins) — re-walking every scope's whole subtree per scope made this
+    quadratic in nesting depth."""
+    out: List[Tuple[ast.AST, int]] = []
+
+    def visit(node: ast.AST, scopes):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Module)):
+            scopes = scopes + [{n.name: n for n in node.body
+                                if isinstance(n, ast.FunctionDef)}]
+        if isinstance(node, ast.Call) and \
+                _call_name(node.func) == "compiled" and node.args and \
+                isinstance(node.args[0], ast.Name):
+            for local_defs in reversed(scopes):
+                fn = local_defs.get(node.args[0].id)
+                if fn is not None:
+                    out.append((fn, node.lineno))
+                    break
+        for child in ast.iter_child_nodes(node):
+            visit(child, scopes)
+
+    visit(mod.tree, [])
     return out
 
 
@@ -173,7 +181,7 @@ def _compiled_fn_findings(mod: ModuleInfo) -> List[Finding]:
         params = {a.arg for a in list(fn.args.args) +
                   list(fn.args.kwonlyargs)} - _STATIC_PARAM_NAMES
         qual = f"{mod.relpath}::{fn.name}@{fn.lineno}"
-        for sub in ast.walk(fn):
+        for sub in mod.walk(fn):
             if isinstance(sub, (ast.If, ast.While)):
                 traced = [n.id for n in ast.walk(sub.test)
                           if isinstance(n, ast.Name) and n.id in params]

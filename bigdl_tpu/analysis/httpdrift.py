@@ -106,7 +106,7 @@ def extract_routes(index: ProjectIndex) -> List[Route]:
         return routes[k]
 
     for rel, mod in index.modules.items():
-        for cls_node in ast.walk(mod.tree):
+        for cls_node in mod.walk(mod.tree):
             if not isinstance(cls_node, ast.ClassDef):
                 continue
             for meth in cls_node.body:
@@ -157,7 +157,7 @@ def extract_clients(index: ProjectIndex) -> Dict[str, Tuple[str, int]]:
     """{path: first (file, line)} of in-tree client call sites."""
     out: Dict[str, Tuple[str, int]] = {}
     for rel, mod in index.modules.items():
-        for node in ast.walk(mod.tree):
+        for node in mod.walk(mod.tree):
             if not isinstance(node, ast.Call):
                 continue
             fn = node.func

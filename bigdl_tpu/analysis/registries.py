@@ -87,6 +87,12 @@ CONF_KEYS.update({
         "host:port; '' = ring-only",
     "bigdl.engine.type":
         "'' = auto (jax.default_backend)",
+    "bigdl.llm.api.chat_template":
+        "chat-template family for /v1/chat/completions: plain | llama | chatglm",
+    "bigdl.llm.api.enabled":
+        "OpenAI-compatible /v1/* gateway with SSE streaming; false = routes 404, structurally absent",
+    "bigdl.llm.api.tokenizer":
+        "gateway tokenizer: '' = token-id prompts only, 'byte' = deterministic utf-8 byte tokenizer",
     "bigdl.llm.failover.enabled":
         "router journals in-flight requests and resumes on another backend",
     "bigdl.llm.failover.max.attempts":
@@ -236,6 +242,9 @@ METRICS.update({
         "Recording-rule outputs, one series per rule",
     "bigdl_alerts_transitions_total":
         "Alert state-machine transitions by rule and new state",
+    "bigdl_api_requests_total":
+        "OpenAI gateway requests by route and outcome "
+        "(ok/shed/invalid/error/disconnect)",
     "bigdl_build_info":
         "Constant 1; the build identity lives in the labels",
     "bigdl_cluster_serving_batch_size":
@@ -461,6 +470,8 @@ METRICS.update({
 })
 
 SPAN_NAMES.update({
+    "api/request":
+        "one OpenAI gateway request, translation through final chunk",
     "elastic/flush":
         "durable snapshot flush (elastic training, process 0)",
     "federation/scrape":
@@ -583,6 +594,11 @@ FEATURE_GATES.update({
     "bigdl.elastic.enabled": {
         "package": "bigdl_tpu/elastic",
         "desc": "elastic training: supervisor/agent/snapshot ring"},
+    "bigdl.llm.api.enabled": {
+        "package": "bigdl_tpu/llm/api",
+        "desc": "OpenAI-compatible /v1/* gateway + SSE relay from the "
+                "failover journal drain; off = routes 404 naming the "
+                "gate, no bigdl_api_* series"},
     "bigdl.llm.failover.enabled": {
         "package": "bigdl_tpu/llm/failover.py",
         "desc": "router journal + prober + resume machinery"},
@@ -640,6 +656,15 @@ FEATURE_GATES.update({
 })
 
 HTTP_ENDPOINTS.update({
+    "/v1/chat/completions": {
+        "methods": ("POST",), "gate": "bigdl.llm.api.enabled",
+        "desc": "OpenAI chat completions (templated), blocking or SSE"},
+    "/v1/completions": {
+        "methods": ("POST",), "gate": "bigdl.llm.api.enabled",
+        "desc": "OpenAI text completions, blocking or SSE stream"},
+    "/v1/models": {
+        "methods": ("GET",), "gate": "bigdl.llm.api.enabled",
+        "desc": "OpenAI model list (the one served model)"},
     "/alerts": {
         "methods": ("GET",),
         "gate": "bigdl.observability.timeseries.enabled",
@@ -729,6 +754,8 @@ HTTP_ENDPOINTS.update({
 })
 
 PYTEST_MARKERS.update({
+    "api":
+        "OpenAI-compatible gateway tests (translation, SSE, parity)",
     "analysis":
         "static-analysis suite tests (passes, baseline, lockwatch)",
     "chaos":

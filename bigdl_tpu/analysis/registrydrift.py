@@ -85,11 +85,11 @@ def _callee(func: ast.AST) -> str:
     return ""
 
 
-def _docstring_nodes(tree: ast.AST) -> Set[int]:
+def _docstring_nodes(mod) -> Set[int]:
     """id()s of Constant nodes that are docstrings — excluded from the
     literal scan (prose mentioning a key is not a use of it)."""
     out: Set[int] = set()
-    for node in ast.walk(tree):
+    for node in mod.walk(mod.tree):
         if isinstance(node, (ast.Module, ast.ClassDef, ast.FunctionDef,
                              ast.AsyncFunctionDef)):
             body = getattr(node, "body", [])
@@ -100,25 +100,49 @@ def _docstring_nodes(tree: ast.AST) -> Set[int]:
     return out
 
 
+#: every first-sighting dict on _Literals, for the per-module merge
+_LIT_FIELDS = ("conf", "metric_decl", "metric_use", "span",
+               "span_prefix", "site_inject", "site_inject_prefix",
+               "site_arm", "marks")
+
+
+def _module_literals(mod, rel: str) -> _Literals:
+    """One module's literal harvest, cached on the ModuleInfo — the
+    enforce and usage indexes are filtered views over the SAME parsed
+    modules, so without the cache every shared module is scanned
+    twice per run."""
+    cached = getattr(mod, "_registry_literals", None)
+    if cached is not None:
+        return cached
+    lits = _Literals()
+    docstrings = _docstring_nodes(mod)
+    is_source = rel in _SOURCE_FILES
+    for node in mod.walk(mod.tree):
+        if isinstance(node, ast.Constant) and \
+                isinstance(node.value, str) and \
+                id(node) not in docstrings and not is_source:
+            if _CONF_RE.match(node.value):
+                _first(lits.conf, node.value, rel, node.lineno)
+        if isinstance(node, ast.Call):
+            _scan_call(node, rel, lits)
+        if isinstance(node, ast.Attribute) and \
+                isinstance(node.value, ast.Attribute) and \
+                isinstance(node.value.value, ast.Name) and \
+                node.value.value.id == "pytest" and \
+                node.value.attr == "mark":
+            _first(lits.marks, node.attr, rel, node.lineno)
+    mod._registry_literals = lits
+    return lits
+
+
 def collect_literals(index: ProjectIndex) -> _Literals:
     lits = _Literals()
     for rel, mod in index.modules.items():
-        docstrings = _docstring_nodes(mod.tree)
-        is_source = rel in _SOURCE_FILES
-        for node in ast.walk(mod.tree):
-            if isinstance(node, ast.Constant) and \
-                    isinstance(node.value, str) and \
-                    id(node) not in docstrings and not is_source:
-                if _CONF_RE.match(node.value):
-                    _first(lits.conf, node.value, rel, node.lineno)
-            if isinstance(node, ast.Call):
-                _scan_call(node, rel, lits)
-            if isinstance(node, ast.Attribute) and \
-                    isinstance(node.value, ast.Attribute) and \
-                    isinstance(node.value.value, ast.Name) and \
-                    node.value.value.id == "pytest" and \
-                    node.value.attr == "mark":
-                _first(lits.marks, node.attr, rel, node.lineno)
+        mlits = _module_literals(mod, rel)
+        for fname in _LIT_FIELDS:
+            dst = getattr(lits, fname)
+            for key, where in getattr(mlits, fname).items():
+                _first(dst, key, *where)
     return lits
 
 

@@ -1,0 +1,29 @@
+"""OpenAI-compatible serving gateway (ISSUE 20).
+
+Gated by ``bigdl.llm.api.enabled`` (default off): the worker/router
+construct :class:`~bigdl_tpu.llm.api.gateway.OpenAIGateway` only when
+the gate is on — off means ``/v1/*`` answers 404 naming the gate, no
+``bigdl_api_*`` series exist, and nothing in this package runs.
+
+Modules: :mod:`~bigdl_tpu.llm.api.gateway` (translation + dispatch),
+:mod:`~bigdl_tpu.llm.api.sse` (SSE framing, both sides of the wire),
+:mod:`~bigdl_tpu.llm.api.templates` (tokenizer protocol + per-family
+chat templates), :mod:`~bigdl_tpu.llm.api.errors` (OpenAI error
+objects). See ``docs/API.md`` for the wire contract.
+"""
+
+from bigdl_tpu.llm.api.errors import (ApiError, InvalidRequestError,
+                                      RateLimitError, UpstreamError)
+from bigdl_tpu.llm.api.gateway import (EngineBackend, OpenAIGateway,
+                                       StopMatcher)
+from bigdl_tpu.llm.api.sse import parse_sse, sse_done, sse_event
+from bigdl_tpu.llm.api.templates import (ByteTokenizer,
+                                         apply_chat_template,
+                                         build_tokenizer)
+
+__all__ = [
+    "ApiError", "InvalidRequestError", "RateLimitError",
+    "UpstreamError", "EngineBackend", "OpenAIGateway", "StopMatcher",
+    "parse_sse", "sse_done", "sse_event", "ByteTokenizer",
+    "apply_chat_template", "build_tokenizer",
+]

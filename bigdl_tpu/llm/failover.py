@@ -45,12 +45,26 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 # Request journal
 # ---------------------------------------------------------------------------
 
+class StreamAbort(Exception):
+    """Raised *through* a decode stream to tear it down without a
+    failover retry (ISSUE 20): the OpenAI gateway's journal listener
+    raises it when the SSE client hung up (``client_gone=True`` — the
+    request is aborted, slot + KV pages free) or when a ``stop``
+    sequence is satisfied mid-stream (the answer is complete; the rest
+    of the token budget would be wasted work). Never a breaker failure
+    and never a failover attempt — the backend did nothing wrong."""
+
+    def __init__(self, reason: str, client_gone: bool = False):
+        super().__init__(reason)
+        self.client_gone = client_gone
+
+
 class JournalEntry:
     """One in-flight routed request: the resume state failover needs."""
 
     __slots__ = ("id", "prompt_ids", "max_new_tokens", "tokens",
                  "attempts", "hedges", "created_at", "finish_reason",
-                 "token_times", "priority")
+                 "token_times", "priority", "listener")
 
     def __init__(self, entry_id: int, prompt_ids: List[int],
                  max_new_tokens: int, priority: Optional[str] = None):
@@ -73,6 +87,15 @@ class JournalEntry:
         # re-stamp a token, and the failover recovery gap shows up as
         # one honest inter-token sample
         self.token_times: List[float] = []
+        # journal→SSE relay (ISSUE 20): an optional callable fired
+        # from ``drained`` with exactly the newly-extended token slice.
+        # Because it sits INSIDE the exactly-once growth guard, the
+        # gateway's SSE chunks and the SLO arrival stamps are the same
+        # accounting — a hedge twin's echo or a resume's replayed
+        # prefix can no more double-emit a chunk than double-stamp a
+        # token. May raise :class:`StreamAbort` to tear down the
+        # attempt (client disconnect / stop satisfied).
+        self.listener: Optional[Callable[[List[int]], None]] = None
 
     @property
     def remaining(self) -> int:
@@ -96,10 +119,15 @@ class JournalEntry:
             # the guard means tokens only ever GROW, so stamping the
             # tail up to the new length covers exactly the indices
             # this update added
+            prev = len(self.tokens)
             self.tokens[base:] = [int(t) for t in cumulative]
             now = time.monotonic()
             while len(self.token_times) < len(self.tokens):
                 self.token_times.append(now)
+            if self.listener is not None:
+                # one relay call per drained token group (ISSUE 20);
+                # the slice is exactly what this update added
+                self.listener(self.tokens[prev:])
 
 
 class RequestJournal:

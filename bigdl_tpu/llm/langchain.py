@@ -4,11 +4,18 @@ wrappers over the ggml models).
 langchain isn't a baked-in dependency; the classes duck-type the
 ``langchain_core`` interfaces (``invoke``/``_call``, ``embed_documents``/
 ``embed_query``) so they drop into chains when langchain is installed and
-stay usable standalone when it isn't."""
+stay usable standalone when it isn't.
+
+:class:`BigdlTpuOpenAI` (ISSUE 20) is the remote sibling: the same
+duck-typed LLM protocol over a live worker/router's OpenAI gateway
+(``base_url`` style, like langchain's ``OpenAI(base_url=...)``) instead
+of an in-process model — so a chain can point at a serving fleet by
+URL with no langchain and no openai package installed."""
 
 from __future__ import annotations
 
-from typing import Any, List, Optional
+import json
+from typing import Any, Iterator, List, Optional, Tuple
 
 import numpy as np
 
@@ -68,6 +75,132 @@ class BigdlTpuLLM:
 
     invoke = _call
     __call__ = _call
+
+
+class BigdlTpuOpenAI:
+    """Remote LLM over the OpenAI gateway (ISSUE 20): the langchain
+    ``_call``/``invoke`` protocol backed by ``POST /v1/completions`` on
+    a ``bigdl.llm.api.enabled`` worker or router. Prompts may be
+    strings (the server needs a tokenizer configured) or token-id
+    lists (native, tokenizer-free); ``stream()`` yields the SSE deltas
+    as they arrive."""
+
+    def __init__(self, base_url: str, model: str = "bigdl-tpu-llm",
+                 max_tokens: int = 64, timeout: float = 120.0,
+                 stop: Optional[List[str]] = None):
+        self.base_url = base_url
+        self.model = model
+        self.max_tokens = max_tokens
+        self.timeout = timeout
+        self.stop = list(stop) if stop else None
+        self._addr = self._parse(base_url)
+
+    @staticmethod
+    def _parse(base_url: str) -> Tuple[str, int]:
+        """``http://host:port[/v1]`` (or bare ``host:port``) → addr."""
+        url = base_url
+        for prefix in ("http://", "https://"):
+            if url.startswith(prefix):
+                url = url[len(prefix):]
+        url = url.split("/", 1)[0]
+        host, _, port = url.rpartition(":")
+        if not host or not port.isdigit():
+            raise ValueError(
+                f"base_url must carry host:port, got {base_url!r}")
+        return host, int(port)
+
+    @property
+    def _llm_type(self) -> str:
+        return "bigdl_tpu_openai"
+
+    def _request(self, method: str, path: str, body=None):
+        import http.client
+        conn = http.client.HTTPConnection(*self._addr,
+                                          timeout=self.timeout)
+        conn.request(method, path,
+                     None if body is None else json.dumps(body),
+                     {"Content-Type": "application/json"})
+        return conn, conn.getresponse()
+
+    @staticmethod
+    def _raise_api_error(status: int, parsed: dict):
+        err = parsed.get("error")
+        msg = err.get("message", "") if isinstance(err, dict) else err
+        raise RuntimeError(f"gateway answered {status}: {msg}")
+
+    def models(self) -> List[str]:
+        """Served model ids from ``GET /v1/models``."""
+        conn, resp = self._request("GET", "/v1/models")
+        try:
+            parsed = json.loads(resp.read().decode())
+            if resp.status != 200:
+                self._raise_api_error(resp.status, parsed)
+            return [m["id"] for m in parsed.get("data", [])]
+        finally:
+            conn.close()
+
+    def _body(self, prompt, stop, stream=False) -> dict:
+        body = {"model": self.model, "prompt": prompt,
+                "max_tokens": self.max_tokens}
+        stops = stop if stop is not None else self.stop
+        if stops:
+            body["stop"] = stops
+        if stream:
+            body["stream"] = True
+        return body
+
+    def _call(self, prompt, stop: Optional[List[str]] = None,
+              **kwargs: Any) -> str:
+        conn, resp = self._request(
+            "POST", "/v1/completions", self._body(prompt, stop))
+        try:
+            parsed = json.loads(resp.read().decode())
+            if resp.status != 200:
+                self._raise_api_error(resp.status, parsed)
+            return parsed["choices"][0].get("text", "")
+        finally:
+            conn.close()
+
+    invoke = _call
+    __call__ = _call
+
+    def stream(self, prompt,
+               stop: Optional[List[str]] = None) -> Iterator[str]:
+        """Yield text deltas from the SSE stream as they arrive."""
+        from bigdl_tpu.llm.api.sse import parse_sse
+        conn, resp = self._request(
+            "POST", "/v1/completions",
+            self._body(prompt, stop, stream=True))
+        try:
+            if resp.status != 200:
+                self._raise_api_error(resp.status,
+                                      json.loads(resp.read().decode()))
+            for obj in parse_sse(resp):
+                if "error" in obj:
+                    self._raise_api_error(resp.status, obj)
+                for choice in obj.get("choices", ()):
+                    if choice.get("text"):
+                        yield choice["text"]
+        finally:
+            conn.close()
+
+    def chat(self, messages: List[dict],
+             stop: Optional[List[str]] = None) -> str:
+        """One ``POST /v1/chat/completions`` turn → assistant text."""
+        body = {"model": self.model, "messages": messages,
+                "max_tokens": self.max_tokens}
+        stops = stop if stop is not None else self.stop
+        if stops:
+            body["stop"] = stops
+        conn, resp = self._request("POST", "/v1/chat/completions", body)
+        try:
+            parsed = json.loads(resp.read().decode())
+            if resp.status != 200:
+                self._raise_api_error(resp.status, parsed)
+            msg = parsed["choices"][0].get("message", {})
+            return msg.get("content", "")
+        finally:
+            conn.close()
 
 
 class BigdlTpuEmbeddings:

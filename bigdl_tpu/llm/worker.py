@@ -135,7 +135,9 @@ class LLMWorker:
                  request_timeout: float = 600.0,
                  role: Optional[str] = None,
                  federation: Optional[bool] = None,
-                 fleet: Optional[bool] = None):
+                 fleet: Optional[bool] = None,
+                 api: Optional[bool] = None,
+                 tokenizer=None):
         from bigdl_tpu.utils.conf import conf
         self.server = server
         self.model_name = model_name
@@ -158,6 +160,19 @@ class LLMWorker:
         if fleet_on:
             from bigdl_tpu.llm.fleet import DrainCoordinator
             self._drain = DrainCoordinator(server)
+        # OpenAI-compatible gateway (ISSUE 20): constructed ONLY when
+        # bigdl.llm.api.enabled — disabled mode keeps /v1/* answering
+        # 404 naming the gate and mints no bigdl_api_* series
+        api_on = (api if api is not None else
+                  conf.get_bool("bigdl.llm.api.enabled", False))
+        self._api = None
+        if api_on:
+            from bigdl_tpu.llm.api.gateway import (EngineBackend,
+                                                   OpenAIGateway)
+            self._api = OpenAIGateway(
+                EngineBackend(server, model_name,
+                              request_timeout=request_timeout),
+                tokenizer=tokenizer, scope="worker")
         self._t0 = time.time()
         self._tokens_out = 0
         worker = self
@@ -270,6 +285,14 @@ class LLMWorker:
                         self._json(404, {"error": "fleet disabled"})
                     else:
                         self._json(200, worker._drain.status())
+                elif self.path == "/v1/models":
+                    # OpenAI surface (ISSUE 20): 404 when the gateway
+                    # is off — structurally absent, naming the gate
+                    if worker._api is None:
+                        self._json(404, {"error": "api disabled "
+                                         "(bigdl.llm.api.enabled)"})
+                    else:
+                        worker._api.handle_models(self)
                 elif self.path == "/worker_get_status":
                     dt = max(time.time() - worker._t0, 1e-9)
                     status = {
@@ -365,7 +388,9 @@ class LLMWorker:
                 if self.path in ("/worker_generate",
                                  "/worker_generate_stream",
                                  "/worker_prefill",
-                                 "/worker_import_chain"):
+                                 "/worker_import_chain",
+                                 "/v1/completions",
+                                 "/v1/chat/completions"):
                     # case-insensitive trace extraction (or a fresh
                     # root); None in disabled mode — no headers emitted
                     ctx = rc.server_context(self.headers)
@@ -376,7 +401,8 @@ class LLMWorker:
                 # serves the prefill/export side — misrouted calls are
                 # the router's bug and answer 403, not a silent detour
                 if worker.role == "prefill" and self.path in (
-                        "/worker_generate", "/worker_generate_stream"):
+                        "/worker_generate", "/worker_generate_stream",
+                        "/v1/completions", "/v1/chat/completions"):
                     self._json(403, {"error": "prefill-role worker: "
                                      "use /worker_prefill"})
                     return
@@ -389,6 +415,18 @@ class LLMWorker:
                         self.path == "/worker_import_chain":
                     self._json(403, {"error": "prefill-role worker "
                                      "does not import chains"})
+                    return
+                if self.path in ("/v1/completions",
+                                 "/v1/chat/completions"):
+                    # OpenAI surface (ISSUE 20): direct engine drain
+                    # on the single-node worker; 404 naming the gate
+                    # when off — structurally absent
+                    if worker._api is None:
+                        self._json(404, {"error": "api disabled "
+                                         "(bigdl.llm.api.enabled)"})
+                        return
+                    with rc.activate(ctx):
+                        worker._api.handle_post(self, self.path)
                     return
                 if self.path == "/worker_drain":
                     # graceful drain control (ISSUE 15): begin flips
@@ -742,6 +780,68 @@ class _BackendFatal(Exception):
         self.parsed = parsed
 
 
+class _RouteError(Exception):
+    """Typed carrier for a failover-routing outcome that must surface
+    as an HTTP error. ``_route_failover`` renders it through
+    ``handler._json`` exactly as before the ISSUE 20 refactor; the
+    OpenAI gateway's router backend maps it onto OpenAI error objects
+    (503 → 429 ``rate_limit_exceeded`` keeping the Retry-After)."""
+
+    def __init__(self, status, body, headers=()):
+        super().__init__(body.get("error", f"status {status}"))
+        self.status = status
+        self.body = body
+        self.headers = tuple(headers)
+
+
+class _ApiRouterBackend:
+    """OpenAI-gateway backend over the router's failover dispatch
+    (ISSUE 20): ``generate`` runs the same journal + resume loop as
+    ``POST /worker_generate``, with the gateway's per-delta callback
+    installed as the journal entry's drain listener — the SSE chunk
+    emission and the router SLO arrival stamps happen at the same
+    drain event, so client-visible TTFT/ITL and the
+    ``bigdl_router_{ttft,itl}_seconds`` sketches are one accounting.
+    Routed pools run greedy decode (the failover bit-parity contract
+    requires determinism), so ``sampling()`` reports greedy."""
+
+    def __init__(self, router, model_name: str):
+        self.router = router
+        self.model_name = model_name
+        self.request_timeout = router.request_timeout
+
+    def sampling(self):
+        return (0.0, 0)
+
+    def generate(self, prompt_ids, max_new_tokens, priority, deadline,
+                 on_delta):
+        from bigdl_tpu.llm.api.errors import error_for_status
+        body = {"prompt_ids": [int(t) for t in prompt_ids],
+                "max_new_tokens": int(max_new_tokens)}
+        ctx = rc.current()
+
+        def fwd_headers():
+            hdrs = list(rc.to_headers(ctx))
+            if deadline is not None:
+                hdrs.append((reliability.DEADLINE_HEADER,
+                             deadline.to_header()))
+            if priority is not None:
+                hdrs.append((PRIORITY_HEADER, priority))
+            return hdrs
+
+        try:
+            ent = self.router._dispatch_failover(
+                body, fwd_headers, deadline, priority=priority,
+                listener=on_delta)
+        except _RouteError as e:
+            raise error_for_status(
+                e.status,
+                e.body.get("error", f"routing failed ({e.status})"),
+                retry_after=dict(e.headers).get("Retry-After"))
+        return [int(t) for t in ent.tokens], \
+            ent.finish_reason or "length"
+
+
 #: Prometheus encoding of breaker states (ISSUE 7 satellite):
 #: closed=0, half_open=1, open=2 — so an alerting rule is `> 1`.
 BREAKER_STATE_VALUES = {"closed": 0, "half_open": 1, "open": 2}
@@ -817,7 +917,10 @@ class LLMRouter:
                  fleet: Optional[bool] = None,
                  provider=None,
                  fleet_opts: Optional[dict] = None,
-                 start_fleet: bool = True):
+                 start_fleet: bool = True,
+                 api: Optional[bool] = None,
+                 model_name: str = "bigdl-tpu-llm",
+                 tokenizer=None):
         from bigdl_tpu.utils.conf import conf
         if not decode_workers:
             raise ValueError("the router needs at least one "
@@ -910,6 +1013,25 @@ class LLMRouter:
             self._fleet = FleetController(self, provider=provider,
                                           **(fleet_opts or {}))
             self._start_fleet = start_fleet
+        # OpenAI-compatible gateway (ISSUE 20): constructed ONLY when
+        # bigdl.llm.api.enabled. On the router it REQUIRES failover
+        # mode — the SSE relay streams from the failover journal's
+        # drain (the per-token listener), and the blocking PR 6 path
+        # streams nothing to relay.
+        self.model_name = model_name
+        api_on = (api if api is not None else
+                  conf.get_bool("bigdl.llm.api.enabled", False))
+        self._api = None
+        if api_on:
+            if not self.failover_enabled:
+                raise ValueError(
+                    "bigdl.llm.api needs bigdl.llm.failover.enabled "
+                    "on the router: the SSE relay drains the failover "
+                    "journal")
+            from bigdl_tpu.llm.api.gateway import OpenAIGateway
+            self._api = OpenAIGateway(
+                _ApiRouterBackend(self, model_name),
+                tokenizer=tokenizer, scope="router")
         self._ins = None
         router = self
 
@@ -970,6 +1092,14 @@ class LLMRouter:
                         self._json(404, {"error": "fleet disabled"})
                     else:
                         self._json(200, router._fleet.status())
+                elif self.path == "/v1/models":
+                    # OpenAI surface (ISSUE 20): 404 when the gateway
+                    # is off — structurally absent, naming the gate
+                    if router._api is None:
+                        self._json(404, {"error": "api disabled "
+                                         "(bigdl.llm.api.enabled)"})
+                    else:
+                        router._api.handle_models(self)
                 elif self.path == "/worker_get_status":
                     self._json(200, router._status_body())
                 else:
@@ -992,6 +1122,21 @@ class LLMRouter:
                         self._json(400, {"error": f"bad request: {e}"})
                         return
                     self._json(code, out)
+                    return
+                if self.path in ("/v1/completions",
+                                 "/v1/chat/completions"):
+                    # OpenAI surface (ISSUE 20): SSE relay from the
+                    # failover journal drain; 404 naming the gate when
+                    # off — structurally absent
+                    if router._api is None:
+                        self._json(404, {"error": "api disabled "
+                                         "(bigdl.llm.api.enabled)"})
+                        return
+                    ctx = rc.server_context(self.headers)
+                    if ctx is not None:
+                        self._trace = ctx.trace_id
+                    with rc.activate(ctx):
+                        router._api.handle_post(self, self.path)
                     return
                 if self.path != "/worker_generate":
                     self._json(404, {"error": "unknown path"})
@@ -1399,7 +1544,11 @@ class LLMRouter:
         finish reason. Raises :class:`_BackendShed` (503),
         :class:`_BackendFatal` (other 4xx) or a failover-eligible error
         (transport / 5xx / mid-generation engine failure — the breaker
-        records those)."""
+        records those). A :class:`~bigdl_tpu.llm.failover.StreamAbort`
+        raised out of ``on_tokens`` (the SSE relay tearing the stream
+        down, ISSUE 20) propagates without blaming the breaker — the
+        backend did nothing wrong."""
+        from bigdl_tpu.llm import failover as fo
         breaker = self._breaker_for(addr)
         conn = http.client.HTTPConnection(addr[0], addr[1],
                                           timeout=self.request_timeout)
@@ -1471,7 +1620,8 @@ class LLMRouter:
                         f"{addr[0]}:{addr[1]} timed out mid-generation "
                         f"({len(last.get('output_ids', []))} tokens "
                         "drained)")
-            except (_BackendShed, _BackendFatal, _BackendDraining):
+            except (_BackendShed, _BackendFatal, _BackendDraining,
+                    fo.StreamAbort):
                 raise
             except Exception:
                 # same hedge-loser carve-out as _call: a socket we
@@ -1511,7 +1661,10 @@ class LLMRouter:
 
         hedge_fn = None
         hedge_addr = None
-        if self._hedge.allow():
+        # SSE-relayed requests never hedge: the drain listener fires
+        # from whichever twin extends the journal, and a StreamAbort it
+        # raises must unwind ONE attempt, not a race of two
+        if self._hedge.allow() and ent.listener is None:
             hedge_addr = self._pick(
                 "decode", exclude={addr} | (tried or set()))
             if hedge_addr is not None and hedge_addr != addr:
@@ -1550,13 +1703,58 @@ class LLMRouter:
 
     def _route_failover(self, handler, body, fwd_headers, deadline,
                         priority=None):
+        """The native JSON surface over :meth:`_dispatch_failover`:
+        typed routing errors render through ``handler._json`` exactly
+        as they did before the ISSUE 20 refactor."""
+        try:
+            ent = self._dispatch_failover(body, fwd_headers, deadline,
+                                          priority=priority)
+        except _RouteError as e:
+            handler._json(e.status, e.body, headers=e.headers)
+            return
+        handler._json(200, {
+            "output_ids": [int(t) for t in ent.tokens],
+            "finish_reason": ent.finish_reason or "length"})
+
+    def _observe_slo(self, ent):
+        """Client-visible SLO verdict from the journal's token arrival
+        stamps (ISSUE 12): resumed/hedged tokens were stamped exactly
+        once by ``JournalEntry.drained``, so a mid-stream failover
+        contributes its recovery gap as ONE inter-token sample instead
+        of replayed duplicates. Shared by the native JSON path and the
+        OpenAI SSE relay (ISSUE 20) — the gateway's chunks fire from
+        the same drain events, so there is one accounting, not two."""
+        if self._slo is None:
+            return
+        from bigdl_tpu.observability.slo import itl_samples
+        times = list(ent.token_times)
+        if times:
+            ttft = times[0] - ent.created_at
+            self._slo.observe_ttft(ttft)
+            gaps = itl_samples(times)
+            for g in gaps:
+                self._slo.observe_itl(g)
+            self._slo.finish(ttft, max(gaps) if gaps else None)
+        else:
+            self._slo.finish(None, None)
+
+    def _dispatch_failover(self, body, fwd_headers, deadline,
+                           priority=None, listener=None):
+        """Journal + resume dispatch loop (ISSUE 7), decoupled from the
+        HTTP handler (ISSUE 20): returns the completed journal entry or
+        raises :class:`_RouteError`. ``listener`` (the OpenAI gateway's
+        per-delta callback) is installed as the entry's drain listener;
+        a :class:`~bigdl_tpu.llm.failover.StreamAbort` it raises tears
+        down the attempt without a failover retry and propagates after
+        the delivered tokens are SLO-observed."""
+        from bigdl_tpu.llm import failover as fo
         prompt_ids = body["prompt_ids"]
         try:
             mnt = int(body.get("max_new_tokens", 32))
         except (TypeError, ValueError):
-            handler._json(400, {"error": "bad max_new_tokens"})
-            return
+            raise _RouteError(400, {"error": "bad max_new_tokens"})
         ent = self._journal.add(prompt_ids, mnt, priority=priority)
+        ent.listener = listener
         self._hedge.note_request()
         ins = self._instruments()
         if ins is not None and "journal" in ins:
@@ -1570,20 +1768,18 @@ class LLMRouter:
             drain_bounces = 0
             while True:
                 if deadline is not None and deadline.expired():
-                    handler._json(504, {
+                    raise _RouteError(504, {
                         "error": "deadline exceeded while routing",
                         "tokens_drained": len(ent.tokens)})
-                    return
                 addr = self._pick("decode", exclude=tried)
                 if addr is None:
                     reliability.count_shed("llm_router")
-                    handler._json(
+                    raise _RouteError(
                         503, {"error": "no decode backend available "
                               "(breakers open or unhealthy)"},
                         headers=(("Retry-After",
                                   reliability.retry_after_seconds(
                                       self._journal.inflight())),))
-                    return
                 if handoff and addr not in imported:
                     try:
                         self._call(addr, "/worker_import_chain",
@@ -1597,6 +1793,13 @@ class LLMRouter:
                     ent.finish_reason = self._decode_attempt(
                         addr, ent, fwd_headers, tried)
                     break
+                except fo.StreamAbort:
+                    # the SSE relay tore the stream down (client gone,
+                    # or stop satisfied): no retry, no breaker blame —
+                    # observe what was delivered, let the gateway
+                    # decide how the request ends
+                    self._observe_slo(ent)
+                    raise
                 except _BackendDraining:
                     # drain bounce (ISSUE 15): the backend is healthy
                     # but winding down — route elsewhere without
@@ -1612,24 +1815,21 @@ class LLMRouter:
                     if drain_bounces > 2 * max(
                             len(self.decode_workers), 1):
                         reliability.count_shed("llm_router")
-                        handler._json(
+                        raise _RouteError(
                             503, {"error": "every decode backend is "
                                   "draining"},
                             headers=(("Retry-After",
                                       reliability.retry_after_seconds(
                                           self._journal.inflight())),))
-                        return
                     continue
                 except _BackendShed as e:
                     reliability.count_shed("llm_router")
                     ra = e.retry_after or \
                         reliability.retry_after_seconds(0)
-                    handler._json(503, e.parsed,
-                                  headers=(("Retry-After", ra),))
-                    return
+                    raise _RouteError(503, e.parsed,
+                                      headers=(("Retry-After", ra),))
                 except _BackendFatal as e:
-                    handler._json(e.status, e.parsed)
-                    return
+                    raise _RouteError(e.status, e.parsed)
                 except Exception as e:  # noqa: BLE001 — failover
                     tried.add(addr)
                     if ent.remaining == 0:
@@ -1639,11 +1839,10 @@ class LLMRouter:
                         break
                     if not self.failover_enabled or \
                             ent.attempts >= self.max_attempts:
-                        handler._json(502, {
+                        raise _RouteError(502, {
                             "error": f"decode backend failed after "
                                      f"{ent.attempts} attempt(s): {e}",
                             "tokens_drained": len(ent.tokens)})
-                        return
                     # journal → resume: re-dispatch prompt + generated
                     # so far to another backend (the tentpole)
                     self._journal.record_failover(ent)
@@ -1659,26 +1858,8 @@ class LLMRouter:
                            if rc.current() is not None else {}))
                     continue
             self.requests_routed += 1
-            if self._slo is not None:
-                # client-visible SLO verdict from the journal's token
-                # arrival stamps (ISSUE 12): resumed/hedged tokens were
-                # stamped exactly once by JournalEntry.drained, so a
-                # mid-stream failover contributes its recovery gap as
-                # ONE inter-token sample instead of replayed duplicates
-                from bigdl_tpu.observability.slo import itl_samples
-                times = list(ent.token_times)
-                if times:
-                    ttft = times[0] - ent.created_at
-                    self._slo.observe_ttft(ttft)
-                    gaps = itl_samples(times)
-                    for g in gaps:
-                        self._slo.observe_itl(g)
-                    self._slo.finish(ttft, max(gaps) if gaps else None)
-                else:
-                    self._slo.finish(None, None)
-            handler._json(200, {
-                "output_ids": [int(t) for t in ent.tokens],
-                "finish_reason": ent.finish_reason or "length"})
+            self._observe_slo(ent)
+            return ent
         finally:
             self._journal.complete(ent)
             if ins is not None and "journal" in ins:

@@ -47,6 +47,7 @@ EVENT_KINDS: Tuple[str, ...] = (
     "failover", "hedge", "drain_migrate", "scale_out", "scale_in",
     "preempt", "preempt_resume", "finish", "alert_fire",
     "alert_resolve", "draft", "verify_accept", "verify_reject",
+    "client_abort",
 )
 
 

@@ -142,6 +142,14 @@ _DEFAULTS: Dict[str, str] = {
     # no prober thread, blocking dispatch)
     "bigdl.llm.failover.enabled": "false",
     "bigdl.llm.failover.max.attempts": "3",   # dispatch tries/request
+    # OpenAI-compatible gateway (ISSUE 20): /v1/completions,
+    # /v1/chat/completions and /v1/models on workers and the router,
+    # with stream=true relayed as SSE from the failover journal drain.
+    # false = structurally absent (routes 404 naming this gate, no
+    # bigdl_api_* series, the api package is never imported)
+    "bigdl.llm.api.enabled": "false",
+    "bigdl.llm.api.tokenizer": "",            # "" token-ids only; "byte"
+    "bigdl.llm.api.chat_template": "plain",   # plain | llama | chatglm
     "bigdl.llm.prober.interval": "0.5",       # /healthz poll (seconds)
     # hedged dispatch (ISSUE 7): duplicate a slow prefill/decode call
     # to a second backend after a p95-based delay; first success wins

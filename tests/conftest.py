@@ -93,6 +93,10 @@ def pytest_configure(config):
         "markers",
         "spec: self-speculative decoding tests (greedy bit-parity "
         "matrix, adaptive-k, compile grid; select with -m spec)")
+    config.addinivalue_line(
+        "markers",
+        "api: OpenAI-compatible gateway tests (translation, SSE "
+        "framing, worker/router parity; select with -m api)")
 
 
 @pytest.fixture(scope="session")

@@ -281,6 +281,18 @@ def _flatten_full(rec: dict) -> Dict[str, float]:
         val = ab.get(field)
         if isinstance(val, (int, float)) and not isinstance(val, bool):
             flat[key] = float(val)
+    # ISSUE 20: the OpenAI gateway — client-visible streaming TTFT
+    # through the SSE leg and the gateway's translation+framing
+    # overhead vs the native stream on the same prompts; the mismatch
+    # tally drifting off 0 means the gateway stopped being a faithful
+    # view of the engine
+    ob = (((rec.get("extra") or {}).get("telemetry") or {})
+          .get("openai_api") or {})
+    for field in ("ttft_direct_p50_ms", "ttft_gateway_p50_ms",
+                  "gateway_overhead_ms", "output_mismatches"):
+        val = ob.get(field)
+        if isinstance(val, (int, float)) and not isinstance(val, bool):
+            flat[f"api.{field}"] = float(val)
     # ISSUE 16: the live roofline gauges sampled while the serving
     # microbenches ran — MFU or achieved HBM bandwidth drifting down
     # between rounds is a dispatch-efficiency regression even when

@@ -801,6 +801,194 @@ def run_failover_chaos(seed: int = 0, n_requests: int = 4,
         s2.stop()
 
 
+def run_api_chaos(seed: int = 0, n_requests: int = 3, kills: int = 1,
+                  new_tokens: int = 5, smoke: bool = False) -> dict:
+    """ISSUE 20 acceptance: the OpenAI gateway's SSE stream rides the
+    failover journal, so a mid-stream ``router.dispatch`` kill under a
+    live SSE client must be invisible at the ``data:`` boundary — the
+    concatenated stream stays bit-identical to ``model.generate`` and
+    every relayed token is stamped exactly once in the router's SLO
+    sketches (the chunks and the stamps fire from the same journal
+    drain event — one accounting, not two).
+
+    Also asserts the disabled-mode contract: with the gate off the
+    worker and router hold no gateway object, ``/v1/*`` answers 404
+    naming ``bigdl.llm.api.enabled``, and serving a native request
+    grows no ``bigdl_api_*`` metric series."""
+    import numpy as np
+
+    from bigdl_tpu import observability as obs
+    from bigdl_tpu import reliability as rel
+    from bigdl_tpu.llm.models.llama import LlamaConfig, LlamaForCausalLM
+    from bigdl_tpu.llm.serving import LLMServer
+    from bigdl_tpu.llm.worker import LLMRouter, LLMWorker
+    from tools.loadgen import _post_stream_openai
+
+    if smoke:
+        n_requests = min(n_requests, 2)
+        new_tokens = min(new_tokens, 4)
+
+    model = LlamaForCausalLM.from_config(LlamaConfig.tiny(), seed=0,
+                                         max_cache_len=128)
+    rs = np.random.RandomState(seed)
+    prompts = [rs.randint(0, 250, 8 + 2 * j).astype(np.int32)
+               for j in range(n_requests)]
+    want = [list(map(int,
+                     model.generate(p[None],
+                                    max_new_tokens=new_tokens)
+                     [0, len(p):]))
+            for p in prompts]
+
+    def get(addr, path, timeout=60):
+        import http.client
+        import json as _json
+        conn = http.client.HTTPConnection(*addr, timeout=timeout)
+        try:
+            conn.request("GET", path)
+            r = conn.getresponse()
+            return r.status, _json.loads(r.read().decode())
+        finally:
+            conn.close()
+
+    # --- disabled-mode structural absence (gate off, one native req)
+    s0 = LLMServer(model, max_batch=2, max_seq_len=64, page_size=8) \
+        .start()
+    w0 = LLMWorker(s0, role="decode").start()
+    r0 = LLMRouter([], [w0.address], failover=True,
+                   start_prober=False).start()
+    before = set(obs.render().splitlines()) if obs.enabled() else set()
+    try:
+        assert w0._api is None and r0._api is None, \
+            "disabled mode built a gateway object"
+        for addr in (w0.address, r0.address):
+            st, body = get(addr, "/v1/models")
+            assert st == 404 and \
+                "bigdl.llm.api.enabled" in body.get("error", ""), \
+                f"disabled /v1/models answered {st}: {body}"
+        st, body = _post_stream_openai(
+            w0.address, {"prompt_ids": [1, 2, 3],
+                         "max_new_tokens": 2}, 60)[:2]
+        assert st == 404 and \
+            "bigdl.llm.api.enabled" in body.get("error", ""), \
+            f"disabled /v1/completions answered {st}: {body}"
+        srv_out = s0.submit(prompts[0], max_new_tokens=2).get(
+            timeout=600)
+        assert len(srv_out) == 2, f"warmup answered {srv_out!r}"
+        if obs.enabled():
+            new = "\n".join(set(obs.render().splitlines()) - before)
+            assert "bigdl_api_" not in new, \
+                f"disabled mode grew gateway series: {new}"
+    finally:
+        r0.stop()
+        w0.stop()
+        s0.stop()
+
+    # --- the storm: SSE client + mid-stream dispatch kill
+    was_enabled = rel.enabled()
+    if not was_enabled:
+        rel.enable()
+    s1 = LLMServer(model, max_batch=2, max_seq_len=64, page_size=8,
+                   kvcache=True, slo=True).start()
+    s2 = LLMServer(model, max_batch=2, max_seq_len=64, page_size=8,
+                   kvcache=True, slo=True).start()
+    w1 = LLMWorker(s1, role="decode").start()
+    w2 = LLMWorker(s2, role="decode").start()
+    router = LLMRouter([], [w1.address, w2.address], failover=True,
+                       failover_attempts=8, start_prober=False,
+                       slo=True, api=True).start()
+
+    def _slo_counts():
+        if not obs.enabled():
+            return None
+        reg = obs.REGISTRY
+        return {
+            "ttft": reg.sample_value("bigdl_router_ttft_seconds") or 0.0,
+            "itl": reg.sample_value("bigdl_router_itl_seconds") or 0.0}
+    slo_before = _slo_counts()
+    try:
+        # warm every storm shape on both engines (prefill + suffix
+        # resume) so compiles don't eat the kill windows
+        for srv in (s1, s2):
+            for p in prompts:
+                srv.submit(p, max_new_tokens=1).get(timeout=600)
+                srv.submit(p, max_new_tokens=1).get(timeout=600)
+        plan = rel.FaultPlan(seed=seed)
+        for k in range(kills):
+            plan.add("router.dispatch", "raise", times=1,
+                     after=3 + 2 * k)
+        plan.add("llm.step", "delay", times=None, delay=0.02)
+        rel.set_plan(plan)
+        got = []
+        failures = []
+        try:
+            for j, p in enumerate(prompts):
+                st, parsed, _, ttft, gaps = _post_stream_openai(
+                    router.address,
+                    {"prompt_ids": [int(t) for t in p],
+                     "max_new_tokens": new_tokens}, 600)
+                if st != 200 or parsed.get("error") is not None:
+                    failures.append((j, st, parsed.get("error")))
+                    got.append(None)
+                else:
+                    got.append(parsed["output_ids"])
+        finally:
+            rel.set_plan(None)
+            if not was_enabled:
+                rel.disable()
+        out = {
+            "seed": seed,
+            "requests": n_requests,
+            "events_fired": [f"{s}:{a}" for s, a in plan.fired],
+            "failovers": router.failovers,
+            "tokens_resumed": router.tokens_resumed,
+            "lost_requests": len(failures),
+            "match": got == want,
+        }
+        if failures:
+            raise AssertionError(
+                f"api chaos lost {len(failures)} request(s) "
+                f"(fired: {out['events_fired']}): {failures}")
+        if not any(s == "router.dispatch" for s, _ in plan.fired):
+            raise AssertionError(
+                "api chaos armed but no router.dispatch kill fired — "
+                "widen the kill windows")
+        if router.failovers == 0:
+            raise AssertionError(
+                "api chaos completed without a failover — the kill "
+                "landed outside the SSE-relayed stream")
+        if got != want:
+            raise AssertionError(
+                f"SSE stream divergence (fired: {out['events_fired']}"
+                f"): {got} vs {want}")
+        # the SSE boundary and the SLO sketches are ONE accounting:
+        # exactly n first-token stamps and Σ(tokens-1) gap stamps for
+        # the streamed requests, failover or not
+        slo_after = _slo_counts()
+        if slo_after is not None:
+            ttft_n = slo_after["ttft"] - slo_before["ttft"]
+            itl_n = slo_after["itl"] - slo_before["itl"]
+            want_itl = sum(len(w) - 1 for w in want)
+            out["slo_ttft_samples"] = ttft_n
+            out["slo_itl_samples"] = itl_n
+            if ttft_n != len(want):
+                raise AssertionError(
+                    f"SLO ttft sketch holds {ttft_n} samples for "
+                    f"{len(want)} SSE requests — the relay double- or "
+                    "under-stamped first tokens")
+            if itl_n != want_itl:
+                raise AssertionError(
+                    f"SLO itl sketch holds {itl_n} samples, expected "
+                    f"{want_itl}: SSE-relayed tokens were not stamped "
+                    "exactly once")
+        return out
+    finally:
+        router.stop()
+        w1.stop()
+        w2.stop()
+        s1.stop()
+        s2.stop()
+
+
 def _counter_total(name: str) -> Optional[float]:
     """Sum of every child of one registry counter, or None when the
     observability registry is disabled (the flight cross-check then
@@ -2293,6 +2481,8 @@ def run_all_chaos(seed: int = 0) -> dict:
                          ("elastic", lambda: run_elastic_chaos(
                              seed=seed, smoke=True)),
                          ("alerts", lambda: run_alerts_chaos(
+                             seed=seed, smoke=True)),
+                         ("api", lambda: run_api_chaos(
                              seed=seed, smoke=True))):
             try:
                 out[name] = fn()
@@ -2389,11 +2579,21 @@ def main():
                          "autoscaler making identical decisions "
                          "through the store primitive, and disabled "
                          "mode structurally absent (ISSUE 18)")
+    ap.add_argument("--api", action="store_true",
+                    help="run the OpenAI gateway pass: a mid-stream "
+                         "router.dispatch kill under a live SSE client "
+                         "must keep the concatenated stream "
+                         "bit-identical to model.generate with every "
+                         "relayed token SLO-stamped exactly once, and "
+                         "disabled mode must 404 naming "
+                         "bigdl.llm.api.enabled with zero bigdl_api_* "
+                         "series (ISSUE 20)")
     ap.add_argument("--all", action="store_true",
                     help="run every chaos suite (train, kvcache, "
                          "kvtier, mixed, failover, fleet, preempt, "
-                         "spec, elastic, alerts) and report one record "
-                         "per pass (the bench.py chaos_all block)")
+                         "spec, elastic, alerts, api) and report one "
+                         "record per pass (the bench.py chaos_all "
+                         "block)")
     ap.add_argument("--cpu", action="store_true",
                     help="force the CPU backend (sitecustomize pins the "
                          "axon TPU platform; env vars are ineffective)")
@@ -2407,7 +2607,9 @@ def main():
         if not out["ok"]:
             sys.exit(1)
         return
-    if args.spec:
+    if args.api:
+        out = run_api_chaos(seed=args.seed)
+    elif args.spec:
         out = run_spec_chaos(seed=args.seed)
     elif args.elastic:
         out = run_elastic_chaos(seed=args.seed)

@@ -57,6 +57,10 @@ OUTPUT_LENS = (2, 4, 6, 8)
 PRIORITY_HEADER = "X-BigDL-Priority"
 PRIORITY_CLASSES = ("interactive", "standard", "batch")
 
+#: model id the OpenAI gateway serves (ISSUE 20) — the worker/router
+#: default; --openai-model overrides for renamed deployments
+OPENAI_MODEL = "bigdl-tpu-llm"
+
 
 def parse_priority_mix(spec: str) -> List[Tuple[str, int]]:
     """``"interactive:1,standard:1,batch:2"`` → ``[(class, weight)]``.
@@ -188,13 +192,118 @@ def _post_stream(addr: Tuple[str, int], body: dict, timeout: float,
         conn.close()
 
 
+def _openai_error(parsed: dict) -> dict:
+    """Normalize an OpenAI error body to the native ``{"error": msg}``
+    shape the retry/report loop already understands."""
+    err = parsed.get("error")
+    if isinstance(err, dict):
+        return {"error": err.get("message", "")}
+    return parsed
+
+
+def _post_openai(addr: Tuple[str, int], body: dict, timeout: float,
+                 headers: Optional[dict] = None,
+                 model: str = OPENAI_MODEL):
+    """Blocking ``/v1/completions`` leg (ISSUE 20): same return shape
+    as :func:`_post` — the choice's ``token_ids`` renamed to
+    ``output_ids`` so parity asserts are endpoint-agnostic."""
+    import http.client
+    conn = http.client.HTTPConnection(addr[0], addr[1], timeout=timeout)
+    try:
+        hdrs = {"Content-Type": "application/json"}
+        if headers:
+            hdrs.update(headers)
+        req = {"model": model,
+               "prompt": body["prompt_ids"],
+               "max_tokens": body["max_new_tokens"]}
+        conn.request("POST", "/v1/completions", json.dumps(req), hdrs)
+        resp = conn.getresponse()
+        data = resp.read()
+        try:
+            parsed = json.loads(data.decode())
+        except ValueError:
+            parsed = {"error": data.decode(errors="replace")[:200]}
+        if resp.status != 200:
+            return resp.status, _openai_error(parsed), resp.msg
+        choice = parsed["choices"][0]
+        return 200, {"output_ids": choice.get("token_ids", []),
+                     "finish_reason": choice.get("finish_reason")}, \
+            resp.msg
+    finally:
+        conn.close()
+
+
+def _post_stream_openai(addr: Tuple[str, int], body: dict,
+                        timeout: float,
+                        headers: Optional[dict] = None,
+                        model: str = OPENAI_MODEL):
+    """SSE ``/v1/completions`` leg (ISSUE 20): same return shape as
+    :func:`_post_stream`. TTFT/ITL are measured at the SSE boundary —
+    the client-visible numbers the gateway's journal stamps must
+    reconcile with. A mid-stream SSE ``error`` event surfaces as a
+    retriable ``{"error": ...}`` final payload, mirroring the native
+    stream's terminal error chunk."""
+    import http.client
+
+    from bigdl_tpu.llm.api.sse import parse_sse
+    conn = http.client.HTTPConnection(addr[0], addr[1], timeout=timeout)
+    try:
+        hdrs = {"Content-Type": "application/json"}
+        if headers:
+            hdrs.update(headers)
+        req = {"model": model,
+               "prompt": body["prompt_ids"],
+               "max_tokens": body["max_new_tokens"],
+               "stream": True}
+        t_send = time.perf_counter()
+        conn.request("POST", "/v1/completions", json.dumps(req), hdrs)
+        resp = conn.getresponse()
+        if resp.status != 200:
+            data = resp.read()
+            try:
+                parsed = json.loads(data.decode())
+            except ValueError:
+                parsed = {"error": data.decode(errors="replace")[:200]}
+            return resp.status, _openai_error(parsed), resp.msg, None, []
+        ttft = None
+        gaps: List[float] = []
+        t_prev = None
+        tokens: List[int] = []
+        finish = None
+        err = None
+        for obj in parse_sse(resp):
+            now = time.perf_counter()
+            if "error" in obj:
+                err = _openai_error(obj)["error"]
+                continue
+            choice = (obj.get("choices") or [{}])[0]
+            new = choice.get("token_ids", [])
+            if new:
+                if ttft is None:
+                    ttft = now - t_send
+                elif t_prev is not None:
+                    gaps.append(now - t_prev)
+                t_prev = now
+                tokens.extend(int(t) for t in new)
+            if choice.get("finish_reason"):
+                finish = choice["finish_reason"]
+        if err is not None:
+            return 200, {"error": err}, resp.msg, ttft, gaps
+        return 200, {"output_ids": tokens, "finish_reason": finish}, \
+            resp.msg, ttft, gaps
+    finally:
+        conn.close()
+
+
 def run_load(addr: Tuple[str, int], prompts: Sequence[Any],
              max_new_tokens: Any = 4, qps: float = 20.0,
              concurrency: int = 4,
              max_retries: int = 20, retry_cap_s: float = 0.25,
              request_timeout: float = 120.0,
              priorities: Optional[Sequence[str]] = None,
-             stream: bool = False) -> Dict[str, Any]:
+             stream: bool = False,
+             openai: bool = False,
+             openai_model: str = OPENAI_MODEL) -> Dict[str, Any]:
     """Drive ``prompts`` through ``addr`` at ``qps`` scheduled arrivals.
     ``max_new_tokens`` may be one int or a per-prompt sequence of the
     same length (the mixed-output part of the soak). ``priorities``
@@ -205,7 +314,11 @@ def run_load(addr: Tuple[str, int], prompts: Sequence[Any],
     TTFT and ITL, not just completion latency. Returns the result
     record described in the module docstring; ``outputs[i]`` is request
     ``i``'s token list (None when lost — the zero-lost assertion is
-    ``lost == 0``)."""
+    ``lost == 0``). ``openai=True`` (ISSUE 20) drives the same traffic
+    through the gateway's ``/v1/completions`` instead — SSE when
+    ``stream`` — retrying the gateway's 429 translation of a shed
+    exactly like the native 503 (same Retry-After honor), so every
+    parity/loss assertion is endpoint-agnostic."""
     from bigdl_tpu.observability.sketch import QuantileSketch
     n = len(prompts)
     if isinstance(max_new_tokens, (list, tuple)):
@@ -263,10 +376,20 @@ def run_load(addr: Tuple[str, int], prompts: Sequence[Any],
                 ttft = None
                 gaps: List[float] = []
                 try:
-                    if stream:
+                    if stream and openai:
+                        status, parsed, hdrs, ttft, gaps = \
+                            _post_stream_openai(addr, body,
+                                                request_timeout,
+                                                req_headers,
+                                                model=openai_model)
+                    elif stream:
                         status, parsed, hdrs, ttft, gaps = \
                             _post_stream(addr, body, request_timeout,
                                          req_headers)
+                    elif openai:
+                        status, parsed, hdrs = _post_openai(
+                            addr, body, request_timeout, req_headers,
+                            model=openai_model)
                     else:
                         status, parsed, hdrs = _post(
                             addr, body, request_timeout, req_headers)
@@ -297,10 +420,11 @@ def run_load(addr: Tuple[str, int], prompts: Sequence[Any],
                                 rec["itl"].observe(g)
                     done = True
                     break
-                if status == 503:
+                if status in (503, 429):
                     # backpressure: honor the server's Retry-After
-                    # (capped — the soak must finish), then retry.
-                    # Shed-then-served is latency, never loss.
+                    # (capped — the soak must finish), then retry. 429
+                    # is the gateway's OpenAI translation of the same
+                    # shed. Shed-then-served is latency, never loss.
                     with lock:
                         counters["retries_503"] += 1
                         if cls is not None:
@@ -400,7 +524,8 @@ def sketch_window(before: Optional[dict], after: Optional[dict],
 
 def run_fleet_soak(n_requests: int = 8, qps: float = 100.0,
                    seed: int = 0,
-                   priority_mix: Optional[str] = None) -> Dict[str, Any]:
+                   priority_mix: Optional[str] = None,
+                   openai: bool = False) -> Dict[str, Any]:
     """The ``fleet_elastic`` bench telemetry block (ISSUE 15): a
     fault-free soak of the elastic fleet — spike against one worker,
     autoscaler scale-out, graceful drain-and-scale-in back to the
@@ -410,7 +535,11 @@ def run_fleet_soak(n_requests: int = 8, qps: float = 100.0,
     ISSUE 17 ``parse_priority_mix`` spec) turns on the SLO-class
     scheduler in the pool's workers, stamps each request with its
     class, and adds a ``per_class`` block — the mixed-class version of
-    the same soak. The chaos variant with kills lives in
+    the same soak. ``openai=True`` (ISSUE 20) enables the gateway on
+    every pool worker and the router and drives the identical soak
+    through ``/v1/completions`` SSE instead of the native endpoint —
+    elastic scale-out/drain must be invisible at the OpenAI boundary
+    too. The chaos variant with kills lives in
     ``tools/chaos_check.py --fleet``."""
     import time as _time
 
@@ -433,7 +562,9 @@ def run_fleet_soak(n_requests: int = 8, qps: float = 100.0,
         slo=True)
     if classes is not None:
         server_kwargs["priority"] = True
-    provider = LocalWorkerProvider(model, server_kwargs=server_kwargs)
+    worker_kwargs = dict(api=True) if openai else None
+    provider = LocalWorkerProvider(model, server_kwargs=server_kwargs,
+                                   worker_kwargs=worker_kwargs)
     router = None
     ttft_before = registry_sketch_snapshot("bigdl_router_ttft_seconds")
     itl_before = registry_sketch_snapshot("bigdl_llm_itl_seconds")
@@ -446,7 +577,7 @@ def run_fleet_soak(n_requests: int = 8, qps: float = 100.0,
         router = LLMRouter(
             [], [seed_addr], failover=True, failover_attempts=8,
             start_prober=False, slo=True, fleet=True,
-            provider=provider, start_fleet=False,
+            provider=provider, start_fleet=False, api=openai,
             fleet_opts=dict(min_workers=1, max_workers=3,
                             interval=0.05, cooldown=0.0, sustain=1,
                             queue_high=1.0, idle_low=0.0,
@@ -459,7 +590,8 @@ def run_fleet_soak(n_requests: int = 8, qps: float = 100.0,
             holder["res"] = run_load(router.address, prompts,
                                      max_new_tokens=4, qps=qps,
                                      concurrency=4,
-                                     priorities=classes)
+                                     priorities=classes,
+                                     openai=openai, stream=openai)
         t = _threading.Thread(target=_run, daemon=True)
         t.start()
         deadline = _time.time() + 60.0
@@ -503,6 +635,65 @@ def run_fleet_soak(n_requests: int = 8, qps: float = 100.0,
             conf.set("bigdl.llm.kvtier.sync", prev_sync)
 
 
+def run_openai_bench(n_requests: int = 6, max_new: int = 6,
+                     seed: int = 0) -> Dict[str, Any]:
+    """The ``openai_api`` bench telemetry block (ISSUE 20): one
+    api-enabled worker, the same seeded prompts streamed twice — native
+    ``/worker_generate_stream`` vs gateway ``/v1/completions`` SSE —
+    reporting client-visible TTFT p50 for both and the gateway's added
+    latency (translation + SSE framing over the same journal-free
+    engine path). Outputs must be bit-identical between the two
+    endpoints; mismatches are reported, not asserted (bench telemetry
+    is advisory — the hard assert lives in tests/test_api.py)."""
+    from bigdl_tpu.llm.models.llama import LlamaConfig, LlamaForCausalLM
+    from bigdl_tpu.llm.serving import LLMServer
+    from bigdl_tpu.llm.worker import LLMWorker
+    from bigdl_tpu.observability.sketch import QuantileSketch
+
+    model = LlamaForCausalLM.from_config(LlamaConfig.tiny(), seed=0,
+                                         max_cache_len=128)
+    prompts = gen_prompts(n_requests, seed=seed)
+    srv = LLMServer(model, max_batch=2, max_seq_len=64, page_size=8,
+                    kvcache=True).start()
+    worker = LLMWorker(srv, api=True).start()
+    try:
+        for p in prompts:       # warm every compiled shape first
+            srv.submit(p, max_new_tokens=1).get(timeout=600)
+        addr = worker.address
+        direct = QuantileSketch()
+        gateway = QuantileSketch()
+        mismatches = 0
+        for i, p in enumerate(prompts):
+            body = {"prompt_ids": [int(t) for t in p],
+                    "max_new_tokens": max_new}
+            st, native, _, t_direct, _ = _post_stream(
+                addr, body, 120.0)
+            st2, via, _, t_gw, _ = _post_stream_openai(
+                addr, body, 120.0)
+            if st == 200 and t_direct is not None:
+                direct.observe(t_direct)
+            if st2 == 200 and t_gw is not None:
+                gateway.observe(t_gw)
+            if st != 200 or st2 != 200 or \
+                    list(native.get("output_ids", [])) != \
+                    list(via.get("output_ids", [])):
+                mismatches += 1
+        d50 = direct.quantiles((0.5,)).get(0.5)
+        g50 = gateway.quantiles((0.5,)).get(0.5)
+        return {
+            "requests": n_requests,
+            "ttft_direct_p50_ms": _ms(d50),
+            "ttft_gateway_p50_ms": _ms(g50),
+            "gateway_overhead_ms": (
+                None if d50 is None or g50 is None
+                else round((g50 - d50) * 1000.0, 3)),
+            "output_mismatches": mismatches,
+        }
+    finally:
+        worker.stop()
+        srv.stop()
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--url", required=True,
@@ -521,9 +712,18 @@ def main():
                          "batch:2' — stamps X-BigDL-Priority and "
                          "reports per-class TTFT/ITL sketches")
     ap.add_argument("--no-stream", action="store_true",
-                    help="with --priority-mix, use the blocking "
-                         "endpoint (per-class TTFT/ITL unavailable; "
-                         "needed when the target is a router)")
+                    help="with --priority-mix or --openai, use the "
+                         "blocking endpoint (per-class TTFT/ITL "
+                         "unavailable; needed when a priority-mix "
+                         "target is a router)")
+    ap.add_argument("--openai", action="store_true",
+                    help="drive the OpenAI gateway (/v1/completions, "
+                         "SSE unless --no-stream) instead of the "
+                         "native endpoints; requires "
+                         "bigdl.llm.api.enabled on the target")
+    ap.add_argument("--openai-model", default=OPENAI_MODEL,
+                    help="model id to send with --openai (must match "
+                         "the target's served model)")
     args = ap.parse_args()
     host, port = args.url.rsplit(":", 1)
     prompts = gen_prompts(args.requests, seed=args.seed,
@@ -534,7 +734,9 @@ def main():
                    max_new_tokens=args.max_new, qps=args.qps,
                    concurrency=args.concurrency,
                    priorities=classes,
-                   stream=bool(classes is not None
+                   openai=args.openai,
+                   openai_model=args.openai_model,
+                   stream=bool((classes is not None or args.openai)
                                and not args.no_stream))
     out.pop("outputs")          # token lists are for parity asserts,
     print(json.dumps(out, indent=1))   # not for the CLI report
